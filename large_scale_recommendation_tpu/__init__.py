@@ -1,0 +1,49 @@
+"""large_scale_recommendation_tpu — a TPU-native framework for large-scale
+recommendation via distributed matrix factorization.
+
+A ground-up JAX/XLA/pallas/pjit rebuild of the capabilities of the reference
+Flink+Spark framework (Mallik-G/large-scale-recommendation):
+
+- batch DSGD (Gemulla-style stratified SGD) with stratum rotation mapped to
+  ``lax.ppermute`` over a TPU device mesh
+  (reference: flink-adaptive-recom/.../mf/offline/DSGDforMF.scala,
+  spark-adaptive-recom/.../OfflineSpark.scala)
+- ALS normal-equation solver (reference periodic-retrain path:
+  spark-adaptive-recom/.../OnlineSpark.scala:125-131)
+- online/streaming MF with incremental updates-only output
+  (reference: .../mf/online/FlinkOnlineMF.scala, OnlineSpark.scala)
+- combined online + periodic batch retraining with state-machine switchover
+  (reference: .../mf/PSOfflineOnlineMF.scala)
+- async parameter-server execution semantics with bounded in-flight windows
+  (reference: .../ps/FlinkPS.scala, .../mf/PSOfflineMF.scala)
+- pluggable factor initializers/updaters behind the same seam the reference's
+  ``core`` module defines (reference: core/.../FactorInitializer.scala,
+  FactorUpdater.scala)
+- prediction + empirical-risk evaluation
+  (reference: .../mf/offline/MatrixFactorization.scala:133-192,239-274)
+
+Packages:
+    core      engine-agnostic math contract (types, initializers, updaters,
+              synthetic generators, throughput limiter)
+    ops       jitted numeric kernels (SGD stratum sweep, ALS normal equations,
+              pallas kernels)
+    models    user-facing solvers/drivers (DSGD, ALS, online MF, combined,
+              PS-mode)
+    parallel  device-mesh utilities, shard_map DSGD, collectives
+    data      host-side blocking/ingest (COO strata, micro-batch streams,
+              dataset loaders)
+    utils     config, checkpointing, metrics, logging
+"""
+
+__version__ = "0.1.0"
+
+from large_scale_recommendation_tpu.core.types import Ratings, FactorVector
+from large_scale_recommendation_tpu.core.initializers import (
+    RandomFactorInitializer,
+    PseudoRandomFactorInitializer,
+)
+from large_scale_recommendation_tpu.core.updaters import (
+    SGDUpdater,
+    RegularizedSGDUpdater,
+    MockFactorUpdater,
+)

@@ -1,0 +1,35 @@
+"""Engine-agnostic math contract.
+
+TPU-native rebuild of the reference ``core`` module
+(core/src/main/scala/hu/sztaki/ilab/recom/core/): the seam every solver is
+written against. Initializers and updaters are pure, batched functions so a
+jitted kernel can replace the reference's per-element inner loop while the
+ingest/orchestration shells stay thin.
+"""
+
+from large_scale_recommendation_tpu.core.types import (
+    Ratings,
+    FactorVector,
+    UserUpdate,
+    ItemUpdate,
+)
+from large_scale_recommendation_tpu.core.initializers import (
+    FactorInitializer,
+    RandomFactorInitializer,
+    PseudoRandomFactorInitializer,
+)
+from large_scale_recommendation_tpu.core.updaters import (
+    FactorUpdater,
+    SGDUpdater,
+    RegularizedSGDUpdater,
+    MockFactorUpdater,
+    LearningRateSchedule,
+    constant_lr,
+    inverse_sqrt_lr,
+)
+from large_scale_recommendation_tpu.core.generators import (
+    UniformRatingGenerator,
+    ExponentialRatingGenerator,
+    DiscreteExponentialGenerator,
+)
+from large_scale_recommendation_tpu.core.limiter import ThroughputLimiter

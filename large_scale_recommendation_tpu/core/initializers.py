@@ -1,0 +1,134 @@
+"""Factor initializers.
+
+TPU-native rebuild of the reference's initializer seam
+(reference: core/.../FactorInitializer.scala:5-50). The reference exposes a
+per-id ``nextFactor(id): Array[Double]`` plus a serializable
+``FactorInitializerDescriptor.open()`` factory (the descriptor/open split
+exists so closures ship to workers and the RNG is created on the worker).
+
+Here initializers are pure, batched functions ``ids -> [n, rank] array`` that
+run jitted on device. The descriptor/open split is unnecessary in a
+functional world — the initializer object itself is a small serializable
+dataclass — but ``.open()`` is kept as an alias for API parity.
+
+Two semantics match the reference exactly:
+
+- ``RandomFactorInitializer``: fresh uniform[0,1) draws from a stream RNG
+  (reference: FactorInitializer.scala:23-28 — ``random.nextDouble`` per slot).
+  In JAX the "stream" is a PRNG key; different tables / different calls use
+  different fold-in salts.
+- ``PseudoRandomFactorInitializer``: the row content is a deterministic pure
+  function of the id alone — reference seeds ``new Random(id)``
+  (FactorInitializer.scala:30-36). Here: ``jax.random.fold_in(base_key, id)``
+  with a fixed base key, so the same id always maps to the same vector on any
+  worker/device — the property the reference's examples rely on for
+  reproducibility (SparkExample.scala:32).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Protocol
+
+import jax
+import jax.numpy as jnp
+
+
+class FactorInitializer(Protocol):
+    """Batched initializer: int32[n] ids -> float32[n, rank] factors.
+
+    ≙ ``FactorInitializer.nextFactor(id)`` (FactorInitializer.scala:5-7),
+    vectorized.
+    """
+
+    rank: int
+
+    def __call__(self, ids: jax.Array) -> jax.Array: ...
+
+
+@dataclasses.dataclass(frozen=True)
+class RandomFactorInitializer:
+    """Uniform[0,1) factors from a keyed stream.
+
+    ≙ ``RandomFactorInitializer`` (FactorInitializer.scala:23-28). ``scale``
+    defaults to 1.0 for reference parity (nextDouble ∈ [0,1)); MF practice
+    often wants smaller inits — pass e.g. ``scale=1/sqrt(rank)``.
+
+    ``salt`` distinguishes independent streams (e.g. the user table vs the
+    item table) the way two ``Random`` instances would.
+    """
+
+    rank: int
+    seed: int = 0
+    scale: float = 1.0
+    salt: int = 0
+
+    def __call__(self, ids: jax.Array) -> jax.Array:
+        ids = jnp.asarray(ids, dtype=jnp.int32)
+        key = jax.random.fold_in(jax.random.PRNGKey(self.seed), self.salt)
+        # Draw per-id keys from the stream key *and* the position so repeated
+        # ids in one call still get independent draws (stream semantics).
+        keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+            key, jnp.arange(ids.shape[0], dtype=jnp.int32)
+        )
+        draw = lambda k: jax.random.uniform(k, (self.rank,), dtype=jnp.float32)
+        return self.scale * jax.vmap(draw)(keys)
+
+    def open(self) -> "RandomFactorInitializer":
+        """API-parity alias for ``FactorInitializerDescriptor.open()``
+        (FactorInitializer.scala:13-21)."""
+        return self
+
+
+@dataclasses.dataclass(frozen=True)
+class PseudoRandomFactorInitializer:
+    """Deterministic per-id factors: row = f(id) only.
+
+    ≙ ``PseudoRandomFactorInitializer`` (FactorInitializer.scala:30-36,
+    seed = id). The same id yields the same vector on every device, every
+    call — the reproducibility hook the reference examples use
+    (SparkExample.scala:32).
+    """
+
+    rank: int
+    scale: float = 1.0
+
+    def __call__(self, ids: jax.Array) -> jax.Array:
+        ids = jnp.asarray(ids, dtype=jnp.int32)
+        base = jax.random.PRNGKey(0)
+        keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(base, ids)
+        draw = lambda k: jax.random.uniform(k, (self.rank,), dtype=jnp.float32)
+        return self.scale * jax.vmap(draw)(keys)
+
+    def open(self) -> "PseudoRandomFactorInitializer":
+        return self
+
+
+@dataclasses.dataclass(frozen=True)
+class FunctionFactorInitializer:
+    """Wrap an arbitrary ``ids -> [n, rank]`` function.
+
+    ≙ ``FactorInitializerDescriptor.apply(init: Int => Array[Double])``
+    (FactorInitializer.scala:13-21).
+    """
+
+    rank: int
+    fn: Callable[[jax.Array], jax.Array]
+
+    def __call__(self, ids: jax.Array) -> jax.Array:
+        return self.fn(ids)
+
+    def open(self) -> "FunctionFactorInitializer":
+        return self
+
+
+def init_table(
+    initializer: FactorInitializer, num_rows: int, rank: int | None = None
+) -> jax.Array:
+    """Materialize a full factor table for ids [0, num_rows).
+
+    ≙ ``randomFactors`` building the initial factor DataSet
+    (MatrixFactorization.scala:278-280).
+    """
+    del rank
+    return initializer(jnp.arange(num_rows, dtype=jnp.int32))

@@ -1,0 +1,17 @@
+"""Test environment: force an 8-device virtual CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; every sharding/collective
+code path is exercised on XLA's host-platform virtual devices instead
+(SURVEY §4: multi-device tests via xla_force_host_platform_device_count).
+This must run before anything imports jax.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
