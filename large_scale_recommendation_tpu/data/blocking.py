@@ -1,0 +1,251 @@
+"""Host-side DSGD blocking: id compaction, block assignment, stratum layout.
+
+TPU-native rebuild of the reference's blocking stage
+(reference: DSGDforMF.scala:513-588 ``initFactorBlockAndIndices``,
+:301-333 rating-block construction, :245-255 ``unblock``,
+:597-601 ``toRatingBlockId``; Spark variant OfflineSpark.scala:135-161).
+
+The reference builds blocks as distributed datasets each superstep; here the
+whole blocking is a one-time host-side preprocessing pass producing dense,
+statically-shaped arrays that live on device for the entire training run:
+
+- ids are compacted to dense rows, rows are dealt into ``num_blocks``
+  equal-size blocks (block b owns the contiguous row range
+  ``[b*rows_per_block, (b+1)*rows_per_block)``) so a factor table shards
+  evenly over a device mesh;
+- ratings are bucketed into the ``num_blocks × num_blocks`` grid
+  (≙ ``toRatingBlockId = userBlock*k + itemBlock``, DSGDforMF.scala:597-601)
+  and laid out **stratum-major**: stratum step ``s`` covers the k disjoint
+  blocks ``{(p, (p+s) mod k)}`` — exactly the reference's diagonal-start
+  rotation schedule (initial block ``b*(k+1)`` at DSGDforMF.scala:562;
+  rotation ``nextRatingBlock`` at :611-619: the user block walks one column
+  right each step while items walk rows — equivalently, after s steps user
+  block p meets item block (p+s) mod k);
+- per-id occurrence counts (omegas, DSGDforMF.scala:537-541) are dense
+  per-row arrays for the λ/ω regularizer;
+- every block is padded to the same nnz with weight-0 entries so shapes are
+  static (the price of XLA's static-shape model; SURVEY §7 hard part (e) —
+  the ``max_pad_ratio`` statistic reports the waste).
+
+Design departures from the reference (deliberate, documented):
+- The reference assigns each id to a **random** block
+  (DSGDforMF.scala:522-535), giving unbalanced blocks. Here rows are randomly
+  *permuted* then dealt round-robin, preserving randomness while making block
+  sizes equal (±0) — required for even mesh sharding, and strictly better
+  load balance.
+- Blocking is exact bucketing, not an engine shuffle; "unblocking"
+  (DSGDforMF.scala:245-255) reduces to an index lookup table (row → id).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from large_scale_recommendation_tpu.core.types import Ratings
+
+
+@dataclasses.dataclass(frozen=True)
+class IdIndex:
+    """Dense row layout for one factor matrix (user or item side).
+
+    ≙ the (id → (idxInBlock, blockId)) map + ``UnblockInformation`` of
+    DSGDforMF.scala:571-587, collapsed into flat arrays: global row
+    ``b * rows_per_block + j``.
+    """
+
+    ids: np.ndarray  # int64[num_rows_padded]; -1 marks padding rows
+    row_of: dict  # id -> global row
+    num_blocks: int
+    rows_per_block: int
+    omega: np.ndarray  # float32[num_rows_padded] occurrence counts (0 on padding)
+    sorted_ids: np.ndarray  # int64[n_real] — for vectorized lookup
+    sorted_rows: np.ndarray  # int64[n_real] rows aligned with sorted_ids
+
+    @property
+    def num_rows(self) -> int:
+        return self.ids.shape[0]
+
+    def rows_for(self, ids: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Map external ids to rows; unknown ids get row 0 with mask 0.
+
+        Vectorized binary search (no Python loop — predict/eval call this on
+        up-to-ML-25M-sized arrays)."""
+        ids = np.asarray(ids, dtype=np.int64)
+        if self.sorted_ids.size == 0:
+            return np.zeros(len(ids), np.int64), np.zeros(len(ids), np.float32)
+        pos = np.searchsorted(self.sorted_ids, ids)
+        pos = np.clip(pos, 0, self.sorted_ids.size - 1)
+        found = self.sorted_ids[pos] == ids
+        rows = np.where(found, self.sorted_rows[pos], 0)
+        return rows, found.astype(np.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockedRatings:
+    """Stratum-major blocked ratings, ready for device placement.
+
+    ``u_rows/i_rows/values/weights`` have shape
+    ``[num_blocks (stratum step s), num_blocks (user block p), block_nnz]``:
+    entry ``[s, p, :]`` is the rating block (p, (p+s) mod k) — the block the
+    reference schedule visits at superstep offset s
+    (DSGDforMF.scala:562,611-619). ``block_nnz`` is padded to a multiple of
+    the kernel minibatch.
+    """
+
+    u_rows: np.ndarray  # int32[k, k, bmax] global user rows
+    i_rows: np.ndarray  # int32[k, k, bmax] global item rows
+    values: np.ndarray  # float32[k, k, bmax]
+    weights: np.ndarray  # float32[k, k, bmax] 1=real 0=pad
+    num_blocks: int
+    nnz: int  # real rating count
+    max_pad_ratio: float  # padded size / real size (load-balance statistic)
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockedProblem:
+    users: IdIndex
+    items: IdIndex
+    ratings: BlockedRatings
+
+
+def build_id_index(
+    ids: np.ndarray,
+    num_blocks: int,
+    seed: int | None,
+    row_multiple: int = 8,
+) -> IdIndex:
+    """Compact ids to dense rows and deal rows into equal-size blocks.
+
+    ≙ initFactorBlockAndIndices (DSGDforMF.scala:513-588): distinct ids,
+    random block assignment (here: seeded shuffle + round-robin deal for
+    balance), omega counts. ``row_multiple`` pads rows_per_block up for
+    TPU-friendly shard shapes.
+    """
+    ids = np.asarray(ids)
+    uniq, counts = np.unique(ids, return_counts=True)
+    n = len(uniq)
+    rng = np.random.default_rng(seed if seed is not None else None)
+    perm = rng.permutation(n)
+
+    rows_per_block = max(-(-n // num_blocks), 1)  # ceil, ≥1
+    rows_per_block = -(-rows_per_block // row_multiple) * row_multiple
+    total = rows_per_block * num_blocks
+
+    out_ids = np.full(total, -1, dtype=np.int64)
+    omega = np.zeros(total, dtype=np.float32)
+    # Deal shuffled ids round-robin (vectorized): the k-th shuffled id goes
+    # to block k mod B at in-block offset k div B, i.e. global row
+    # (k mod B)·rows_per_block + k div B.
+    k_idx = np.arange(n)
+    rows = (k_idx % num_blocks) * rows_per_block + k_idx // num_blocks
+    shuffled_ids = uniq[perm].astype(np.int64)
+    out_ids[rows] = shuffled_ids
+    omega[rows] = counts[perm]
+    order = np.argsort(shuffled_ids)
+    return IdIndex(
+        ids=out_ids,
+        row_of=dict(zip(shuffled_ids.tolist(), rows.tolist())),
+        num_blocks=num_blocks,
+        rows_per_block=rows_per_block,
+        omega=omega,
+        sorted_ids=shuffled_ids[order],
+        sorted_rows=rows[order],
+    )
+
+
+def block_ratings(
+    ratings: Ratings | tuple,
+    users: IdIndex,
+    items: IdIndex,
+    minibatch_multiple: int = 1,
+) -> BlockedRatings:
+    """Bucket ratings into the k×k grid in stratum-major layout.
+
+    ≙ rating-block construction (DSGDforMF.scala:301-333): join ratings with
+    block indices, group by ``ratingBlockId = uBlk*k + iBlk``. Blocks are
+    sorted by (user row, item row) for determinism (the reference sorts iff a
+    seed is set, DSGDforMF.scala:316-327 — we are always deterministic).
+    """
+    if isinstance(ratings, Ratings):
+        ru, ri, rv, rw = ratings.to_numpy()
+        # Weight-0 entries are padding (types.Ratings contract) — they must
+        # not train, register ids, or count toward omegas.
+        real = rw > 0
+        if not real.all():
+            ru, ri, rv = ru[real], ri[real], rv[real]
+    else:
+        ru, ri, rv = ratings[:3]
+    k = users.num_blocks
+    assert items.num_blocks == k, "user and item block counts must match"
+
+    urow, umask = users.rows_for(ru)
+    irow, imask = items.rows_for(ri)
+    if not (umask.all() and imask.all()):
+        raise ValueError("block_ratings: ratings contain ids absent from the "
+                         "id indices")
+    ublk = urow // users.rows_per_block
+    iblk = irow // items.rows_per_block
+    # stratum step s at which block (p, q) is visited: q = (p+s) mod k
+    strat = (iblk - ublk) % k
+
+    # Sort by (stratum, user block, user row, item row): blocks become
+    # contiguous runs, deterministic order inside each block.
+    order = np.lexsort((irow, urow, ublk, strat))
+    urow, irow = urow[order], irow[order]
+    vals = np.asarray(rv, dtype=np.float32)[order]
+    strat_s, ublk_s = strat[order], ublk[order]
+
+    # Per-(s, p) block sizes → padded bmax.
+    flat = strat_s * k + ublk_s
+    sizes = np.bincount(flat, minlength=k * k)
+    bmax = int(sizes.max()) if len(sizes) else 0
+    bmax = max(bmax, 1)
+    bmax = -(-bmax // minibatch_multiple) * minibatch_multiple
+
+    u_out = np.zeros((k, k, bmax), dtype=np.int32)
+    i_out = np.zeros((k, k, bmax), dtype=np.int32)
+    v_out = np.zeros((k, k, bmax), dtype=np.float32)
+    w_out = np.zeros((k, k, bmax), dtype=np.float32)
+    starts = np.concatenate([[0], np.cumsum(sizes)])
+    for s in range(k):
+        for p in range(k):
+            a, b = starts[s * k + p], starts[s * k + p + 1]
+            m = b - a
+            u_out[s, p, :m] = urow[a:b]
+            i_out[s, p, :m] = irow[a:b]
+            v_out[s, p, :m] = vals[a:b]
+            w_out[s, p, :m] = 1.0
+    nnz = len(urow)
+    return BlockedRatings(
+        u_rows=u_out,
+        i_rows=i_out,
+        values=v_out,
+        weights=w_out,
+        num_blocks=k,
+        nnz=nnz,
+        max_pad_ratio=(k * k * bmax) / max(nnz, 1),
+    )
+
+
+def block_problem(
+    ratings: Ratings,
+    num_blocks: int,
+    seed: int | None = 0,
+    minibatch_multiple: int = 1,
+    row_multiple: int = 8,
+) -> BlockedProblem:
+    """Full blocking pass: both id indices + stratum-major rating blocks.
+
+    Weight-0 (padding) entries are excluded everywhere: they neither register
+    ids nor contribute to omegas nor train."""
+    ru, ri, _, rw = ratings.to_numpy()
+    real = rw > 0
+    ru, ri = ru[real], ri[real]
+    users = build_id_index(ru, num_blocks, seed, row_multiple)
+    items = build_id_index(
+        ri, num_blocks, None if seed is None else seed + 1, row_multiple
+    )
+    blocked = block_ratings(ratings, users, items, minibatch_multiple)
+    return BlockedProblem(users=users, items=items, ratings=blocked)

@@ -1,0 +1,155 @@
+"""DSGD: Gemulla-style stratified SGD matrix factorization (batch solver).
+
+TPU-native rebuild of the reference's two DSGD implementations:
+- Flink DataSet bulk-iteration DSGD (DSGDforMF.scala:130-620, FlinkML
+  ``Predictor`` with fit/predict)
+- Spark zipPartitions DSGD (OfflineSpark.scala:69-207)
+
+Architecture: blocking is a one-time host pass (``data.blocking``), the whole
+``iterations × k`` superstep loop is ONE jitted XLA computation
+(``ops.sgd.dsgd_train``) — no per-superstep network shuffle, no host
+round-trips. On a device mesh the same schedule runs with U/V sharded and
+``lax.ppermute`` rotating item shards (``parallel.dsgd_mesh``).
+
+Config parity (reference defaults in FlinkML parameter objects,
+MatrixFactorization.scala:201-211, DSGDforMF.scala:161-169):
+num_factors=10, lambda=1.0, iterations=10, blocks=None→auto,
+learning_rate=0.001, η/√t decay (DSGDforMF.scala:118).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from large_scale_recommendation_tpu.core.initializers import (
+    PseudoRandomFactorInitializer,
+    RandomFactorInitializer,
+)
+from large_scale_recommendation_tpu.core.updaters import (
+    RegularizedSGDUpdater,
+    constant_lr,
+    inverse_sqrt_lr,
+)
+from large_scale_recommendation_tpu.core.types import Ratings
+from large_scale_recommendation_tpu.data import blocking
+from large_scale_recommendation_tpu.models.mf import MFModel
+from large_scale_recommendation_tpu.ops import sgd as sgd_ops
+
+
+@dataclasses.dataclass(frozen=True)
+class DSGDConfig:
+    """≙ the FlinkML parameter registry (MatrixFactorization.scala:195-223,
+    DSGDforMF.scala:135-169) as one dataclass (SURVEY §5 config layer)."""
+
+    num_factors: int = 10
+    lambda_: float = 1.0
+    iterations: int = 10
+    num_blocks: int | None = None  # None → auto (devices or 1; ≙ Blocks None→1)
+    learning_rate: float = 0.001
+    lr_schedule: str = "inverse_sqrt"  # "inverse_sqrt" (ref default) | "constant"
+    seed: int | None = 0
+    minibatch_size: int = 1024
+    init_scale: float = 1.0  # factor init upper bound (nextDouble ∈ [0,1))
+
+    def schedule_fn(self):
+        return inverse_sqrt_lr if self.lr_schedule == "inverse_sqrt" else constant_lr
+
+
+class DSGD:
+    """Batch DSGD solver. ≙ ``DSGDforMF().setIterations(..).fit(ds)``
+    (DSGDforMF.scala:70-85 scaladoc usage)."""
+
+    def __init__(self, config: DSGDConfig | None = None, updater: Any = None):
+        self.config = config or DSGDConfig()
+        # Pluggable updater — the reference seam (FactorUpdater.scala): any
+        # core.updaters implementation may be injected; default is the DSGD
+        # λ/ω-regularized rule (DSGDforMF.scala:405-413).
+        self.updater = updater or RegularizedSGDUpdater(
+            learning_rate=self.config.learning_rate,
+            lambda_=self.config.lambda_,
+            schedule=self.config.schedule_fn(),
+        )
+        self.model: MFModel | None = None
+
+    # -- fit ---------------------------------------------------------------
+
+    def fit(self, ratings: Ratings, num_blocks: int | None = None) -> MFModel:
+        cfg = self.config
+        if ratings.n == 0:
+            raise ValueError("cannot fit on an empty ratings set")
+        k = num_blocks or cfg.num_blocks or 1
+
+        # Pad each block to the minibatch so chunk boundaries align with
+        # block boundaries — this makes the single-device sweep numerically
+        # identical to the mesh sweep (blocks in a stratum are row-disjoint,
+        # so processing them sequentially here vs in parallel on the mesh is
+        # the same math).
+        problem = blocking.block_problem(
+            ratings,
+            num_blocks=k,
+            seed=cfg.seed,
+            minibatch_multiple=cfg.minibatch_size,
+        )
+        U, V = self._init_factors(problem)
+
+        # Module-level jitted train fn: stable function object + hashable
+        # static args (frozen-dataclass updater) → refits with the same
+        # shapes/config hit the XLA compile cache.
+        U, V = sgd_ops.dsgd_train(
+            U, V,
+            jnp.asarray(problem.ratings.u_rows, jnp.int32),
+            jnp.asarray(problem.ratings.i_rows, jnp.int32),
+            jnp.asarray(problem.ratings.values, jnp.float32),
+            jnp.asarray(problem.ratings.weights, jnp.float32),
+            jnp.asarray(problem.users.omega),
+            jnp.asarray(problem.items.omega),
+            updater=self.updater,
+            minibatch=cfg.minibatch_size,
+            num_blocks=k,
+            iterations=cfg.iterations,
+        )
+        self.model = MFModel(U=U, V=V, users=problem.users, items=problem.items)
+        return self.model
+
+    def _init_factors(self, problem: blocking.BlockedProblem):
+        cfg = self.config
+        if cfg.seed is not None:
+            # Deterministic per-id init ≙ seeded Random(id ^ seed) factors
+            # (DSGDforMF.scala:543-551) — row content is a function of id.
+            init_u = PseudoRandomFactorInitializer(cfg.num_factors,
+                                                   scale=cfg.init_scale)
+            init_v = PseudoRandomFactorInitializer(cfg.num_factors,
+                                                   scale=cfg.init_scale)
+        else:
+            init_u = RandomFactorInitializer(cfg.num_factors, seed=0, salt=0,
+                                             scale=cfg.init_scale)
+            init_v = RandomFactorInitializer(cfg.num_factors, seed=0, salt=1,
+                                             scale=cfg.init_scale)
+        U = init_u(jnp.asarray(np.maximum(problem.users.ids, 0)))
+        V = init_v(jnp.asarray(np.maximum(problem.items.ids, 0)))
+        return U, V
+
+    # -- scoring passthroughs (Predictor-style surface,
+    #    MatrixFactorization.scala:239-274,133-192) ------------------------
+
+    def predict(self, user_ids, item_ids):
+        self._require_fitted()
+        return self.model.predict(user_ids, item_ids)
+
+    def empirical_risk(self, data: Ratings) -> float:
+        self._require_fitted()
+        return self.model.empirical_risk(data, lambda_=self.config.lambda_)
+
+    def _require_fitted(self):
+        if self.model is None:
+            # ≙ "The ALS model has not been fitted to data..." guard
+            # (MatrixFactorization.scala:270-272)
+            raise RuntimeError(
+                "model has not been fitted; call fit() before predicting"
+            )
+
+

@@ -1,0 +1,105 @@
+"""The matrix-factorization model object: factors + scoring + risk.
+
+TPU-native rebuild of the reference's model surface
+(reference: MatrixFactorization.scala — ``factorsOption`` pair of factor
+DataSets, join-based ``predict`` :239-274, ``empiricalRisk`` :133-192,
+``Factors(id, factors)`` :232). Factors live as dense device tables; external
+ids map to rows through host-side ``IdIndex`` lookup tables (the "unblock"
+information, DSGDforMF.scala:245-255,571-587).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from large_scale_recommendation_tpu.core.types import FactorVector, Ratings
+from large_scale_recommendation_tpu.data.blocking import IdIndex
+from large_scale_recommendation_tpu.ops import sgd as sgd_ops
+
+
+@dataclasses.dataclass
+class MFModel:
+    """A trained (or in-training) factorization: U, V on device + id maps.
+
+    ≙ ``instance.factorsOption = Some((users, items))``
+    (DSGDforMF.scala:355).
+    """
+
+    U: jax.Array  # float32[num_user_rows, rank]
+    V: jax.Array  # float32[num_item_rows, rank]
+    users: IdIndex
+    items: IdIndex
+
+    @property
+    def rank(self) -> int:
+        return int(self.U.shape[-1])
+
+    # -- scoring ------------------------------------------------------------
+
+    def predict(self, user_ids: np.ndarray, item_ids: np.ndarray) -> np.ndarray:
+        """Score (user, item) pairs. Pairs whose user OR item was never seen
+        score 0.0 — the reference's join simply drops them
+        (MatrixFactorization.scala:250-265); a dense API needs a value, and 0
+        is the "no information" score.
+        """
+        u_rows, u_mask = self.users.rows_for(np.asarray(user_ids))
+        i_rows, i_mask = self.items.rows_for(np.asarray(item_ids))
+        scores = sgd_ops.predict_rows(
+            self.U, self.V, jnp.asarray(u_rows), jnp.asarray(i_rows)
+        )
+        return np.asarray(scores) * u_mask * i_mask
+
+    def empirical_risk(self, data: Ratings, lambda_: float = 1.0) -> float:
+        """Σ residual² + λ(‖u‖²+‖v‖²) over labeled points
+        (≙ MatrixFactorization.scala:133-192). Unseen pairs are dropped,
+        like the reference's inner join."""
+        ru, ri, rv, rw = data.to_numpy()
+        u_rows, u_mask = self.users.rows_for(ru)
+        i_rows, i_mask = self.items.rows_for(ri)
+        mask = u_mask * i_mask * rw
+        return float(
+            sgd_ops.empirical_risk_rows(
+                self.U, self.V,
+                jnp.asarray(u_rows), jnp.asarray(i_rows),
+                jnp.asarray(rv), jnp.asarray(mask),
+                jnp.float32(lambda_),
+            )
+        )
+
+    def rmse(self, data: Ratings) -> float:
+        """Root-mean-square error over labeled points (the benchmark metric;
+        the reference only ships empiricalRisk — RMSE is its λ=0 mean-root
+        form)."""
+        ru, ri, rv, rw = data.to_numpy()
+        u_rows, u_mask = self.users.rows_for(ru)
+        i_rows, i_mask = self.items.rows_for(ri)
+        mask = u_mask * i_mask * rw
+        n = mask.sum()
+        if n == 0:
+            return float("nan")
+        sse = sgd_ops.sse_rows(
+            self.U, self.V,
+            jnp.asarray(u_rows), jnp.asarray(i_rows),
+            jnp.asarray(rv), jnp.asarray(mask),
+        )
+        return float(np.sqrt(float(sse) / n))
+
+    # -- export -------------------------------------------------------------
+
+    def user_factors(self) -> Iterator[FactorVector]:
+        """≙ unblocked DataSet[Factors] (DSGDforMF.scala:245-255)."""
+        U = np.asarray(self.U)
+        for row, ident in enumerate(self.users.ids):
+            if ident >= 0:
+                yield FactorVector(int(ident), U[row])
+
+    def item_factors(self) -> Iterator[FactorVector]:
+        V = np.asarray(self.V)
+        for row, ident in enumerate(self.items.ids):
+            if ident >= 0:
+                yield FactorVector(int(ident), V[row])

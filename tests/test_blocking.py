@@ -1,0 +1,129 @@
+"""Blocking-layer tests: id compaction, balance, stratum coverage.
+
+Property (SURVEY §4): every (p, q) block is visited exactly once per sweep —
+the stratum-major layout must cover the full k×k grid with the diagonal
+rotation schedule (≙ nextRatingBlock semantics, DSGDforMF.scala:611-619).
+"""
+
+import numpy as np
+
+from large_scale_recommendation_tpu.core.types import Ratings
+from large_scale_recommendation_tpu.data import blocking
+
+
+def _toy_ratings(n=500, nu=60, ni=40, seed=0):
+    rng = np.random.default_rng(seed)
+    return Ratings.from_arrays(
+        rng.integers(0, nu, n), rng.integers(100, 100 + ni, n),
+        rng.normal(size=n).astype(np.float32),
+    )
+
+
+class TestIdIndex:
+    def test_blocks_balanced_and_rows_consistent(self):
+        ids = np.random.default_rng(1).integers(0, 1000, 5000)
+        idx = blocking.build_id_index(ids, num_blocks=4, seed=0)
+        # every unique id mapped exactly once, row round-trips
+        uniq = np.unique(ids)
+        assert len(idx.row_of) == len(uniq)
+        for ident in uniq[:50]:
+            assert idx.ids[idx.row_of[int(ident)]] == ident
+        # equal block capacity by construction
+        assert idx.num_rows == idx.num_blocks * idx.rows_per_block
+        # real ids dealt round-robin → per-block counts differ by ≤ 1
+        real_per_block = [
+            (idx.ids[b * idx.rows_per_block:(b + 1) * idx.rows_per_block] >= 0).sum()
+            for b in range(4)
+        ]
+        assert max(real_per_block) - min(real_per_block) <= 1
+
+    def test_omega_counts(self):
+        """≙ omega = occurrences per id (DSGDforMF.scala:537-541)."""
+        ids = np.array([7, 7, 7, 3, 3, 9])
+        idx = blocking.build_id_index(ids, num_blocks=2, seed=0)
+        assert idx.omega[idx.row_of[7]] == 3
+        assert idx.omega[idx.row_of[3]] == 2
+        assert idx.omega[idx.row_of[9]] == 1
+
+    def test_rows_for_unknown_masked(self):
+        idx = blocking.build_id_index(np.array([1, 2, 3]), 1, seed=0)
+        rows, mask = idx.rows_for(np.array([2, 999]))
+        assert mask.tolist() == [1.0, 0.0]
+        assert idx.ids[rows[0]] == 2
+
+    def test_seed_determinism(self):
+        ids = np.random.default_rng(2).integers(0, 500, 2000)
+        a = blocking.build_id_index(ids, 4, seed=7)
+        b = blocking.build_id_index(ids, 4, seed=7)
+        np.testing.assert_array_equal(a.ids, b.ids)
+
+
+class TestBlockRatings:
+    def test_stratum_coverage_and_content(self):
+        """Every rating lands in exactly one (s, p) cell, with
+        s = (iblk − ublk) mod k — one visit per sweep per block."""
+        r = _toy_ratings()
+        k = 4
+        prob = blocking.block_problem(r, num_blocks=k, seed=0)
+        br = prob.ratings
+        assert br.u_rows.shape == (k, k, br.u_rows.shape[-1])
+        # total real entries == input nnz
+        assert int(br.weights.sum()) == r.n == br.nnz
+        # block membership honored: in cell (s, p) all user rows belong to
+        # user block p and all item rows to item block (p+s) mod k
+        for s in range(k):
+            for p in range(k):
+                w = br.weights[s, p].astype(bool)
+                if not w.any():
+                    continue
+                ub = br.u_rows[s, p][w] // prob.users.rows_per_block
+                ib = br.i_rows[s, p][w] // prob.items.rows_per_block
+                assert (ub == p).all()
+                assert (ib == (p + s) % k).all()
+
+    def test_every_rating_preserved(self):
+        r = _toy_ratings(n=200)
+        prob = blocking.block_problem(r, num_blocks=3, seed=1)
+        br = prob.ratings
+        got = []
+        for s in range(3):
+            for p in range(3):
+                w = br.weights[s, p].astype(bool)
+                for ur, ir, v in zip(br.u_rows[s, p][w], br.i_rows[s, p][w],
+                                     br.values[s, p][w]):
+                    got.append((prob.users.ids[ur], prob.items.ids[ir],
+                                round(float(v), 5)))
+        ru, ri, rv, _ = r.to_numpy()
+        want = sorted((int(a), int(b), round(float(c), 5))
+                      for a, b, c in zip(ru, ri, rv))
+        assert sorted(got) == want
+
+    def test_minibatch_multiple_padding(self):
+        r = _toy_ratings(n=100)
+        prob = blocking.block_problem(r, num_blocks=2, seed=0,
+                                      minibatch_multiple=64)
+        assert prob.ratings.u_rows.shape[-1] % 64 == 0
+
+
+class TestPaddingExclusion:
+    def test_weight_zero_entries_do_not_train_or_register(self):
+        """Regression: padded Ratings (weight 0) must not create phantom ids,
+        omegas, or training entries."""
+        r = Ratings.from_arrays([5, 6, 7], [8, 9, 10], [1.0, 2.0, 3.0]).pad_to(16)
+        prob = blocking.block_problem(r, num_blocks=2, seed=0)
+        # only the 3 real ids registered
+        assert len(prob.users.row_of) == 3
+        assert len(prob.items.row_of) == 3
+        assert int(prob.ratings.weights.sum()) == 3
+        # id 0 (the padding placeholder) was never registered
+        assert 0 not in prob.users.row_of
+        # omegas reflect only real occurrences
+        assert prob.users.omega.sum() == 3
+
+    def test_rows_for_vectorized_large(self):
+        ids = np.arange(0, 100000, 3)
+        idx = blocking.build_id_index(ids, 4, seed=0)
+        q = np.array([0, 3, 4, 99998, 99996])
+        rows, mask = idx.rows_for(q)
+        assert mask.tolist() == [1.0, 1.0, 0.0, 0.0, 1.0]
+        assert idx.ids[rows[4]] == 99996
