@@ -1,0 +1,109 @@
+"""Benchmark: DSGD training throughput on one chip.
+
+Metric: ratings/sec/chip on a synthetic ML-25M-shaped DSGD workload
+(BASELINE.md north star: ratings/sec/chip; the reference publishes no
+numbers, so the baseline is the reference's own inner-loop style — a
+sequential per-rating NumPy SGD loop, the direct analogue of
+DSGDforMF.scala:398-417 / netlib ddot — measured here on the same host).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Env knobs: BENCH_NNZ, BENCH_RANK, BENCH_ITERS, BENCH_USERS, BENCH_ITEMS,
+BENCH_MB (minibatch), BENCH_BLOCKS.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def _numpy_sequential_baseline(ratings, rank, sample=150_000, lr=0.01,
+                               lam=0.1, seed=0):
+    """Reference-style sequential per-rating SGD (the Flink/Spark inner loop,
+    DSGDforMF.scala:398-417) in NumPy — ratings/sec on host CPU."""
+    ru, ri, rv, _ = ratings.to_numpy()
+    n = min(sample, len(ru))
+    rng = np.random.default_rng(seed)
+    nu, ni = int(ru.max()) + 1, int(ri.max()) + 1
+    U = rng.normal(0, 0.1, (nu, rank))
+    V = rng.normal(0, 0.1, (ni, rank))
+    t0 = time.perf_counter()
+    for j in range(n):
+        u, i, r = ru[j], ri[j], rv[j]
+        pu, qv = U[u], V[i]
+        e = r - pu @ qv
+        U[u] = pu - lr * (lam * pu - e * qv)
+        V[i] = qv - lr * (lam * qv - e * pu)
+    dt = time.perf_counter() - t0
+    return n / dt
+
+
+def main():
+    nnz = int(os.environ.get("BENCH_NNZ", 2_000_000))
+    rank = int(os.environ.get("BENCH_RANK", 64))
+    iters = int(os.environ.get("BENCH_ITERS", 5))
+    num_users = int(os.environ.get("BENCH_USERS", 100_000))
+    num_items = int(os.environ.get("BENCH_ITEMS", 20_000))
+    mb = int(os.environ.get("BENCH_MB", 8192))
+    blocks = int(os.environ.get("BENCH_BLOCKS", 4))
+
+    import jax
+
+    from large_scale_recommendation_tpu.core.generators import (
+        SyntheticMFGenerator,
+    )
+    from large_scale_recommendation_tpu.models.dsgd import DSGD, DSGDConfig
+
+    gen = SyntheticMFGenerator(num_users=num_users, num_items=num_items,
+                               rank=min(rank, 32), noise=0.1, seed=0)
+    ratings = gen.generate(nnz)
+
+    cfg = DSGDConfig(
+        num_factors=rank, lambda_=0.05, iterations=iters,
+        learning_rate=0.05, lr_schedule="constant", seed=0,
+        minibatch_size=mb, init_scale=0.1,
+    )
+
+    # Warm-up: compile (and one full run, first compile is slow).
+    warm_cfg = DSGDConfig(
+        num_factors=rank, lambda_=0.05, iterations=1,
+        learning_rate=0.05, lr_schedule="constant", seed=0,
+        minibatch_size=mb, init_scale=0.1,
+    )
+    DSGD(warm_cfg).fit(ratings, num_blocks=blocks).U.block_until_ready()
+
+    solver = DSGD(cfg)
+    t0 = time.perf_counter()
+    model = solver.fit(ratings, num_blocks=blocks)
+    model.U.block_until_ready()
+    dt = time.perf_counter() - t0
+    # NOTE: dt includes the host blocking pass (fair: the reference's
+    # supersteps include their shuffles).
+    throughput = nnz * iters / dt
+
+    baseline = _numpy_sequential_baseline(ratings, rank)
+
+    rmse = model.rmse(gen.generate(100_000))
+    result = {
+        "metric": f"ratings/sec/chip (synthetic DSGD rank={rank}, "
+                  f"{nnz // 1_000_000}M ratings, {blocks}x{blocks} strata)",
+        "value": round(throughput, 1),
+        "unit": "ratings/s",
+        "vs_baseline": round(throughput / baseline, 2),
+    }
+    print(json.dumps(result))
+    # Extra context on stderr (not part of the one-line contract)
+    import sys
+    print(
+        f"# wall={dt:.2f}s iters={iters} rmse={rmse:.4f} "
+        f"numpy_baseline={baseline:.0f} r/s device={jax.devices()[0]}",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()
