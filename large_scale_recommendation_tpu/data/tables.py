@@ -1,0 +1,138 @@
+"""Growable device factor tables: dynamic vocabulary on static-shaped arrays.
+
+The reference grows its factor maps implicitly everywhere with
+``getOrElseUpdate(id, init)`` on JVM hash maps (reference:
+ps/server/SimplePSLogic.scala:14, PSOfflineMF.scala:155,257,
+FlinkOnlineMF.scala:92-93,129, OfflineSpark.scala:180-181). A device array
+cannot grow — SURVEY §7 hard part (a). The TPU-native equivalent is:
+
+- a dense ``float32[capacity, rank]`` device table,
+- a host-side id → row dict (the only dynamic structure),
+- geometric capacity doubling, so a stream of n distinct ids causes only
+  O(log n) reallocations / recompilations of downstream jitted fns,
+- new rows initialized from the pluggable ``FactorInitializer`` **by id**
+  (so ``PseudoRandomFactorInitializer`` keeps its same-id-same-vector
+  property across tables, devices and restarts).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from large_scale_recommendation_tpu.core.initializers import FactorInitializer
+from large_scale_recommendation_tpu.core.types import FactorVector
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+class GrowableFactorTable:
+    """A factor matrix with ``getOrElseUpdate`` semantics on device.
+
+    ≙ the PS server's ``HashMap[Int, P]`` shard with pull-side init
+    (SimplePSLogic.scala:13-18) and the online operators' state maps
+    (FlinkOnlineMF.scala:92-93,129).
+    """
+
+    def __init__(
+        self,
+        initializer: FactorInitializer,
+        capacity: int = 1024,
+        device_put=None,
+    ):
+        self.initializer = initializer
+        self.rank = initializer.rank
+        self._row_of: dict[int, int] = {}
+        self._ids: list[int] = []
+        self._device_put = device_put or (lambda x: x)
+        self.capacity = max(_next_pow2(capacity), 8)
+        self.array: jax.Array = self._device_put(
+            jnp.zeros((self.capacity, self.rank), jnp.float32)
+        )
+
+    # -- vocabulary --------------------------------------------------------
+
+    @property
+    def num_rows(self) -> int:
+        return len(self._ids)
+
+    def __contains__(self, ident: int) -> bool:
+        return int(ident) in self._row_of
+
+    def ensure(self, ids: np.ndarray) -> np.ndarray:
+        """Register any unseen ids (initializing their rows) and return the
+        row for every input id. ≙ ``getOrElseUpdate(id, init.nextFactor(id))``
+        (SimplePSLogic.scala:14), batched."""
+        ids = np.asarray(ids).astype(np.int64)
+        new_ids = []
+        row_of = self._row_of
+        next_row = len(self._ids)
+        for ident in ids.tolist():
+            if ident not in row_of:
+                row_of[ident] = next_row
+                new_ids.append(ident)
+                next_row += 1
+        if new_ids:
+            self._ids.extend(new_ids)
+            if next_row > self.capacity:
+                self._grow(next_row)
+            rows = jnp.asarray(
+                [row_of[i] for i in new_ids], dtype=jnp.int32
+            )
+            fresh = self.initializer(jnp.asarray(new_ids, dtype=jnp.int32))
+            self.array = self._device_put(self.array.at[rows].set(fresh))
+        return np.asarray([row_of[i] for i in ids.tolist()], dtype=np.int64)
+
+    def rows_for(self, ids: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """Look up rows WITHOUT registering; unknown ids → row 0, mask 0
+        (read-only form, for predict on a live model)."""
+        ids = np.asarray(ids).astype(np.int64)
+        rows = np.zeros(len(ids), dtype=np.int64)
+        mask = np.zeros(len(ids), dtype=np.float32)
+        row_of = self._row_of
+        for j, ident in enumerate(ids.tolist()):
+            r = row_of.get(ident)
+            if r is not None:
+                rows[j] = r
+                mask[j] = 1.0
+        return rows, mask
+
+    def _grow(self, need: int) -> None:
+        new_cap = _next_pow2(need)
+        pad = jnp.zeros((new_cap - self.capacity, self.rank), jnp.float32)
+        self.array = self._device_put(jnp.concatenate([self.array, pad]))
+        self.capacity = new_cap
+
+    # -- access ------------------------------------------------------------
+
+    def lookup(self, ids: np.ndarray) -> np.ndarray:
+        """Factor vectors for ids (must be registered)."""
+        rows = np.asarray([self._row_of[int(i)] for i in np.asarray(ids)])
+        return np.asarray(self.array[jnp.asarray(rows)])
+
+    def factor_vectors(self, ids=None):
+        """Iterate ``FactorVector`` updates for ``ids`` (default: all).
+
+        ≙ the updates-only output stream (``UpdateSeparatedHashMap.updates``,
+        OfflineSpark.scala:33-67) / PS output ``(id, newValue)``
+        (SimplePSLogic.scala:20-24)."""
+        if ids is None:
+            ids = self._ids
+        host = np.asarray(self.array)
+        for ident in ids:
+            yield FactorVector(int(ident), host[self._row_of[int(ident)]])
+
+    def as_dict(self) -> dict[int, np.ndarray]:
+        """Full model export as id → vector (host)."""
+        host = np.asarray(self.array)
+        return {i: host[r] for i, r in self._row_of.items()}
+
+    def ids(self) -> list[int]:
+        return list(self._ids)

@@ -34,8 +34,8 @@ def sgd_minibatch_update(
     i_rows: jax.Array,
     values: jax.Array,
     weights: jax.Array,
-    omega_u: jax.Array,
-    omega_v: jax.Array,
+    omega_u: jax.Array | None,
+    omega_v: jax.Array | None,
     updater: Any,
     t: jax.Array | int,
 ) -> tuple[jax.Array, jax.Array]:
@@ -51,8 +51,8 @@ def sgd_minibatch_update(
         u,
         v,
         weights=weights,
-        omega_u=omega_u[u_rows],
-        omega_v=omega_v[i_rows],
+        omega_u=None if omega_u is None else omega_u[u_rows],
+        omega_v=None if omega_v is None else omega_v[i_rows],
         t=t,
     )
     U = U.at[u_rows].add(du)
@@ -67,8 +67,8 @@ def sgd_block_sweep(
     i_rows: jax.Array,
     values: jax.Array,
     weights: jax.Array,
-    omega_u: jax.Array,
-    omega_v: jax.Array,
+    omega_u: jax.Array | None,
+    omega_v: jax.Array | None,
     updater: Any,
     t: jax.Array | int,
     minibatch: int,
@@ -155,6 +155,50 @@ def dsgd_train(
 
     (U, V), _ = jax.lax.scan(
         step, (U, V), jnp.arange(iterations * k, dtype=jnp.int32)
+    )
+    return U, V
+
+
+@partial(jax.jit, static_argnames=("updater", "minibatch", "iterations"))
+def online_train(
+    U: jax.Array,
+    V: jax.Array,
+    u_rows: jax.Array,  # int32[e], e divisible by minibatch
+    i_rows: jax.Array,
+    values: jax.Array,
+    weights: jax.Array,
+    *,
+    updater: Any,
+    minibatch: int,
+    iterations: int = 1,
+) -> tuple[jax.Array, jax.Array]:
+    """Online micro-batch update: sweep one micro-batch ``iterations`` times.
+
+    ≙ the online inner loops — one ``nextFactors`` application per arriving
+    rating (FlinkOnlineMF.scala:125-136; OnlineSpark.scala:76-78 runs exactly
+    a 1-iteration DSGD over the micro-batch) — batched into minibatch chunks
+    via ``lax.scan``. No omegas: the online paths use the plain ``SGDUpdater``
+    rule (unregularized, FactorUpdater.scala:35-53); regularized updaters
+    receive omega=None and fall back to plain λ. Sweep ``s`` (0-based) runs at
+    schedule step ``t = s + 1`` so decaying schedules advance per sweep (the
+    same t convention as ``dsgd_train``).
+    """
+    e = u_rows.shape[0]
+    assert e % minibatch == 0, (
+        f"batch size {e} not divisible by minibatch {minibatch}; pad with "
+        f"weight-0 entries first"
+    )
+
+    def sweep(carry, t):
+        U, V = carry
+        U, V = sgd_block_sweep(
+            U, V, u_rows, i_rows, values, weights, None, None,
+            updater, t, minibatch,
+        )
+        return (U, V), None
+
+    (U, V), _ = jax.lax.scan(
+        sweep, (U, V), jnp.arange(1, iterations + 1, dtype=jnp.int32)
     )
     return U, V
 
