@@ -160,13 +160,20 @@ def block_ratings(
     users: IdIndex,
     items: IdIndex,
     minibatch_multiple: int = 1,
+    seed: int | None = 0,
 ) -> BlockedRatings:
     """Bucket ratings into the k×k grid in stratum-major layout.
 
     ≙ rating-block construction (DSGDforMF.scala:301-333): join ratings with
-    block indices, group by ``ratingBlockId = uBlk*k + iBlk``. Blocks are
-    sorted by (user row, item row) for determinism (the reference sorts iff a
-    seed is set, DSGDforMF.scala:316-327 — we are always deterministic).
+    block indices, group by ``ratingBlockId = uBlk*k + iBlk``.
+
+    Within each block, ratings are SHUFFLED with a seeded RNG — deterministic,
+    but order-decorrelated. The reference shuffles each block before every
+    visit (DSGDforMF.scala:392-393); beyond SGD folklore this matters
+    mechanically here: a user-sorted block puts all of one row's ratings into
+    the same minibatch, maximizing intra-minibatch row collisions (SURVEY §7
+    hard part (b)) — shuffling spreads them uniformly so the batched kernel's
+    collision handling almost never engages.
     """
     if isinstance(ratings, Ratings):
         ru, ri, rv, rw = ratings.to_numpy()
@@ -191,8 +198,19 @@ def block_ratings(
     strat = (iblk - ublk) % k
 
     # Sort by (stratum, user block, user row, item row): blocks become
-    # contiguous runs, deterministic order inside each block.
+    # contiguous runs in a deterministic base order...
     order = np.lexsort((irow, urow, ublk, strat))
+    # ...then decorrelate inside each block with one seeded global shuffle
+    # (stable re-sort of shuffled positions keeps blocks contiguous but the
+    # within-block order random — ≙ the reference's per-visit shuffle,
+    # DSGDforMF.scala:392-393, made deterministic).
+    rng = np.random.default_rng(0 if seed is None else seed + 7919)
+    perm = rng.permutation(len(order))
+    shuffled = order[perm]
+    reorder = np.argsort(
+        strat[shuffled] * k + ublk[shuffled], kind="stable"
+    )
+    order = shuffled[reorder]
     urow, irow = urow[order], irow[order]
     vals = np.asarray(rv, dtype=np.float32)[order]
     strat_s, ublk_s = strat[order], ublk[order]
@@ -247,5 +265,6 @@ def block_problem(
     items = build_id_index(
         ri, num_blocks, None if seed is None else seed + 1, row_multiple
     )
-    blocked = block_ratings(ratings, users, items, minibatch_multiple)
+    blocked = block_ratings(ratings, users, items, minibatch_multiple,
+                            seed=seed)
     return BlockedProblem(users=users, items=items, ratings=blocked)

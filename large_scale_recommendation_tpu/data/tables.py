@@ -51,6 +51,7 @@ class GrowableFactorTable:
         self.rank = initializer.rank
         self._row_of: dict[int, int] = {}
         self._ids: list[int] = []
+        self._sorted_cache: tuple[np.ndarray, np.ndarray] | None = None
         self._device_put = device_put or (lambda x: x)
         self.capacity = max(_next_pow2(capacity), 8)
         self.array: jax.Array = self._device_put(
@@ -92,17 +93,27 @@ class GrowableFactorTable:
 
     def rows_for(self, ids: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """Look up rows WITHOUT registering; unknown ids → row 0, mask 0
-        (read-only form, for predict on a live model)."""
+        (read-only form, for predict on a live model).
+
+        Vectorized binary search over a lazily-rebuilt sorted index —
+        predict/eval call this on full evaluation sets (same rationale as
+        ``IdIndex.rows_for``)."""
         ids = np.asarray(ids).astype(np.int64)
-        rows = np.zeros(len(ids), dtype=np.int64)
-        mask = np.zeros(len(ids), dtype=np.float32)
-        row_of = self._row_of
-        for j, ident in enumerate(ids.tolist()):
-            r = row_of.get(ident)
-            if r is not None:
-                rows[j] = r
-                mask[j] = 1.0
-        return rows, mask
+        sorted_ids, sorted_rows = self._sorted_index()
+        if sorted_ids.size == 0:
+            return np.zeros(len(ids), np.int64), np.zeros(len(ids), np.float32)
+        pos = np.searchsorted(sorted_ids, ids)
+        pos = np.clip(pos, 0, sorted_ids.size - 1)
+        found = sorted_ids[pos] == ids
+        rows = np.where(found, sorted_rows[pos], 0)
+        return rows, found.astype(np.float32)
+
+    def _sorted_index(self) -> tuple[np.ndarray, np.ndarray]:
+        if self._sorted_cache is None or self._sorted_cache[0].size != len(self._ids):
+            all_ids = np.asarray(self._ids, dtype=np.int64)
+            order = np.argsort(all_ids)
+            self._sorted_cache = (all_ids[order], order.astype(np.int64))
+        return self._sorted_cache
 
     def _grow(self, need: int) -> None:
         new_cap = _next_pow2(need)
@@ -122,12 +133,19 @@ class GrowableFactorTable:
 
         ≙ the updates-only output stream (``UpdateSeparatedHashMap.updates``,
         OfflineSpark.scala:33-67) / PS output ``(id, newValue)``
-        (SimplePSLogic.scala:20-24)."""
+        (SimplePSLogic.scala:20-24).
+
+        Only the requested rows are gathered off the device — per-batch
+        updates-only output must not scale with table capacity."""
         if ids is None:
             ids = self._ids
-        host = np.asarray(self.array)
-        for ident in ids:
-            yield FactorVector(int(ident), host[self._row_of[int(ident)]])
+        ids = [int(i) for i in ids]
+        if not ids:
+            return
+        rows = jnp.asarray([self._row_of[i] for i in ids], dtype=jnp.int32)
+        host = np.asarray(self.array[rows])
+        for j, ident in enumerate(ids):
+            yield FactorVector(ident, host[j])
 
     def as_dict(self) -> dict[int, np.ndarray]:
         """Full model export as id → vector (host)."""

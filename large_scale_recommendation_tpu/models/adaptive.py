@@ -1,0 +1,273 @@
+"""Adaptive MF: continuous online updates + periodic full batch retrain.
+
+TPU-native rebuild of the reference's two "combined" paths:
+
+- **Spark**: ``OnlineSpark.buildModelCombineOffline``
+  (spark-adaptive-recom/.../OnlineSpark.scala:26-162) — every micro-batch
+  trains online (1-iteration DSGD on the new ratings); all ratings accumulate
+  into ``ratingsHistory`` (:68-70); every ``offlineEvery`` batches a FULL
+  retrain runs from the history — DSGD from scratch (:119-124) or MLlib ALS
+  (:125-131) — and the model is swapped wholesale (:134-150).
+- **Flink PS**: ``PSOfflineOnlineMF.offlineOnlinePS``
+  (flink-adaptive-recom/.../mf/PSOfflineOnlineMF.scala:24-401) — an external
+  trigger stream flips a 3-state machine Online → BatchInit → Batch on
+  workers and servers; the PS clears its parameters on batch start
+  (retrain-from-scratch, :313-314); ratings arriving during Batch are queued
+  (``onlinePullQueue``) and folded back into the online flow when the batch
+  ends (:204-237).
+
+Architecture here: the online flow is ``models.online.OnlineMF``
+(synchronous jitted micro-batches); the batch retrain is ``models.dsgd.DSGD``
+or ``models.als.ALS`` over the accumulated history. The state machine
+survives in recognizable form:
+
+    Online  — micro-batches update the live tables directly
+    Batch   — a retrain runs (optionally on a background thread, the
+              analogue of the reference's in-band-signaled concurrent batch);
+              arriving micro-batches are buffered, exactly the
+              ``onlinePullQueue`` contract
+    swap    — the retrained model replaces the online tables wholesale
+              (≙ model swap OnlineSpark.scala:134-150 / PS param clear
+              PSOfflineOnlineMF.scala:313-314), then buffered batches replay
+              through the online path (≙ folding the queue into ``rs``)
+
+``BatchInit`` (the reference's drain-in-flight-pulls state) has no analogue:
+synchronous jitted micro-batches leave nothing in flight to drain — the
+consistency problem that state solves is gone by construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Iterable, Iterator, Literal
+
+import numpy as np
+
+from large_scale_recommendation_tpu.core.limiter import ThroughputLimiter
+from large_scale_recommendation_tpu.core.types import Ratings
+from large_scale_recommendation_tpu.models.als import ALS, ALSConfig
+from large_scale_recommendation_tpu.models.dsgd import DSGD, DSGDConfig
+from large_scale_recommendation_tpu.models.mf import MFModel
+from large_scale_recommendation_tpu.models.online import (
+    BatchUpdates,
+    OnlineMF,
+    OnlineMFConfig,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class AdaptiveMFConfig:
+    """≙ the argument list of ``buildModelCombineOffline``
+    (OnlineSpark.scala:26-35: factorInit, factorUpdate, parameters,
+    checkpointEvery, offlineEvery, numberOfIterations, offlineAlgorithm) plus
+    the online knobs."""
+
+    num_factors: int = 10
+    learning_rate: float = 0.01
+    minibatch_size: int = 256
+    offline_every: int | None = 10  # retrain each N batches; None → trigger-only
+    offline_algorithm: Literal["dsgd", "als"] = "dsgd"
+    offline_iterations: int = 10
+    lambda_: float = 0.1
+    background: bool = False  # retrain on a thread (≙ concurrent batch mode)
+    history_limit: int | None = None  # cap history rows (None = unbounded)
+
+
+class AdaptiveMF:
+    """Online MF with periodic full retrain from history.
+
+    ≙ ``new OnlineSpark().buildModelCombineOffline(...)``
+    (OnlineSpark.scala:26-36) and the PS state machine
+    (PSOfflineOnlineMF.scala:28-34).
+    """
+
+    def __init__(self, config: AdaptiveMFConfig | None = None):
+        self.config = cfg = config or AdaptiveMFConfig()
+        self.online = OnlineMF(OnlineMFConfig(
+            num_factors=cfg.num_factors,
+            learning_rate=cfg.learning_rate,
+            minibatch_size=cfg.minibatch_size,
+        ))
+        self._history: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+        self._history_rows = 0
+        self._batches_since_retrain = 0
+        self.retrain_count = 0
+        # Batch-state machinery (background mode)
+        self._state = "Online"  # "Online" | "Batch"
+        self._thread: threading.Thread | None = None
+        self._retrained: MFModel | None = None
+        self._buffer: list[Ratings] = []
+
+    # -- state -------------------------------------------------------------
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    # -- ingest ------------------------------------------------------------
+
+    def process(self, batch: Ratings) -> BatchUpdates:
+        """One micro-batch through the adaptive pipeline.
+
+        ≙ one ``transform`` body (OnlineSpark.scala:55-158): history ∪= batch,
+        online update, counters; retrain + swap when due.
+        """
+        cfg = self.config
+        self._append_history(batch)
+
+        if self._state == "Batch":
+            if self._thread is not None and self._thread.is_alive():
+                # ≙ enqueue to onlinePullQueue (PSOfflineOnlineMF.scala:142)
+                self._buffer.append(batch)
+                return BatchUpdates([], [])
+            # retrain finished: swap + replay the queue
+            updates = self._finish_batch()
+            more = self.online.partial_fit(batch)
+            return BatchUpdates(updates.user_updates + more.user_updates,
+                                updates.item_updates + more.item_updates)
+
+        out = self.online.partial_fit(batch)
+        self._batches_since_retrain += 1
+        if (cfg.offline_every is not None
+                and self._batches_since_retrain >= cfg.offline_every):
+            self.trigger_batch_training()
+        return out
+
+    def trigger_batch_training(self) -> None:
+        """Start a full retrain from history.
+
+        ≙ an element on ``batchTrainingTrigger``
+        (PSOfflineOnlineMF.scala:37,385) / the offlineEvery counter expiring
+        (OnlineSpark.scala:115).
+        """
+        if self._state == "Batch" or self._history_rows == 0:
+            return
+        self._batches_since_retrain = 0
+        history = self._history_ratings()
+        if self.config.background:
+            self._state = "Batch"
+            self._retrained = None
+            self._thread = threading.Thread(
+                target=self._retrain_into_slot, args=(history,), daemon=True
+            )
+            self._thread.start()
+        else:
+            model = self._retrain(history)
+            self._install(model)
+            self.retrain_count += 1
+
+    def flush(self) -> BatchUpdates:
+        """Block until any background retrain completes and swap it in
+        (≙ batch-finished sign propagation, PSOfflineOnlineMF.scala:316-323).
+        """
+        if self._state != "Batch":
+            return BatchUpdates([], [])
+        if self._thread is not None:
+            self._thread.join()
+        return self._finish_batch()
+
+    def run(
+        self,
+        batches: Iterable[Ratings],
+        limiter: ThroughputLimiter | None = None,
+    ) -> Iterator[BatchUpdates]:
+        for batch in batches:
+            if limiter is not None:
+                limiter.emit_batch_or_wait(int(batch.n))
+            yield self.process(batch)
+
+    # -- retrain machinery --------------------------------------------------
+
+    def _retrain(self, history: Ratings) -> MFModel:
+        """Full batch fit from scratch on the whole history.
+
+        ≙ ``offlineDSGD(ratingsHistory, empty factors, ...)``
+        (OnlineSpark.scala:119-124 — note the EMPTY initial factors: retrain
+        from scratch, same as the PS param clear) or ``ALS.train``
+        (:125-131).
+        """
+        cfg = self.config
+        if cfg.offline_algorithm == "als":
+            return ALS(ALSConfig(
+                num_factors=cfg.num_factors, lambda_=cfg.lambda_,
+                iterations=cfg.offline_iterations,
+            )).fit(history)
+        return DSGD(DSGDConfig(
+            num_factors=cfg.num_factors, lambda_=cfg.lambda_,
+            iterations=cfg.offline_iterations,
+            learning_rate=0.05, lr_schedule="constant",
+            minibatch_size=min(cfg.minibatch_size, 1024),
+        )).fit(history)
+
+    def _retrain_into_slot(self, history: Ratings) -> None:
+        self._retrained = self._retrain(history)
+
+    def _finish_batch(self) -> BatchUpdates:
+        """Swap the retrained model in and replay the buffered queue."""
+        model = self._retrained
+        self._thread = None
+        self._retrained = None
+        self._state = "Online"
+        if model is not None:
+            self._install(model)
+            self.retrain_count += 1
+        buffered, self._buffer = self._buffer, []
+        users: list = []
+        items: list = []
+        for b in buffered:  # ≙ fold onlinePullQueue into rs and resume
+            out = self.online.partial_fit(b)
+            users.extend(out.user_updates)
+            items.extend(out.item_updates)
+        return BatchUpdates(users, items)
+
+    def _install(self, model: MFModel) -> None:
+        """Replace the online tables with the retrained factors wholesale.
+
+        ≙ the model swap (OnlineSpark.scala:134-150). Vocabulary seen online
+        but absent from the history snapshot survives with its online
+        vectors.
+        """
+        import jax.numpy as jnp
+
+        U = np.asarray(model.U)
+        V = np.asarray(model.V)
+        for table, T, index in ((self.online.users, U, model.users),
+                                (self.online.items, V, model.items)):
+            real = index.ids >= 0
+            ids = index.ids[real]
+            rows = table.ensure(ids)
+            table.array = table.array.at[jnp.asarray(rows)].set(
+                jnp.asarray(T[real])
+            )
+
+    # -- history ------------------------------------------------------------
+
+    def _append_history(self, batch: Ratings) -> None:
+        """≙ ``ratingsHistory = ratingsHistory union rs``
+        (OnlineSpark.scala:68-70), as host arrays."""
+        ru, ri, rv, rw = batch.to_numpy()
+        real = rw > 0
+        if not real.any():
+            return
+        self._history.append((ru[real], ri[real], rv[real]))
+        self._history_rows += int(real.sum())
+        limit = self.config.history_limit
+        if limit is not None:
+            while self._history_rows > limit and len(self._history) > 1:
+                dropped = self._history.pop(0)
+                self._history_rows -= len(dropped[0])
+
+    def _history_ratings(self) -> Ratings:
+        ru = np.concatenate([h[0] for h in self._history])
+        ri = np.concatenate([h[1] for h in self._history])
+        rv = np.concatenate([h[2] for h in self._history])
+        return Ratings.from_arrays(ru, ri, rv)
+
+    # -- scoring ------------------------------------------------------------
+
+    def predict(self, user_ids, item_ids) -> np.ndarray:
+        return self.online.predict(user_ids, item_ids)
+
+    def rmse(self, data: Ratings) -> float:
+        return self.online.rmse(data)

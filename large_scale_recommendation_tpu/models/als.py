@@ -1,0 +1,125 @@
+"""ALS: alternating least squares matrix factorization (batch solver).
+
+TPU-native stand-in for the MLlib ALS the reference calls in its
+periodic-retrain branch (reference: spark-adaptive-recom/.../
+OnlineSpark.scala:125-131 — ``ALS.train(ratingsHistory, rank,
+numberOfIterations, 0.1)``). Capability parity per SURVEY §7 step 5: the
+second offline algorithm behind the same fit/predict surface as DSGD.
+
+The whole solver is one jitted computation (``ops.als.als_train``):
+normal-equation gram assembly via chunked scatter-add and batched Cholesky
+solves on the MXU — the ALX-style formulation (see PAPERS.md) rather than
+MLlib's block-routed LAPACK calls.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from large_scale_recommendation_tpu.core.initializers import (
+    PseudoRandomFactorInitializer,
+    RandomFactorInitializer,
+)
+from large_scale_recommendation_tpu.core.types import Ratings
+from large_scale_recommendation_tpu.data import blocking
+from large_scale_recommendation_tpu.models.mf import MFModel
+from large_scale_recommendation_tpu.ops import als as als_ops
+
+
+@dataclasses.dataclass(frozen=True)
+class ALSConfig:
+    """Defaults ≙ the reference call site: rank from config, λ=0.1 hardcoded,
+    iterations from config (OnlineSpark.scala:125-131)."""
+
+    num_factors: int = 10
+    lambda_: float = 0.1
+    iterations: int = 10
+    reg_mode: str = "direct"  # "direct" (MLlib ALS.train) | "als_wr" (ω-scaled)
+    seed: int | None = 0
+    chunk_size: int = 4096  # gram-assembly scatter chunk
+    init_scale: float = 0.1
+
+
+class ALS:
+    """Batch ALS solver with the same surface as ``DSGD``."""
+
+    def __init__(self, config: ALSConfig | None = None):
+        self.config = config or ALSConfig()
+        self.model: MFModel | None = None
+
+    def fit(self, ratings: Ratings) -> MFModel:
+        cfg = self.config
+        if ratings.n == 0:
+            raise ValueError("cannot fit on an empty ratings set")
+
+        ru, ri, rv, rw = ratings.to_numpy()
+        real = rw > 0
+        ru, ri, rv = ru[real], ri[real], rv[real]
+
+        users = blocking.build_id_index(ru, num_blocks=1, seed=cfg.seed)
+        items = blocking.build_id_index(
+            ri, num_blocks=1, seed=None if cfg.seed is None else cfg.seed + 1
+        )
+        u_rows, _ = users.rows_for(ru)
+        i_rows, _ = items.rows_for(ri)
+
+        n = len(ru)
+        padded = -(-n // cfg.chunk_size) * cfg.chunk_size
+        ur = np.zeros(padded, np.int32)
+        ir = np.zeros(padded, np.int32)
+        vals = np.zeros(padded, np.float32)
+        w = np.zeros(padded, np.float32)
+        ur[:n], ir[:n], vals[:n], w[:n] = u_rows, i_rows, rv, 1.0
+
+        U, V = self._init_factors(users, items)
+        U, V = als_ops.als_train(
+            U, V,
+            jnp.asarray(ur), jnp.asarray(ir),
+            jnp.asarray(vals), jnp.asarray(w),
+            jnp.asarray(users.omega), jnp.asarray(items.omega),
+            lambda_=cfg.lambda_,
+            num_u_rows=users.num_rows,
+            num_i_rows=items.num_rows,
+            chunk=cfg.chunk_size,
+            iterations=cfg.iterations,
+            reg_mode=cfg.reg_mode,
+        )
+        self.model = MFModel(U=U, V=V, users=users, items=items)
+        return self.model
+
+    def _init_factors(self, users: blocking.IdIndex, items: blocking.IdIndex):
+        cfg = self.config
+        # Only V's init matters mathematically (the first half-step solves U
+        # from V), but both tables are initialized for API symmetry.
+        if cfg.seed is not None:
+            init = PseudoRandomFactorInitializer(cfg.num_factors,
+                                                 scale=cfg.init_scale)
+            U = init(jnp.asarray(np.maximum(users.ids, 0)))
+            V = init(jnp.asarray(np.maximum(items.ids, 0)))
+        else:
+            U = RandomFactorInitializer(cfg.num_factors, seed=0, salt=0,
+                                        scale=cfg.init_scale)(
+                jnp.arange(users.num_rows))
+            V = RandomFactorInitializer(cfg.num_factors, seed=0, salt=1,
+                                        scale=cfg.init_scale)(
+                jnp.arange(items.num_rows))
+        return U, V
+
+    # -- scoring passthroughs (same surface as DSGD) -----------------------
+
+    def predict(self, user_ids, item_ids):
+        self._require_fitted()
+        return self.model.predict(user_ids, item_ids)
+
+    def empirical_risk(self, data: Ratings) -> float:
+        self._require_fitted()
+        return self.model.empirical_risk(data, lambda_=self.config.lambda_)
+
+    def _require_fitted(self):
+        if self.model is None:
+            raise RuntimeError(
+                "model has not been fitted; call fit() before predicting"
+            )

@@ -54,6 +54,7 @@ class DSGDConfig:
     seed: int | None = 0
     minibatch_size: int = 1024
     init_scale: float = 1.0  # factor init upper bound (nextDouble ∈ [0,1))
+    collision_mode: str = "mean"  # minibatch row-collision handling (ops.sgd)
 
     def schedule_fn(self):
         return inverse_sqrt_lr if self.lr_schedule == "inverse_sqrt" else constant_lr
@@ -111,6 +112,7 @@ class DSGD:
             minibatch=cfg.minibatch_size,
             num_blocks=k,
             iterations=cfg.iterations,
+            collision=cfg.collision_mode,
         )
         self.model = MFModel(U=U, V=V, users=problem.users, items=problem.items)
         return self.model

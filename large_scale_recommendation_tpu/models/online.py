@@ -63,6 +63,7 @@ class OnlineMFConfig:
     minibatch_size: int = 256
     init_capacity: int = 1024
     init_scale: float = 0.1
+    collision_mode: str = "mean"  # minibatch row-collision handling (ops.sgd)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -144,7 +145,9 @@ class OnlineMF:
             jnp.asarray(vals), jnp.asarray(w),
             updater=self.updater,
             minibatch=cfg.minibatch_size,
-            iterations=iterations or cfg.iterations_per_batch,
+            iterations=(iterations if iterations is not None
+                        else cfg.iterations_per_batch),
+            collision=cfg.collision_mode,
         )
         self.users.array = U
         self.items.array = V

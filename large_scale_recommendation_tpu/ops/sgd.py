@@ -38,12 +38,29 @@ def sgd_minibatch_update(
     omega_v: jax.Array | None,
     updater: Any,
     t: jax.Array | int,
+    collision: str = "mean",
 ) -> tuple[jax.Array, jax.Array]:
     """One minibatch: gather → delta → scatter-add.
 
     ≙ one group of iterations of the per-rating loop at
-    DSGDforMF.scala:398-417, with additive accumulation on row collisions.
+    DSGDforMF.scala:398-417. Row collisions inside a minibatch (the same
+    user/item hit by several ratings — SURVEY §7 hard part (b)):
+
+    - ``collision="mean"`` (default): each row's accumulated delta is divided
+      by its occurrence count, bounding the effective step at the base
+      learning rate. Without this, dense workloads (many ratings per row per
+      minibatch) make the summed stale-point deltas an effective step of
+      lr × dup_count and training diverges to NaN.
+    - ``collision="sum"``: raw additive accumulation (plain minibatch SGD) —
+      closest to sequential semantics when collisions are rare.
+
+    With ``minibatch=1`` both modes recover the reference's exact sequential
+    per-rating semantics.
     """
+    if collision not in ("mean", "sum"):
+        raise ValueError(
+            f"collision must be 'mean' or 'sum', got {collision!r}"
+        )
     u = U[u_rows]
     v = V[i_rows]
     du, dv = updater.delta(
@@ -55,6 +72,11 @@ def sgd_minibatch_update(
         omega_v=None if omega_v is None else omega_v[i_rows],
         t=t,
     )
+    if collision == "mean":
+        cu = jnp.zeros(U.shape[0], U.dtype).at[u_rows].add(weights)
+        cv = jnp.zeros(V.shape[0], V.dtype).at[i_rows].add(weights)
+        du = du / jnp.maximum(cu[u_rows], 1.0)[:, None]
+        dv = dv / jnp.maximum(cv[i_rows], 1.0)[:, None]
     U = U.at[u_rows].add(du)
     V = V.at[i_rows].add(dv)
     return U, V
@@ -72,6 +94,7 @@ def sgd_block_sweep(
     updater: Any,
     t: jax.Array | int,
     minibatch: int,
+    collision: str = "mean",
 ) -> tuple[jax.Array, jax.Array]:
     """Sweep one rating block (or one whole stratum flattened) in minibatch
     chunks via ``lax.scan``.
@@ -92,7 +115,7 @@ def sgd_block_sweep(
         U, V = carry
         ur, ir, vals, w = xs
         U, V = sgd_minibatch_update(
-            U, V, ur, ir, vals, w, omega_u, omega_v, updater, t
+            U, V, ur, ir, vals, w, omega_u, omega_v, updater, t, collision
         )
         return (U, V), None
 
@@ -104,7 +127,8 @@ def sgd_block_sweep(
 
 @partial(
     jax.jit,
-    static_argnames=("updater", "minibatch", "num_blocks", "iterations"),
+    static_argnames=("updater", "minibatch", "num_blocks", "iterations",
+                     "collision"),
 )
 def dsgd_train(
     U: jax.Array,
@@ -120,6 +144,7 @@ def dsgd_train(
     minibatch: int,
     num_blocks: int,
     iterations: int,
+    collision: str = "mean",
 ) -> tuple[jax.Array, jax.Array]:
     """Full single-device DSGD training loop as ONE jitted computation.
 
@@ -149,7 +174,7 @@ def dsgd_train(
             U, V,
             su_f[s], si_f[s], sv_f[s], sw_f[s],
             omega_u, omega_v,
-            updater, t, minibatch,
+            updater, t, minibatch, collision,
         )
         return (U, V), None
 
@@ -159,7 +184,8 @@ def dsgd_train(
     return U, V
 
 
-@partial(jax.jit, static_argnames=("updater", "minibatch", "iterations"))
+@partial(jax.jit, static_argnames=("updater", "minibatch", "iterations",
+                                   "collision"))
 def online_train(
     U: jax.Array,
     V: jax.Array,
@@ -171,6 +197,7 @@ def online_train(
     updater: Any,
     minibatch: int,
     iterations: int = 1,
+    collision: str = "mean",
 ) -> tuple[jax.Array, jax.Array]:
     """Online micro-batch update: sweep one micro-batch ``iterations`` times.
 
@@ -193,7 +220,7 @@ def online_train(
         U, V = carry
         U, V = sgd_block_sweep(
             U, V, u_rows, i_rows, values, weights, None, None,
-            updater, t, minibatch,
+            updater, t, minibatch, collision,
         )
         return (U, V), None
 

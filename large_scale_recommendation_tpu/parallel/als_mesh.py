@@ -1,0 +1,190 @@
+"""Mesh-parallel ALS: block-sharded factor tables, all_gather half-steps.
+
+Distributed form of ``models.als`` (the MLlib-ALS-equivalent,
+OnlineSpark.scala:125-131) in the ALX style (PAPERS.md): U and V are
+block-sharded over the device mesh exactly like mesh-DSGD; each half-step
+
+    V_full = all_gather(V)                 (factor tables are the small
+                                            [n, k] arrays — cheap on ICI)
+    A, b   = local gram assembly over the device's OWN ratings
+             (ratings are pre-partitioned by user block on the host, so the
+             solved side's rows are always device-local — the same
+             co-location trick as Spark's ``zipPartitions``,
+             OfflineSpark.scala:169-170, without the shuffle)
+    U_l    = batched Cholesky solve of the local shard's systems
+
+and symmetrically for V with ratings partitioned by item block. MLlib routes
+factor blocks between executors through the block manager each half-step;
+here the only communication is the two ``all_gather`` collectives per round,
+riding ICI inside one jitted computation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import lru_cache, partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from large_scale_recommendation_tpu.core.types import Ratings
+from large_scale_recommendation_tpu.data import blocking
+from large_scale_recommendation_tpu.models.als import ALSConfig
+from large_scale_recommendation_tpu.models.mf import MFModel
+from large_scale_recommendation_tpu.ops import als as als_ops
+from large_scale_recommendation_tpu.parallel.mesh import (
+    BLOCK_AXIS,
+    block_sharding,
+    make_block_mesh,
+)
+
+
+def partition_by_block(
+    rows: np.ndarray,
+    other_rows: np.ndarray,
+    values: np.ndarray,
+    num_blocks: int,
+    rows_per_block: int,
+    chunk_multiple: int,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Group ratings by the block of ``rows``; pad every block to the same
+    chunk-aligned size. Solved-side rows are localized (mod rows_per_block);
+    the fixed side keeps GLOBAL rows (it indexes the all_gathered table).
+
+    Returns [k, bmax] arrays: local_rows, other_global_rows, values, weights.
+    """
+    blk = rows // rows_per_block
+    order = np.argsort(blk, kind="stable")
+    rows_s, other_s = rows[order], other_rows[order]
+    vals_s, blk_s = values[order], blk[order]
+    sizes = np.bincount(blk_s, minlength=num_blocks)
+    bmax = max(int(sizes.max()) if sizes.size else 0, 1)
+    bmax = -(-bmax // chunk_multiple) * chunk_multiple
+
+    k = num_blocks
+    out_rows = np.zeros((k, bmax), np.int32)
+    out_other = np.zeros((k, bmax), np.int32)
+    out_vals = np.zeros((k, bmax), np.float32)
+    out_w = np.zeros((k, bmax), np.float32)
+    starts = np.concatenate([[0], np.cumsum(sizes)])
+    for p in range(k):
+        a, b = starts[p], starts[p + 1]
+        m = b - a
+        out_rows[p, :m] = rows_s[a:b] % rows_per_block
+        out_other[p, :m] = other_s[a:b]
+        out_vals[p, :m] = vals_s[a:b]
+        out_w[p, :m] = 1.0
+    return out_rows, out_other, out_vals, out_w
+
+
+@lru_cache(maxsize=32)
+def build_mesh_als_step(
+    mesh: Mesh,
+    lambda_: float,
+    reg_mode: str,
+    chunk: int,
+    iterations: int,
+):
+    """Jitted distributed ALS round loop.
+
+    All 0-dim-sharded inputs: U, V, omegas, and the two rating layouts
+    ([k, bmax] each side). Output sharding equals input sharding.
+    """
+    spec = P(BLOCK_AXIS)
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(spec,) * 12,
+        out_specs=(spec, spec),
+        # the gram accumulators start as fresh (replicated) zeros and become
+        # device-varying through the scatter-add — skip the static VMA check
+        # rather than threading pvary through the shared gram_stats kernel
+        check_vma=False,
+    )
+    def run(U_l, V_l, ou_l, ov_l,
+            # user-partitioned layout: local user rows, global item rows
+            u_loc, u_oth, u_val, u_w,
+            # item-partitioned layout: local item rows, global user rows
+            i_loc, i_oth, i_val, i_w):
+        # drop the leading sharded dim of the per-device rating blocks
+        u_loc, u_oth, u_val, u_w = u_loc[0], u_oth[0], u_val[0], u_w[0]
+        i_loc, i_oth, i_val, i_w = i_loc[0], i_oth[0], i_val[0], i_w[0]
+        nu_l, ni_l = U_l.shape[0], V_l.shape[0]
+        scale_u = ou_l if reg_mode == "als_wr" else None
+        scale_v = ov_l if reg_mode == "als_wr" else None
+
+        def round_(carry, _):
+            U_l, V_l = carry
+            V_full = jax.lax.all_gather(V_l, BLOCK_AXIS, tiled=True)
+            A, b = als_ops.gram_stats(V_full, u_loc, u_oth, u_val, u_w,
+                                      nu_l, chunk)
+            U_l = als_ops.solve_normal_eq(A, b, lambda_, scale_u)
+            U_full = jax.lax.all_gather(U_l, BLOCK_AXIS, tiled=True)
+            A, b = als_ops.gram_stats(U_full, i_loc, i_oth, i_val, i_w,
+                                      ni_l, chunk)
+            V_l = als_ops.solve_normal_eq(A, b, lambda_, scale_v)
+            return (U_l, V_l), None
+
+        (U_l, V_l), _ = jax.lax.scan(round_, (U_l, V_l), None,
+                                     length=iterations)
+        return U_l, V_l
+
+    return jax.jit(run)
+
+
+class MeshALS:
+    """Distributed ALS over a block mesh — same surface as ``MeshDSGD``."""
+
+    def __init__(self, config: ALSConfig | None = None,
+                 mesh: Mesh | None = None):
+        self.config = config or ALSConfig()
+        self.mesh = mesh or make_block_mesh()
+        self.model: MFModel | None = None
+
+    @property
+    def num_blocks(self) -> int:
+        return self.mesh.shape[BLOCK_AXIS]
+
+    def fit(self, ratings: Ratings) -> MFModel:
+        cfg = self.config
+        if ratings.n == 0:
+            raise ValueError("cannot fit on an empty ratings set")
+        k = self.num_blocks
+
+        ru, ri, rv, rw = ratings.to_numpy()
+        real = rw > 0
+        ru, ri, rv = ru[real], ri[real], rv[real]
+
+        users = blocking.build_id_index(ru, num_blocks=k, seed=cfg.seed)
+        items = blocking.build_id_index(
+            ri, num_blocks=k, seed=None if cfg.seed is None else cfg.seed + 1
+        )
+        u_rows, _ = users.rows_for(ru)
+        i_rows, _ = items.rows_for(ri)
+        rv = np.asarray(rv, np.float32)
+
+        by_user = partition_by_block(u_rows, i_rows, rv, k,
+                                     users.rows_per_block, cfg.chunk_size)
+        by_item = partition_by_block(i_rows, u_rows, rv, k,
+                                     items.rows_per_block, cfg.chunk_size)
+
+        from large_scale_recommendation_tpu.models.als import ALS
+
+        U, V = ALS(cfg)._init_factors(users, items)
+
+        shard = block_sharding(self.mesh)
+        put = lambda x: jax.device_put(jnp.asarray(x), shard)
+        step_fn = build_mesh_als_step(
+            self.mesh, cfg.lambda_, cfg.reg_mode, cfg.chunk_size,
+            cfg.iterations,
+        )
+        U, V = step_fn(
+            put(U), put(V), put(users.omega), put(items.omega),
+            *(put(a) for a in by_user), *(put(a) for a in by_item),
+        )
+        self.model = MFModel(U=U, V=V, users=users, items=items)
+        return self.model

@@ -76,6 +76,7 @@ def build_mesh_dsgd_step(
     minibatch: int,
     num_blocks: int,
     iterations: int,
+    collision: str = "mean",
 ):
     """Build the jitted multi-chip training function.
 
@@ -107,7 +108,7 @@ def build_mesh_dsgd_step(
             t = idx // k + 1
             U, V = sgd_ops.sgd_block_sweep(
                 U, V, ru[s], ri[s], rv[s], rw[s], ou_l, ov,
-                updater, t, minibatch,
+                updater, t, minibatch, collision,
             )
             # Rotate the item shard (and its omegas) one step down the ring
             # — ≙ the reference's inter-superstep shuffle of item blocks
@@ -138,6 +139,7 @@ class MeshDSGDConfig:
     seed: int | None = 0
     minibatch_size: int = 1024
     init_scale: float = 1.0
+    collision_mode: str = "mean"  # see ops.sgd.sgd_minibatch_update
 
 
 class MeshDSGD:
@@ -199,7 +201,8 @@ class MeshDSGD:
         ov = put(problem.items.omega)
 
         step_fn = build_mesh_dsgd_step(
-            self.mesh, self.updater, cfg.minibatch_size, k, cfg.iterations
+            self.mesh, self.updater, cfg.minibatch_size, k, cfg.iterations,
+            cfg.collision_mode,
         )
         U, V = step_fn(U, V, *args, ou, ov)
         self.model = MFModel(U=U, V=V, users=problem.users,

@@ -1,0 +1,138 @@
+"""Adaptive (combined online+batch) MF: retrain cadence, model swap,
+state machine buffering, DSGD and ALS retrain paths.
+
+Behaviors ≙ OnlineSpark.buildModelCombineOffline and the
+PSOfflineOnlineMF state machine (SURVEY §3.4/§3.6).
+"""
+
+import time
+
+import numpy as np
+
+from large_scale_recommendation_tpu.core.generators import SyntheticMFGenerator
+from large_scale_recommendation_tpu.core.types import Ratings
+from large_scale_recommendation_tpu.models.adaptive import (
+    AdaptiveMF,
+    AdaptiveMFConfig,
+)
+
+
+def stream(gen, n_batches, batch):
+    for _ in range(n_batches):
+        yield gen.generate(batch)
+
+
+class TestAdaptiveMF:
+    def test_retrain_cadence(self):
+        """offline_every=3 → retrain after every 3rd batch
+        (≙ offlineEvery counter, OnlineSpark.scala:56-66,115)."""
+        gen = SyntheticMFGenerator(num_users=30, num_items=20, rank=3,
+                                   noise=0.1, seed=0)
+        m = AdaptiveMF(AdaptiveMFConfig(num_factors=4, offline_every=3,
+                                        minibatch_size=64,
+                                        offline_iterations=2))
+        for _ in range(7):
+            m.process(gen.generate(300))
+        assert m.retrain_count == 2
+
+    def test_trigger_only_mode(self):
+        """offline_every=None → retrains happen only on explicit trigger
+        (≙ the external batchTrainingTrigger stream,
+        PSOfflineOnlineMF.scala:37)."""
+        gen = SyntheticMFGenerator(num_users=30, num_items=20, rank=3,
+                                   noise=0.1, seed=1)
+        m = AdaptiveMF(AdaptiveMFConfig(num_factors=4, offline_every=None,
+                                        minibatch_size=64,
+                                        offline_iterations=2))
+        for _ in range(5):
+            m.process(gen.generate(300))
+        assert m.retrain_count == 0
+        m.trigger_batch_training()
+        assert m.retrain_count == 1
+
+    def test_retrain_improves_over_online_only(self):
+        """Periodic batch retrain from full history beats the purely online
+        model under the same stream — the reason the combined path exists."""
+        gen = SyntheticMFGenerator(num_users=80, num_items=60, rank=4,
+                                   noise=0.05, seed=2)
+        test = gen.generate(3000)
+
+        adaptive = AdaptiveMF(AdaptiveMFConfig(
+            num_factors=8, offline_every=5, offline_algorithm="als",
+            offline_iterations=6, lambda_=0.05, minibatch_size=128,
+            learning_rate=0.02))
+        online_only = AdaptiveMF(AdaptiveMFConfig(
+            num_factors=8, offline_every=None, minibatch_size=128,
+            learning_rate=0.02))
+
+        gen2 = SyntheticMFGenerator(num_users=80, num_items=60, rank=4,
+                                    noise=0.05, seed=2)
+        for b in stream(gen, 10, 800):
+            adaptive.process(b)
+        for b in stream(gen2, 10, 800):
+            online_only.process(b)
+        assert adaptive.rmse(test) < online_only.rmse(test)
+        assert adaptive.rmse(test) < 0.15
+
+    def test_dsgd_retrain_path(self):
+        gen = SyntheticMFGenerator(num_users=40, num_items=30, rank=3,
+                                   noise=0.05, seed=3)
+        m = AdaptiveMF(AdaptiveMFConfig(
+            num_factors=6, offline_every=4, offline_algorithm="dsgd",
+            offline_iterations=8, lambda_=0.02, minibatch_size=128))
+        for b in stream(gen, 8, 600):
+            m.process(b)
+        assert m.retrain_count == 2
+        assert m.rmse(gen.generate(1000)) < 0.25
+
+    def test_background_batch_buffers_and_replays(self):
+        """During a background retrain, arriving batches are buffered (≙
+        onlinePullQueue) and replayed after the swap
+        (PSOfflineOnlineMF.scala:204-237)."""
+        gen = SyntheticMFGenerator(num_users=40, num_items=30, rank=3,
+                                   noise=0.1, seed=4)
+        m = AdaptiveMF(AdaptiveMFConfig(
+            num_factors=4, offline_every=None, background=True,
+            offline_iterations=30, minibatch_size=64))
+        for b in stream(gen, 3, 500):
+            m.process(b)
+        m.trigger_batch_training()
+        assert m.state == "Batch"
+        # feed while the batch trains; these buffer (empty updates) or, if
+        # the thread already finished, trigger swap+replay
+        buffered_any = False
+        for b in stream(gen, 3, 200):
+            out = m.process(b)
+            if not out.user_updates and m.state == "Batch":
+                buffered_any = True
+        out = m.flush()
+        assert m.state == "Online"
+        assert m.retrain_count == 1
+        if buffered_any:
+            # the replayed queue emitted its updates at swap time
+            assert out.user_updates or not buffered_any
+        # model still serves predictions
+        assert np.isfinite(m.rmse(gen.generate(500)))
+
+    def test_swap_preserves_online_only_vocabulary(self):
+        """Ids seen online but absent from the retrain history snapshot keep
+        their online vectors after the swap."""
+        m = AdaptiveMF(AdaptiveMFConfig(num_factors=4, offline_every=None,
+                                        minibatch_size=8,
+                                        offline_iterations=2))
+        m.process(Ratings.from_arrays([1, 2], [1, 2], [3.0, 2.0]))
+        m.trigger_batch_training()
+        # new id after the retrain
+        m.process(Ratings.from_arrays([99], [1], [4.0]))
+        s = m.predict([99, 1], [1, 1])
+        assert s[0] != 0.0 and s[1] != 0.0
+
+    def test_history_limit(self):
+        m = AdaptiveMF(AdaptiveMFConfig(num_factors=4, offline_every=None,
+                                        minibatch_size=32,
+                                        history_limit=1000))
+        gen = SyntheticMFGenerator(num_users=20, num_items=20, rank=2,
+                                   noise=0.1, seed=5)
+        for b in stream(gen, 10, 400):
+            m.process(b)
+        assert m._history_rows <= 1400  # limit + one batch slack

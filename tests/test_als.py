@@ -1,0 +1,182 @@
+"""ALS solver: numpy oracle parity, convergence, mesh equivalence.
+
+SURVEY §4 test pyramid for the second offline algorithm (the MLlib-ALS
+stand-in, OnlineSpark.scala:125-131).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from large_scale_recommendation_tpu.core.generators import SyntheticMFGenerator
+from large_scale_recommendation_tpu.core.types import Ratings
+from large_scale_recommendation_tpu.models.als import ALS, ALSConfig
+from large_scale_recommendation_tpu.ops import als as als_ops
+
+
+def numpy_als_half_step(ratings, fixed, n_out, lam, reg_scale=None):
+    """Oracle: per-row normal equations solved with numpy, sequentially."""
+    k = fixed.shape[1]
+    out = np.zeros((n_out, k))
+    for row in range(n_out):
+        sel = ratings[:, 0].astype(int) == row
+        if not sel.any():
+            continue
+        vs = fixed[ratings[sel, 1].astype(int)]
+        A = vs.T @ vs
+        b = vs.T @ ratings[sel, 2]
+        s = reg_scale[row] if reg_scale is not None else 1.0
+        out[row] = np.linalg.solve(A + lam * max(s, 1.0) * np.eye(k), b)
+    return out
+
+
+class TestGramAndSolve:
+    def test_gram_stats_matches_oracle(self):
+        rng = np.random.default_rng(0)
+        n_out, n_other, k, e = 6, 5, 3, 32
+        fixed = rng.normal(size=(n_other, k)).astype(np.float32)
+        rows = rng.integers(0, n_out, e).astype(np.int32)
+        orows = rng.integers(0, n_other, e).astype(np.int32)
+        vals = rng.normal(size=e).astype(np.float32)
+        w = np.ones(e, np.float32)
+        w[-5:] = 0.0  # padding must not contribute
+        A, b = als_ops.gram_stats(
+            jnp.asarray(fixed), jnp.asarray(rows), jnp.asarray(orows),
+            jnp.asarray(vals), jnp.asarray(w), n_out, chunk=8,
+        )
+        A_ref = np.zeros((n_out, k, k))
+        b_ref = np.zeros((n_out, k))
+        for j in range(e):
+            if w[j] == 0:
+                continue
+            v = fixed[orows[j]]
+            A_ref[rows[j]] += np.outer(v, v)
+            b_ref[rows[j]] += vals[j] * v
+        np.testing.assert_allclose(np.asarray(A), A_ref, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(b), b_ref, rtol=1e-4, atol=1e-5)
+
+    def test_solve_normal_eq_matches_numpy(self):
+        rng = np.random.default_rng(1)
+        n, k = 4, 5
+        M = rng.normal(size=(n, k, k)).astype(np.float32)
+        A = np.einsum("nij,nkj->nik", M, M)  # PSD
+        b = rng.normal(size=(n, k)).astype(np.float32)
+        lam = 0.3
+        x = als_ops.solve_normal_eq(jnp.asarray(A), jnp.asarray(b), lam)
+        for j in range(n):
+            ref = np.linalg.solve(A[j] + lam * np.eye(k), b[j])
+            np.testing.assert_allclose(np.asarray(x)[j], ref, rtol=1e-3,
+                                       atol=1e-4)
+
+    def test_empty_rows_solve_to_zero(self):
+        A = jnp.zeros((3, 4, 4))
+        b = jnp.zeros((3, 4))
+        x = als_ops.solve_normal_eq(A, b, 0.1)
+        np.testing.assert_array_equal(np.asarray(x), 0.0)
+
+
+class TestALS:
+    def test_one_iteration_matches_numpy_oracle(self):
+        """One full ALS round equals the sequential numpy normal-equation
+        solve (the math MLlib implements per block)."""
+        rng = np.random.default_rng(2)
+        nu, ni, k, e = 8, 7, 3, 60
+        users = rng.integers(0, nu, e)
+        items = rng.integers(0, ni, e)
+        vals = rng.normal(size=e).astype(np.float32)
+        lam = 0.1
+
+        cfg = ALSConfig(num_factors=k, lambda_=lam, iterations=1,
+                        chunk_size=4, seed=0)
+        solver = ALS(cfg)
+        model = solver.fit(Ratings.from_arrays(users, items, vals))
+
+        # oracle in ROW space (use the model's own id->row mapping and init)
+        u_rows, _ = model.users.rows_for(users)
+        i_rows, _ = model.items.rows_for(items)
+        uidx, iidx = model.users, model.items
+        _, V0 = solver._init_factors(uidx, iidx)
+        V0 = np.asarray(V0, dtype=np.float64)
+        tri_u = np.stack([u_rows, i_rows, vals.astype(np.float64)], axis=1)
+        U1 = numpy_als_half_step(tri_u, V0, uidx.num_rows, lam)
+        tri_i = np.stack([i_rows, u_rows, vals.astype(np.float64)], axis=1)
+        V1 = numpy_als_half_step(tri_i, U1, iidx.num_rows, lam)
+
+        np.testing.assert_allclose(np.asarray(model.U), U1, rtol=2e-3,
+                                   atol=2e-4)
+        np.testing.assert_allclose(np.asarray(model.V), V1, rtol=2e-3,
+                                   atol=2e-4)
+
+    def test_converges_on_planted_model(self):
+        gen = SyntheticMFGenerator(num_users=120, num_items=80, rank=5,
+                                   noise=0.05, seed=3)
+        train = gen.generate(12000)
+        test = gen.generate(3000)
+        model = ALS(ALSConfig(num_factors=8, lambda_=0.05, iterations=8,
+                              chunk_size=1024)).fit(train)
+        assert model.rmse(test) < 0.12
+
+    def test_als_wr_mode_runs_and_converges(self):
+        gen = SyntheticMFGenerator(num_users=60, num_items=50, rank=4,
+                                   noise=0.1, seed=4)
+        model = ALS(ALSConfig(num_factors=6, lambda_=0.02, iterations=6,
+                              reg_mode="als_wr", chunk_size=512)).fit(
+            gen.generate(6000))
+        assert model.rmse(gen.generate(1000)) < 0.3
+
+    def test_errors(self):
+        with pytest.raises(ValueError):
+            ALS().fit(Ratings.from_arrays([], [], []))
+        with pytest.raises(RuntimeError):
+            ALS().predict([1], [1])
+
+    def test_deterministic(self):
+        gen = SyntheticMFGenerator(num_users=30, num_items=30, rank=3,
+                                   noise=0.1, seed=5)
+        r = gen.generate(2000)
+        m1 = ALS(ALSConfig(num_factors=4, iterations=3, chunk_size=256)).fit(r)
+        m2 = ALS(ALSConfig(num_factors=4, iterations=3, chunk_size=256)).fit(r)
+        np.testing.assert_array_equal(np.asarray(m1.U), np.asarray(m2.U))
+
+
+class TestMeshALS:
+    @pytest.mark.parametrize("n_dev", [4, 8])
+    def test_matches_single_device(self, n_dev):
+        """Mesh ALS ≡ single-device ALS up to float tolerance — the
+        distribution is communication-only (all_gather), the math is
+        identical."""
+        from large_scale_recommendation_tpu.parallel.als_mesh import MeshALS
+        from large_scale_recommendation_tpu.parallel.mesh import make_block_mesh
+
+        if len(jax.devices()) < n_dev:
+            pytest.skip("not enough devices")
+        gen = SyntheticMFGenerator(num_users=64, num_items=48, rank=4,
+                                   noise=0.1, seed=6)
+        train = gen.generate(4000)
+        test = gen.generate(1000)
+        cfg = ALSConfig(num_factors=6, lambda_=0.05, iterations=4,
+                        chunk_size=128, seed=0)
+
+        mesh_model = MeshALS(cfg, mesh=make_block_mesh(n_dev)).fit(train)
+        single_model = ALS(cfg).fit(train)
+        # Same seed → same id layout modulo blocking; compare via RMSE and
+        # via per-id factor lookup.
+        r_mesh = mesh_model.rmse(test)
+        r_single = single_model.rmse(test)
+        assert abs(r_mesh - r_single) < 2e-2, (r_mesh, r_single)
+        assert r_mesh < 0.4
+
+    def test_mesh_als_converges(self):
+        from large_scale_recommendation_tpu.parallel.als_mesh import MeshALS
+        from large_scale_recommendation_tpu.parallel.mesh import make_block_mesh
+
+        gen = SyntheticMFGenerator(num_users=96, num_items=64, rank=4,
+                                   noise=0.05, seed=7)
+        model = MeshALS(
+            ALSConfig(num_factors=8, lambda_=0.05, iterations=6,
+                      chunk_size=256),
+            mesh=make_block_mesh(4),
+        ).fit(gen.generate(8000))
+        assert model.rmse(gen.generate(2000)) < 0.12
