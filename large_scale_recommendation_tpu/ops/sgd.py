@@ -198,8 +198,14 @@ def online_train(
     minibatch: int,
     iterations: int = 1,
     collision: str = "mean",
+    t0: jax.Array | int = 0,
 ) -> tuple[jax.Array, jax.Array]:
     """Online micro-batch update: sweep one micro-batch ``iterations`` times.
+
+    ``t0`` lets callers that invoke this repeatedly (streaming drivers, PS
+    epoch loops) advance a decaying learning-rate schedule across calls —
+    async-PS convergence leans on η/√t decay exactly like the reference DSGD
+    default (DSGDforMF.scala:118).
 
     ≙ the online inner loops — one ``nextFactors`` application per arriving
     rating (FlinkOnlineMF.scala:125-136; OnlineSpark.scala:76-78 runs exactly
@@ -207,8 +213,9 @@ def online_train(
     via ``lax.scan``. No omegas: the online paths use the plain ``SGDUpdater``
     rule (unregularized, FactorUpdater.scala:35-53); regularized updaters
     receive omega=None and fall back to plain λ. Sweep ``s`` (0-based) runs at
-    schedule step ``t = s + 1`` so decaying schedules advance per sweep (the
-    same t convention as ``dsgd_train``).
+    schedule step ``t = t0 + s + 1`` (the same t convention as
+    ``dsgd_train``), so decaying schedules advance per sweep within a call
+    and across calls via ``t0``.
     """
     e = u_rows.shape[0]
     assert e % minibatch == 0, (
@@ -225,7 +232,9 @@ def online_train(
         return (U, V), None
 
     (U, V), _ = jax.lax.scan(
-        sweep, (U, V), jnp.arange(1, iterations + 1, dtype=jnp.int32)
+        sweep, (U, V),
+        jnp.asarray(t0, jnp.int32) + jnp.arange(1, iterations + 1,
+                                                dtype=jnp.int32),
     )
     return U, V
 

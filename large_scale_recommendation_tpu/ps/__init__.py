@@ -1,0 +1,37 @@
+"""Parameter-server execution mode.
+
+TPU-native rebuild of the reference's generic asynchronous parameter server
+(reference: flink-adaptive-recom/.../ps/FlinkPS.scala and subpackages —
+SURVEY §2 components C7-C12). The reference builds the PS as a cyclic Flink
+streaming topology (worker CoFlatMap ↔ PS FlatMap connected through a
+streaming iteration, FlinkPS.scala:108-244); here the same roles exist as
+host-side threads + queues (the runtime glue) around jitted device kernels
+(the math), with parameter shards living on device as growable tables.
+
+Modules:
+- ``core``      — the trait family: client / worker logic / server logic
+                  (≙ FlinkPS.scala:12-106, C7) and wire entities (C9)
+- ``server``    — default server logic + sharded server (≙ SimplePSLogic,
+                  C11, and the id%P shard routing, C8/FlinkPS.scala:185-189)
+- ``transform`` — ``ps_transform``: wires workers and PS shards into a
+                  running async topology (≙ psTransform, C8)
+- ``mf``        — PS-based offline matrix factorization driver
+                  (≙ PSOfflineMF.scala, C12)
+"""
+
+from large_scale_recommendation_tpu.ps.core import (
+    ParameterServerClient,
+    ParameterServerLogic,
+    WorkerLogic,
+)
+from large_scale_recommendation_tpu.ps.server import SimplePSLogic
+from large_scale_recommendation_tpu.ps.transform import PSTopology, ps_transform
+
+__all__ = [
+    "ParameterServerClient",
+    "ParameterServerLogic",
+    "WorkerLogic",
+    "SimplePSLogic",
+    "PSTopology",
+    "ps_transform",
+]

@@ -1,0 +1,121 @@
+"""PS trait family: the pluggable client / worker / server seam.
+
+≙ the reference's trait definitions (reference: ps/FlinkPS.scala:12-106):
+
+- ``ParameterServerClient`` {pull, push, output}           (:12-19)
+- ``WorkerLogic``          {onRecv, onPullRecv, close}     (:31-38)
+- ``ParameterServerLogic`` {onPullRecv, onPushRecv}        (:67-72)
+
+Design departure: the reference's contracts are per-element (one pull id, one
+push (id, delta) at a time) because elements flow one-by-one through Flink
+channels. Here every method is **batched over id arrays** so a worker's
+device kernel amortizes one gather/scatter per chunk — the per-element form
+is the degenerate length-1 array.
+
+The codec layer (ClientReceiver/ClientSender/PSReceiver/PSSender,
+FlinkPS.scala:21-29,61-65,80-85 and ps/client|server/*, C10) exists in the
+reference to translate between logical events and wire envelopes; in-process
+queues need no wire format, so the codec seam collapses into the plain
+``PullRequest``/``PushRequest``/``PullAnswer`` message dataclasses below
+(≙ the ``WorkerOut``/``WorkerIn`` entities, ps/entities/Messages.scala:3-4,
+C9).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Protocol, runtime_checkable
+
+import numpy as np
+
+
+# -- wire entities (≙ ps/entities/Messages.scala:3-4, C9) -------------------
+
+
+@dataclasses.dataclass
+class PullRequest:
+    """Worker → PS: request parameter rows.
+    ≙ ``WorkerOut(partitionId, Left(pullId))``.
+
+    ``request_id`` ties shard-level sub-requests back to the worker's one
+    logical pull so partial answers can be reassembled (a logical pull may
+    span several PS shards; the reference never batches ids so its pulls are
+    trivially single-shard)."""
+
+    worker_id: int
+    ids: np.ndarray  # int64[n] parameter ids
+    request_id: int = -1
+
+
+@dataclasses.dataclass
+class PushRequest:
+    """Worker → PS: additive deltas for parameter rows.
+    ≙ ``WorkerOut(partitionId, Right((pushId, P)))``."""
+
+    worker_id: int
+    ids: np.ndarray
+    deltas: np.ndarray  # float32[n, rank]
+
+
+@dataclasses.dataclass
+class PullAnswer:
+    """PS → worker: the requested rows.
+    ≙ ``WorkerIn(id, workerPartitionIndex, P)``.
+
+    Worker logic always receives a COMPLETE answer whose ids equal the
+    original pull's ids in order; shard-level parts are reassembled by the
+    client before delivery."""
+
+    ids: np.ndarray
+    values: np.ndarray  # float32[n, rank]
+    request_id: int = -1
+
+
+# -- traits -----------------------------------------------------------------
+
+
+@runtime_checkable
+class ParameterServerClient(Protocol):
+    """What a worker logic sees. ≙ ``ParameterServerClient[P]``
+    (FlinkPS.scala:12-19)."""
+
+    def pull(self, ids: np.ndarray) -> None: ...
+
+    def push(self, ids: np.ndarray, deltas: np.ndarray) -> None: ...
+
+    def output(self, value: Any) -> None: ...
+
+
+class WorkerLogic(Protocol):
+    """Worker-side behavior. ≙ ``WorkerLogic[T, P, WOut]``
+    (FlinkPS.scala:31-38)."""
+
+    def on_recv(self, data: Any, ps: ParameterServerClient) -> None:
+        """A data element arrived from the input stream."""
+        ...
+
+    def on_pull_answer(self, answer: PullAnswer,
+                       ps: ParameterServerClient) -> None:
+        """≙ ``onPullRecv(paramId, paramValue, ps)``."""
+        ...
+
+    def close(self, ps: ParameterServerClient) -> None:
+        """Input exhausted and all in-flight answers drained.
+        ≙ ``close()`` (FlinkPS.scala:37; PSOfflineMF.scala:270-275)."""
+        ...
+
+
+class ParameterServerLogic(Protocol):
+    """Server-side behavior. ≙ ``ParameterServerLogic[P, PSOut]``
+    (FlinkPS.scala:67-72)."""
+
+    def on_pull(self, ids: np.ndarray) -> np.ndarray:
+        """Return values for ids (initializing unseen ones).
+        ≙ ``onPullRecv`` answering through ``ps.answerPull``."""
+        ...
+
+    def on_push(self, ids: np.ndarray, deltas: np.ndarray,
+                outputs: list) -> None:
+        """Apply deltas; append any (id, new_value) emissions to outputs.
+        ≙ ``onPushRecv`` emitting via ``ps.output``."""
+        ...

@@ -1,0 +1,269 @@
+"""PS-based offline matrix factorization.
+
+≙ the reference driver (reference: flink-adaptive-recom/.../mf/
+PSOfflineMF.scala:35-331, C12): users are partitioned to workers
+(``user % workerParallelism``, :70-72), item factors live on the parameter
+server sharded by ``item % psParallelism`` (:281-286). Workers buffer their
+rating shard; when input ends they train for ``iterations`` epochs: pull item
+vectors (bounded in-flight window = ``pullLimit``), update their local user
+vectors and push item deltas; the server merges deltas additively
+(:277-279).
+
+Differences from the reference, deliberate:
+- The pull unit is an **item chunk**, not a single rating — the reference's
+  per-item batched worker variant (``workerLogic``, PSOfflineMF.scala:78-174
+  — dead code there because :292 passes workerLogic2; resurrected here
+  because chunked pulls are what lets the device kernel amortize
+  gather/scatter). Per-chunk updates run through the jitted online kernel on
+  the worker's local user table.
+- Epoch reshuffle actually happens (the reference's
+  ``Random.shuffle(rs)`` discards its result — SURVEY §2.4; we shuffle the
+  chunk order per epoch, seeded).
+- The final model comes back as plain dicts from worker outputs + server
+  snapshot instead of log-line dumps (``###PS###u;id;[v]``,
+  PSOfflineMF.scala:270-275) and the stream-close collector (:302-329).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from large_scale_recommendation_tpu.core.initializers import (
+    PseudoRandomFactorInitializer,
+)
+from large_scale_recommendation_tpu.core.types import Ratings
+from large_scale_recommendation_tpu.core.updaters import SGDUpdater
+from large_scale_recommendation_tpu.data.tables import GrowableFactorTable
+from large_scale_recommendation_tpu.ops import sgd as sgd_ops
+from large_scale_recommendation_tpu.ps.core import PullAnswer
+from large_scale_recommendation_tpu.ps.server import (
+    ShardedParameterStore,
+    SimplePSLogic,
+)
+from large_scale_recommendation_tpu.ps.transform import ps_transform
+
+
+@dataclasses.dataclass(frozen=True)
+class PSOfflineMFConfig:
+    """≙ the ``offline(...)`` parameter list (PSOfflineMF.scala:41-49) —
+    including the learningRate the reference mistyped as Int (SURVEY §2.4)."""
+
+    num_factors: int = 10
+    iterations: int = 10
+    learning_rate: float = 0.01
+    lr_schedule: str = "inverse_sqrt"  # decay over epochs — async-PS pushes
+    # from stale pulls oscillate under a constant step (≙ the reference DSGD
+    # default η/√t, DSGDforMF.scala:118)
+    worker_parallelism: int = 4
+    ps_parallelism: int = 4
+    pull_limit: int | None = 4  # in-flight item-chunk window per worker
+    chunk_size: int = 512  # items per pull
+    minibatch_size: int = 256
+    seed: int = 0
+    init_scale: float = 0.1
+
+
+class _MFWorkerLogic:
+    """≙ the per-item batched worker (PSOfflineMF.scala:78-174): buffer
+    ratings per item; per epoch pull each item chunk, update local users,
+    push item deltas."""
+
+    def __init__(self, cfg: PSOfflineMFConfig, worker_id: int):
+        self.cfg = cfg
+        init = PseudoRandomFactorInitializer(cfg.num_factors,
+                                             scale=cfg.init_scale)
+        self.users = GrowableFactorTable(init)
+        self._by_item: dict[int, list[tuple[int, float]]] = {}
+        self._epoch = 0
+        self._chunks: list[np.ndarray] = []
+        self._answered_in_epoch = 0
+        self._rng = np.random.default_rng(cfg.seed + 31 * worker_id)
+        from large_scale_recommendation_tpu.core.updaters import (
+            constant_lr,
+            inverse_sqrt_lr,
+        )
+
+        sched = (inverse_sqrt_lr if cfg.lr_schedule == "inverse_sqrt"
+                 else constant_lr)
+        self.updater = SGDUpdater(learning_rate=cfg.learning_rate,
+                                  schedule=sched)
+
+    # -- WorkerLogic ---------------------------------------------------------
+
+    def on_recv(self, data, ps) -> None:
+        """Buffer the rating (≙ rs.append, PSOfflineMF.scala:238-247)."""
+        user, item, value = data
+        self._by_item.setdefault(int(item), []).append((int(user), float(value)))
+
+    def on_input_end(self, ps) -> None:
+        """All input seen: start epoch 0 (≙ the all-EOF-markers trigger
+        spawning the training thread, PSOfflineMF.scala:99-134,202-236)."""
+        if not self._by_item:
+            return
+        items = np.asarray(sorted(self._by_item), dtype=np.int64)
+        # near-equal chunk sizes (≤2 distinct lengths) to bound the number
+        # of compiled kernel variants
+        n_chunks = max(1, -(-len(items) // self.cfg.chunk_size))
+        self._chunks = np.array_split(items, n_chunks)
+        self._issue_epoch(ps)
+
+    def _issue_epoch(self, ps) -> None:
+        order = self._rng.permutation(len(self._chunks))
+        self._answered_in_epoch = 0
+        for c in order:
+            ps.pull(self._chunks[c])
+
+    def on_pull_answer(self, answer: PullAnswer, ps) -> None:
+        """≙ onPullRecv: update user vectors, push item deltas
+        (PSOfflineMF.scala:250-268), batched over the chunk."""
+        cfg = self.cfg
+        items, V_chunk = answer.ids, answer.values
+        pos_of = {int(i): p for p, i in enumerate(items.tolist())}
+        us, ips, vals = [], [], []
+        for item in items.tolist():
+            for (user, value) in self._by_item[int(item)]:
+                us.append(user)
+                ips.append(pos_of[item])
+                vals.append(value)
+        # shuffle: item-grouped order maximizes same-row minibatch
+        # collisions (≙ the reference's intended-but-broken per-epoch
+        # reshuffle, SURVEY §2.4)
+        perm = self._rng.permutation(len(us))
+        us = np.asarray(us, dtype=np.int64)[perm]
+        ips = np.asarray(ips, dtype=np.int64)[perm]
+        vals = np.asarray(vals, dtype=np.float32)[perm]
+        u_rows = self.users.ensure(us)
+
+        # fixed minibatch + power-of-2 chunk-count bucketing: the padded
+        # length takes O(log nnz) distinct values, so the jitted kernel
+        # compiles a bounded number of variants instead of one per chunk size
+        n = len(us)
+        mb = cfg.minibatch_size
+        n_mb = max(1, -(-n // mb))
+        bucket = 1
+        while bucket < n_mb:
+            bucket <<= 1
+        padded = bucket * mb
+        ur = np.zeros(padded, np.int32)
+        ir = np.zeros(padded, np.int32)
+        rv = np.zeros(padded, np.float32)
+        w = np.zeros(padded, np.float32)
+        ur[:n], ir[:n], rv[:n], w[:n] = u_rows, ips, vals, 1.0
+
+        V_old = jnp.asarray(V_chunk, dtype=jnp.float32)
+        U_new, V_new = sgd_ops.online_train(
+            self.users.array, V_old,
+            jnp.asarray(ur), jnp.asarray(ir), jnp.asarray(rv), jnp.asarray(w),
+            updater=self.updater, minibatch=mb, iterations=1,
+            t0=self._epoch,  # advance the η/√t schedule across epochs
+        )
+        self.users.array = U_new
+        # W workers push a full local update for the same item computed from
+        # the same (stale) pulled value each epoch — averaging keeps the
+        # combined item step at the intended magnitude (the user side is
+        # worker-exclusive and needs no scaling).
+        deltas = np.asarray(V_new - V_old) / cfg.worker_parallelism
+        ps.push(items, deltas)
+
+        self._answered_in_epoch += 1
+        if self._answered_in_epoch == len(self._chunks):
+            self._epoch += 1
+            if self._epoch < cfg.iterations:
+                self._issue_epoch(ps)
+
+    def close(self, ps) -> None:
+        """Emit the final user vectors (≙ the close() model dump,
+        PSOfflineMF.scala:270-275)."""
+        for fv in self.users.factor_vectors():
+            ps.output((fv.id, fv.factors))
+
+
+class PSOfflineMF:
+    """PS-mode offline MF. ≙ ``PSOfflineMatrixFactorization.offline(...)``
+    (PSOfflineMF.scala:41-49)."""
+
+    def __init__(self, config: PSOfflineMFConfig | None = None):
+        self.config = config or PSOfflineMFConfig()
+        self.user_factors: dict[int, np.ndarray] = {}
+        self.item_factors: dict[int, np.ndarray] = {}
+
+    def offline(self, ratings: Ratings) -> tuple[dict, dict]:
+        cfg = self.config
+        ru, ri, rv, rw = ratings.to_numpy()
+        real = rw > 0
+        ru, ri, rv = ru[real], ri[real], rv[real]
+        if len(ru) == 0:
+            raise ValueError("cannot fit on an empty ratings set")
+
+        # ≙ partition by user % workerParallelism (PSOfflineMF.scala:70-72)
+        shard = np.abs(ru) % cfg.worker_parallelism
+        inputs = [
+            list(zip(ru[shard == w].tolist(), ri[shard == w].tolist(),
+                     rv[shard == w].tolist()))
+            for w in range(cfg.worker_parallelism)
+        ]
+        workers = [_MFWorkerLogic(cfg, w)
+                   for w in range(cfg.worker_parallelism)]
+        init = PseudoRandomFactorInitializer(cfg.num_factors,
+                                             scale=cfg.init_scale)
+        import jax
+
+        devices = jax.local_devices()
+        store = ShardedParameterStore(
+            # one device per PS shard, round-robin — ≙ one task slot per PS
+            # operator instance (FlinkPS.scala:208)
+            lambda p: SimplePSLogic(init, emit_updates=False,
+                                    device=devices[p % len(devices)]),
+            cfg.ps_parallelism,
+        )
+        worker_outs, _ = ps_transform(
+            inputs, workers, store, pull_limit=cfg.pull_limit,
+        )
+
+        self.user_factors = {i: v for out in worker_outs for (i, v) in out}
+        self.item_factors = store.snapshot()
+        return self.user_factors, self.item_factors
+
+    # -- scoring -------------------------------------------------------------
+
+    @staticmethod
+    def _lookup(table: dict[int, np.ndarray], ids: np.ndarray,
+                rank: int) -> tuple[np.ndarray, np.ndarray]:
+        """Vectorized dict → (vectors, found mask) via sorted binary search."""
+        keys = np.fromiter(table.keys(), dtype=np.int64, count=len(table))
+        order = np.argsort(keys)
+        keys = keys[order]
+        mat = np.stack([table[int(k)] for k in keys]) if len(keys) else \
+            np.zeros((0, rank), np.float32)
+        pos = np.clip(np.searchsorted(keys, ids), 0, max(len(keys) - 1, 0))
+        found = (keys[pos] == ids) if len(keys) else np.zeros(len(ids), bool)
+        vecs = mat[pos] if len(keys) else np.zeros((len(ids), rank), np.float32)
+        return vecs, found
+
+    def predict(self, user_ids, item_ids) -> np.ndarray:
+        """Pairs with an unseen user OR item score 0 (MFModel.predict
+        semantics)."""
+        user_ids = np.asarray(user_ids, dtype=np.int64)
+        item_ids = np.asarray(item_ids, dtype=np.int64)
+        rank = self.config.num_factors
+        uu, u_ok = self._lookup(self.user_factors, user_ids, rank)
+        vv, i_ok = self._lookup(self.item_factors, item_ids, rank)
+        return np.einsum("nk,nk->n", uu, vv) * u_ok * i_ok
+
+    def rmse(self, data: Ratings) -> float:
+        ru, ri, rv, rw = data.to_numpy()
+        real = rw > 0
+        ru, ri, rv = ru[real], ri[real], rv[real]
+        rank = self.config.num_factors
+        uu, u_ok = self._lookup(self.user_factors, np.asarray(ru, np.int64),
+                                rank)
+        vv, i_ok = self._lookup(self.item_factors, np.asarray(ri, np.int64),
+                                rank)
+        known = u_ok & i_ok
+        if not known.any():
+            return float("nan")
+        res = rv[known] - np.einsum("nk,nk->n", uu[known], vv[known])
+        return float(np.sqrt(np.mean(res * res)))

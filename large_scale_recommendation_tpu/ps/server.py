@@ -1,0 +1,99 @@
+"""PS server logics: device-table parameter shards.
+
+≙ the reference's default server logic (reference:
+ps/server/SimplePSLogic.scala:7-27): an in-memory map with
+pull → ``getOrElseUpdate(init)`` and push → ``update(old, delta)`` + emit
+``(id, newValue)``. Here the shard's storage is a ``GrowableFactorTable`` —
+a dense device array with getOrElseUpdate semantics — so pull answers are
+device gathers and pushes are one scatter-add per request batch.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from large_scale_recommendation_tpu.core.initializers import FactorInitializer
+from large_scale_recommendation_tpu.data.tables import GrowableFactorTable
+
+
+class SimplePSLogic:
+    """Default parameter shard: pull-initializes, push adds deltas.
+
+    ≙ ``SimplePSLogic(init, update)`` (SimplePSLogic.scala:7-27) with the
+    add-delta merge the MF driver uses (PSOfflineMF.scala:277-279).
+    ``emit_updates`` controls whether pushes emit (id, new_value) outputs
+    (the reference always emits; the offline driver ignores them until the
+    end, so skipping the device→host readback per push is a big win).
+    """
+
+    def __init__(
+        self,
+        initializer: FactorInitializer,
+        update: Callable[[jax.Array, jax.Array], jax.Array] | None = None,
+        emit_updates: bool = True,
+        device=None,
+    ):
+        put = (lambda x: jax.device_put(x, device)) if device is not None else None
+        self.table = GrowableFactorTable(initializer, device_put=put)
+        self._update = update  # None → add (vec + delta)
+        self.emit_updates = emit_updates
+
+    def on_pull(self, ids: np.ndarray) -> np.ndarray:
+        """pull → getOrElseUpdate(init) gather (SimplePSLogic.scala:13-18)."""
+        rows = self.table.ensure(ids)
+        return np.asarray(self.table.array[jnp.asarray(rows)])
+
+    def on_push(self, ids: np.ndarray, deltas: np.ndarray,
+                outputs: list) -> None:
+        """push → merge delta, optionally emit (id, newValue)
+        (SimplePSLogic.scala:20-24).
+
+        Unlike the reference, pushing an id never pulled is allowed (the
+        reference throws, SimplePSLogic.scala:22) — ``ensure`` just
+        initializes it; the stricter protocol buys nothing on device."""
+        rows = self.table.ensure(ids)
+        jrows = jnp.asarray(rows)
+        jdeltas = jnp.asarray(deltas, dtype=jnp.float32)
+        if self._update is None:
+            self.table.array = self.table.array.at[jrows].add(jdeltas)
+        else:
+            old = self.table.array[jrows]
+            self.table.array = self.table.array.at[jrows].set(
+                self._update(old, jdeltas)
+            )
+        if self.emit_updates:
+            new = np.asarray(self.table.array[jrows])
+            outputs.extend(
+                (int(i), new[j]) for j, i in enumerate(ids.tolist())
+            )
+
+    def snapshot(self) -> dict[int, np.ndarray]:
+        return self.table.as_dict()
+
+
+class ShardedParameterStore:
+    """Routes ids to ``ps_parallelism`` shards by ``id % P``.
+
+    ≙ the worker→PS hash partitioner (FlinkPS.scala:185-189 /
+    PSOfflineMF.scala:281-286 ``abs(id) % psParallelism``). Device placement
+    is the caller's choice: ``make_logic(p)`` receives the shard index so it
+    can pass ``SimplePSLogic(device=...)`` to spread shards over local
+    devices (as ``PSOfflineMF`` does)."""
+
+    def __init__(self, make_logic: Callable[[int], SimplePSLogic],
+                 ps_parallelism: int):
+        self.shards = [make_logic(p) for p in range(ps_parallelism)]
+        self.ps_parallelism = ps_parallelism
+
+    def shard_of(self, ids: np.ndarray) -> np.ndarray:
+        return np.abs(ids) % self.ps_parallelism
+
+    def snapshot(self) -> dict[int, np.ndarray]:
+        out: dict[int, np.ndarray] = {}
+        for s in self.shards:
+            out.update(s.snapshot())
+        return out

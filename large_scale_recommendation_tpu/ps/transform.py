@@ -1,0 +1,293 @@
+"""ps_transform: wire workers and PS shards into a running async topology.
+
+≙ the reference's ``FlinkPS.psTransform`` (reference: ps/FlinkPS.scala:
+108-244), which builds a cyclic Flink streaming topology: worker CoFlatMaps
+(parallelism=workerParallelism) exchange messages with PS FlatMaps
+(parallelism=psParallelism) through a streaming iteration, with worker→PS
+traffic hash-partitioned by param id (:185-189) and PS→worker answers routed
+by worker partition index (:217-225).
+
+Here the topology is host threads + queues:
+
+- one thread per worker, consuming a tagged queue of input data and pull
+  answers (≙ the CoFlatMap's two input streams, :135-173);
+- one thread per PS shard, consuming pull/push requests
+  (≙ the PS FlatMap, :190-208); answers go back to the issuing worker's
+  queue (the feedback edge, :210-225);
+- worker/PS outputs collected into separate lists
+  (≙ the Either[WOut, PSOut] split, :227-236).
+
+Backpressure: each worker has a bounded in-flight pull window
+(``pull_limit``). The reference enforces it with a ReentrantLock+Condition
+and a dedicated sender thread so answer processing is never blocked
+(PSOfflineMF.scala:190-236); here ``pull()`` never blocks — requests park in
+a pending deque and a pump releases them as answers drain, which gives the
+same bounded-window semantics without the lock dance.
+
+Termination: deterministic — a worker finishes when its input is exhausted,
+its pending/in-flight windows are empty, and ``close`` has run; shards stop
+after all workers finish. The reference instead ends its cyclic stream by
+silence timeout (``iterationWaitTime``, FlinkPS.scala:123,242); the
+parameter is accepted for API parity and used as a join timeout.
+"""
+
+from __future__ import annotations
+
+import collections
+import queue
+import threading
+from typing import Any, Iterable, Sequence
+
+import numpy as np
+
+from large_scale_recommendation_tpu.ps.core import (
+    PullAnswer,
+    PullRequest,
+    PushRequest,
+    WorkerLogic,
+)
+from large_scale_recommendation_tpu.ps.server import ShardedParameterStore
+
+
+class _WorkerClient:
+    """The ``ParameterServerClient`` handed to worker logic
+    (≙ MessagingPSClient, FlinkPS.scala:40-57).
+
+    One logical ``pull(ids)`` counts as ONE in-flight unit regardless of how
+    many PS shards the ids span: sub-requests are tagged with a request id
+    and the partial answers reassembled (in original id order) before the
+    worker logic sees them.
+    """
+
+    def __init__(self, worker_id: int, topology: "PSTopology",
+                 pull_limit: int | None):
+        self._id = worker_id
+        self._topo = topology
+        self._pull_limit = pull_limit
+        self._pending: collections.deque[np.ndarray] = collections.deque()
+        self._in_flight = 0
+        self._next_req = 0
+        # request_id -> [original ids, parts remaining, id -> value row]
+        self._assembling: dict[int, list] = {}
+        self.outputs: list[Any] = []
+
+    # -- ParameterServerClient ----------------------------------------------
+
+    def pull(self, ids: np.ndarray) -> None:
+        """Non-blocking: parks the request; the pump sends it when the
+        in-flight window (≙ pullLimit, PSOfflineMF.scala:217-230) allows.
+        Ids within one pull must be unique (chunks are)."""
+        self._pending.append(np.asarray(ids, dtype=np.int64))
+        self._pump()
+
+    def push(self, ids: np.ndarray, deltas: np.ndarray) -> None:
+        self._topo._route_push(
+            PushRequest(self._id, np.asarray(ids, np.int64),
+                        np.asarray(deltas, np.float32))
+        )
+
+    def output(self, value: Any) -> None:
+        self.outputs.append(value)
+
+    # -- window pump + reassembly -------------------------------------------
+
+    def _pump(self) -> None:
+        while self._pending and (
+            self._pull_limit is None or self._in_flight < self._pull_limit
+        ):
+            ids = self._pending.popleft()
+            req = self._next_req
+            self._next_req += 1
+            self._in_flight += 1
+            n_parts = self._topo._route_pull(
+                PullRequest(self._id, ids, request_id=req)
+            )
+            self._assembling[req] = [ids, n_parts, {}]
+
+    def _on_answer_part(self, part) -> "PullAnswer | None":
+        """Merge a shard's partial answer; return the complete answer once
+        all parts arrived, else None."""
+        from large_scale_recommendation_tpu.ps.core import PullAnswer
+
+        slot = self._assembling[part.request_id]
+        ids, _, merged = slot
+        for j, ident in enumerate(part.ids.tolist()):
+            merged[ident] = part.values[j]
+        slot[1] -= 1
+        if slot[1] > 0:
+            return None
+        del self._assembling[part.request_id]
+        values = np.stack([merged[int(i)] for i in ids])
+        return PullAnswer(ids, values, request_id=part.request_id)
+
+    def _answer_processed(self) -> None:
+        self._in_flight -= 1
+        self._pump()
+
+    @property
+    def drained(self) -> bool:
+        return not self._pending and self._in_flight == 0
+
+
+_EOF = object()
+_STOP = object()
+
+
+class PSTopology:
+    """A running PS topology. Prefer the ``ps_transform`` entry point."""
+
+    def __init__(
+        self,
+        worker_logics: Sequence[WorkerLogic],
+        store: ShardedParameterStore,
+        pull_limit: int | None = None,
+    ):
+        self.workers = list(worker_logics)
+        self.store = store
+        self.pull_limit = pull_limit
+        self._worker_queues: list[queue.Queue] = [
+            queue.Queue() for _ in self.workers
+        ]
+        self._shard_queues: list[queue.Queue] = [
+            queue.Queue() for _ in store.shards
+        ]
+        self._clients = [
+            _WorkerClient(w, self, pull_limit) for w in range(len(self.workers))
+        ]
+        self.ps_outputs: list[Any] = []
+        self._ps_lock = threading.Lock()
+        self._errors: list[BaseException] = []
+
+    # -- routing (≙ partitionCustom by id, FlinkPS.scala:185-189) -----------
+
+    def _route_pull(self, req: PullRequest) -> int:
+        """Split one logical pull by shard; returns the number of parts (the
+        client tracks them for reassembly)."""
+        shards = self.store.shard_of(req.ids)
+        uniq = np.unique(shards)
+        for s in uniq:
+            m = shards == s
+            self._shard_queues[s].put(
+                PullRequest(req.worker_id, req.ids[m],
+                            request_id=req.request_id)
+            )
+        return len(uniq)
+
+    def _route_push(self, req: PushRequest) -> None:
+        shards = self.store.shard_of(req.ids)
+        for s in np.unique(shards):
+            m = shards == s
+            self._shard_queues[s].put(
+                PushRequest(req.worker_id, req.ids[m], req.deltas[m])
+            )
+
+    # -- threads -------------------------------------------------------------
+
+    def _worker_main(self, w: int, inputs: Iterable[Any]) -> None:
+        logic, client, q = self.workers[w], self._clients[w], \
+            self._worker_queues[w]
+        try:
+            for x in inputs:
+                logic.on_recv(x, client)
+                self._drain_answers(w)
+            hook = getattr(logic, "on_input_end", None)
+            if hook is not None:
+                hook(client)  # ≙ the all-EOFs-received trigger
+                # (PSOfflineMF.scala:99-134)
+            while not client.drained:
+                tag, payload = q.get()
+                self._handle_answer(w, payload)
+            logic.close(client)
+        except BaseException as e:  # surface worker crashes to run()
+            self._errors.append(e)
+
+    def _handle_answer(self, w: int, part) -> None:
+        client, logic = self._clients[w], self.workers[w]
+        answer = client._on_answer_part(part)
+        if answer is not None:
+            logic.on_pull_answer(answer, client)
+            client._answer_processed()
+
+    def _drain_answers(self, w: int) -> None:
+        q = self._worker_queues[w]
+        while True:
+            try:
+                tag, payload = q.get(block=False)
+            except queue.Empty:
+                return
+            self._handle_answer(w, payload)
+
+    def _shard_main(self, s: int) -> None:
+        logic, q = self.store.shards[s], self._shard_queues[s]
+        try:
+            while True:
+                req = q.get()
+                if req is _STOP:
+                    return
+                if isinstance(req, PullRequest):
+                    values = logic.on_pull(req.ids)
+                    self._worker_queues[req.worker_id].put(
+                        ("answer", PullAnswer(req.ids, values,
+                                              request_id=req.request_id))
+                    )
+                else:
+                    out: list = []
+                    logic.on_push(req.ids, req.deltas, out)
+                    if out:
+                        with self._ps_lock:
+                            self.ps_outputs.extend(out)
+        except BaseException as e:
+            self._errors.append(e)
+
+    # -- run ------------------------------------------------------------------
+
+    def run(
+        self,
+        worker_inputs: Sequence[Iterable[Any]],
+        timeout: float | None = None,
+    ) -> tuple[list[list[Any]], list[Any]]:
+        """Execute to completion. Returns (per-worker outputs, PS outputs)
+        — the two sides of the reference's Either split
+        (FlinkPS.scala:227-236)."""
+        assert len(worker_inputs) == len(self.workers)
+        shard_threads = [
+            threading.Thread(target=self._shard_main, args=(s,), daemon=True)
+            for s in range(len(self.store.shards))
+        ]
+        worker_threads = [
+            threading.Thread(target=self._worker_main, args=(w, inp),
+                             daemon=True)
+            for w, inp in enumerate(worker_inputs)
+        ]
+        for t in shard_threads + worker_threads:
+            t.start()
+        for t in worker_threads:
+            t.join(timeout)
+            if t.is_alive():
+                raise TimeoutError("PS worker did not finish "
+                                   f"(iteration_wait_time={timeout})")
+        for q in self._shard_queues:
+            q.put(_STOP)
+        for t in shard_threads:
+            t.join(timeout)
+        if self._errors:
+            raise self._errors[0]
+        return [c.outputs for c in self._clients], self.ps_outputs
+
+
+def ps_transform(
+    worker_inputs: Sequence[Iterable[Any]],
+    worker_logics: Sequence[WorkerLogic],
+    store: ShardedParameterStore,
+    pull_limit: int | None = None,
+    iteration_wait_time: float | None = None,
+) -> tuple[list[list[Any]], list[Any]]:
+    """One-shot topology build + run.
+
+    ≙ ``FlinkPS.psTransform(xs, workerLogic, psLogic, ..., workerParallelism,
+    psParallelism, iterationWaitTime)`` (FlinkPS.scala:112-131):
+    ``len(worker_logics)`` = workerParallelism, ``store.ps_parallelism`` =
+    psParallelism.
+    """
+    topo = PSTopology(worker_logics, store, pull_limit)
+    return topo.run(worker_inputs, timeout=iteration_wait_time)
