@@ -72,6 +72,9 @@ class AdaptiveMFConfig:
     lambda_: float = 0.1
     background: bool = False  # retrain on a thread (≙ concurrent batch mode)
     history_limit: int | None = None  # cap history rows (None = unbounded)
+    checkpoint_every: int | None = None  # snapshot online state each N batches
+    checkpoint_dir: str | None = None  # ≙ checkpointEvery lineage truncation
+    # (OnlineSpark.scala:30,93-99)
 
 
 class AdaptiveMF:
@@ -98,6 +101,14 @@ class AdaptiveMF:
         self._thread: threading.Thread | None = None
         self._retrained: MFModel | None = None
         self._buffer: list[Ratings] = []
+        self._manager = None
+        if cfg.checkpoint_dir is not None:
+            from large_scale_recommendation_tpu.utils.checkpoint import (
+                CheckpointManager,
+            )
+
+            self._manager = CheckpointManager(cfg.checkpoint_dir)
+        self._batches_since_ckpt = 0
 
     # -- state -------------------------------------------------------------
 
@@ -129,10 +140,38 @@ class AdaptiveMF:
 
         out = self.online.partial_fit(batch)
         self._batches_since_retrain += 1
+        self._maybe_checkpoint()
         if (cfg.offline_every is not None
                 and self._batches_since_retrain >= cfg.offline_every):
             self.trigger_batch_training()
         return out
+
+    def _maybe_checkpoint(self) -> None:
+        """≙ the lineage-truncation snapshot every ``checkpointEvery``
+        micro-batches (OnlineSpark.scala:93-99,205-212)."""
+        cfg = self.config
+        if self._manager is None or cfg.checkpoint_every is None:
+            return
+        self._batches_since_ckpt += 1
+        if self._batches_since_ckpt >= cfg.checkpoint_every:
+            from large_scale_recommendation_tpu.utils.checkpoint import (
+                save_online_state,
+            )
+
+            save_online_state(self._manager, self.online, self.online.step)
+            self._batches_since_ckpt = 0
+
+    def resume(self) -> bool:
+        """Restore the latest online-state snapshot, if any. Returns whether
+        a snapshot was loaded."""
+        if self._manager is None or self._manager.latest_step() is None:
+            return False
+        from large_scale_recommendation_tpu.utils.checkpoint import (
+            restore_online_state,
+        )
+
+        restore_online_state(self._manager, self.online)
+        return True
 
     def trigger_batch_training(self) -> None:
         """Start a full retrain from history.

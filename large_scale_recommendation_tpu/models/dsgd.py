@@ -78,7 +78,20 @@ class DSGD:
 
     # -- fit ---------------------------------------------------------------
 
-    def fit(self, ratings: Ratings, num_blocks: int | None = None) -> MFModel:
+    def fit(
+        self,
+        ratings: Ratings,
+        num_blocks: int | None = None,
+        checkpoint_manager=None,
+        checkpoint_every: int | None = None,
+        resume: bool = False,
+    ) -> MFModel:
+        """Train. With ``checkpoint_manager`` + ``checkpoint_every``, the
+        jitted loop runs in segments of that many iterations with a durable
+        snapshot at each boundary (≙ the TemporaryPath persistence barriers,
+        DSGDforMF.scala:291-296 — ours also restart: ``resume=True`` picks
+        up from the latest snapshot, valid because blocking is deterministic
+        given the same ratings + seed)."""
         cfg = self.config
         if ratings.n == 0:
             raise ValueError("cannot fit on an empty ratings set")
@@ -97,23 +110,51 @@ class DSGD:
         )
         U, V = self._init_factors(problem)
 
-        # Module-level jitted train fn: stable function object + hashable
-        # static args (frozen-dataclass updater) → refits with the same
-        # shapes/config hit the XLA compile cache.
-        U, V = sgd_ops.dsgd_train(
-            U, V,
+        done = 0
+        if resume:
+            if checkpoint_manager is None:
+                raise ValueError("resume=True requires a checkpoint_manager")
+            latest = checkpoint_manager.latest_step()
+            if latest is not None:
+                ck = checkpoint_manager.restore(latest)
+                if ck["U"].shape != U.shape or ck["V"].shape != V.shape:
+                    raise ValueError(
+                        "checkpoint shape mismatch — resumed fit must use "
+                        "the same ratings, seed, rank and block count"
+                    )
+                U, V = jnp.asarray(ck["U"]), jnp.asarray(ck["V"])
+                done = latest
+
+        args = (
             jnp.asarray(problem.ratings.u_rows, jnp.int32),
             jnp.asarray(problem.ratings.i_rows, jnp.int32),
             jnp.asarray(problem.ratings.values, jnp.float32),
             jnp.asarray(problem.ratings.weights, jnp.float32),
             jnp.asarray(problem.users.omega),
             jnp.asarray(problem.items.omega),
-            updater=self.updater,
-            minibatch=cfg.minibatch_size,
-            num_blocks=k,
-            iterations=cfg.iterations,
-            collision=cfg.collision_mode,
         )
+        segment = checkpoint_every or cfg.iterations
+
+        # Module-level jitted train fn: stable function object + hashable
+        # static args (frozen-dataclass updater) → refits/segments with the
+        # same shapes/config hit the XLA compile cache.
+        while done < cfg.iterations:
+            seg = min(segment, cfg.iterations - done)
+            U, V = sgd_ops.dsgd_train(
+                U, V, *args,
+                updater=self.updater,
+                minibatch=cfg.minibatch_size,
+                num_blocks=k,
+                iterations=seg,
+                collision=cfg.collision_mode,
+                t0=done,
+            )
+            done += seg
+            if checkpoint_manager is not None:
+                checkpoint_manager.save(
+                    done, {"U": np.asarray(U), "V": np.asarray(V)},
+                    {"kind": "dsgd_segment", "iterations": cfg.iterations},
+                )
         self.model = MFModel(U=U, V=V, users=problem.users, items=problem.items)
         return self.model
 

@@ -145,8 +145,13 @@ def dsgd_train(
     num_blocks: int,
     iterations: int,
     collision: str = "mean",
+    t0: jax.Array | int = 0,
 ) -> tuple[jax.Array, jax.Array]:
     """Full single-device DSGD training loop as ONE jitted computation.
+
+    ``t0`` is the number of iterations already completed — segmented runs
+    (checkpoint boundaries, utils.checkpoint) pass it so the η/√t schedule
+    continues instead of restarting.
 
     ≙ the reference's cluster-wide bulk iteration
     ``union(userBlocks, itemBlocks).iterate(iterations * k)``
@@ -169,7 +174,7 @@ def dsgd_train(
     def step(carry, step_idx):
         U, V = carry
         s = step_idx % k
-        t = step_idx // k + 1
+        t = step_idx // k + 1 + jnp.asarray(t0, jnp.int32)
         U, V = sgd_block_sweep(
             U, V,
             su_f[s], si_f[s], sv_f[s], sw_f[s],

@@ -1,0 +1,116 @@
+"""Metrics, timing, and profiling hooks.
+
+The reference's observability is slf4j log lines (SURVEY §5): pull-window
+depth logged on every change (PSOfflineMF.scala:122,163), buffer depth every
+10 elements (FlinkOnlineMF.scala:76-81), model export via log lines, and
+``empiricalRisk`` as the only quality metric. The TPU-native equivalents:
+
+- ``StepTimer``: wall-clock brackets with ``block_until_ready`` on the
+  result (device execution is async — un-bracketed timing measures dispatch,
+  not compute).
+- ``ThroughputMeter``: ratings/sec counters — the north-star benchmark
+  metric (BASELINE.md).
+- ``MetricsLog``: in-memory structured records + optional stdlib logging;
+  the seam a dashboard would consume.
+- ``profile``: context manager around ``jax.profiler.trace`` producing
+  TensorBoard-loadable traces of the XLA timeline.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import logging
+import time
+from typing import Any, Iterator
+
+logger = logging.getLogger("large_scale_recommendation_tpu")
+
+
+def block(x: Any) -> Any:
+    """Block until device work producing ``x`` (array or pytree) finishes."""
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(x):
+        if hasattr(leaf, "block_until_ready"):
+            leaf.block_until_ready()
+    return x
+
+
+@dataclasses.dataclass
+class StepTimer:
+    """Accumulating wall-clock timer for repeated steps."""
+
+    name: str = "step"
+    total_s: float = 0.0
+    count: int = 0
+    last_s: float = 0.0
+
+    @contextlib.contextmanager
+    def time(self, result_holder: list | None = None) -> Iterator[None]:
+        """Time one step. If ``result_holder`` ends up holding device
+        values, they are blocked on before the clock stops."""
+        t0 = time.perf_counter()
+        yield
+        if result_holder is not None:
+            block(result_holder)
+        self.last_s = time.perf_counter() - t0
+        self.total_s += self.last_s
+        self.count += 1
+
+    @property
+    def mean_s(self) -> float:
+        return self.total_s / self.count if self.count else 0.0
+
+
+@dataclasses.dataclass
+class ThroughputMeter:
+    """Elements/second over the lifetime and per window."""
+
+    total_elements: int = 0
+    total_s: float = 0.0
+
+    def record(self, elements: int, seconds: float) -> None:
+        self.total_elements += elements
+        self.total_s += seconds
+
+    @property
+    def rate(self) -> float:
+        return self.total_elements / self.total_s if self.total_s else 0.0
+
+
+class MetricsLog:
+    """Append-only structured metric records.
+
+    ≙ the role of the reference's in-band log lines, as data instead of
+    strings."""
+
+    def __init__(self, log_to: logging.Logger | None = logger,
+                 level: int = logging.DEBUG):
+        self.records: list[dict] = []
+        self._logger = log_to
+        self._level = level
+
+    def log(self, event: str, **fields) -> None:
+        rec = {"event": event, "t": time.time(), **fields}
+        self.records.append(rec)
+        if self._logger is not None:
+            self._logger.log(self._level, "%s %s", event, fields)
+
+    def of(self, event: str) -> list[dict]:
+        return [r for r in self.records if r["event"] == event]
+
+
+@contextlib.contextmanager
+def profile(log_dir: str | None) -> Iterator[None]:
+    """Trace the XLA timeline to ``log_dir`` (TensorBoard format).
+
+    No-op when ``log_dir`` is None so call sites can leave the hook wired
+    unconditionally."""
+    if log_dir is None:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(log_dir):
+        yield

@@ -1,0 +1,191 @@
+"""Checkpoint/resume + metrics utilities.
+
+SURVEY §5 aux subsystems: snapshot atomicity/retention, MFModel and
+online-state round trips, segmented DSGD fit with resume (the η/√t schedule
+must continue across the boundary), adaptive periodic snapshots.
+"""
+
+import numpy as np
+import pytest
+
+from large_scale_recommendation_tpu.core.generators import SyntheticMFGenerator
+from large_scale_recommendation_tpu.models.adaptive import (
+    AdaptiveMF,
+    AdaptiveMFConfig,
+)
+from large_scale_recommendation_tpu.models.dsgd import DSGD, DSGDConfig
+from large_scale_recommendation_tpu.models.online import OnlineMF, OnlineMFConfig
+from large_scale_recommendation_tpu.utils import metrics as M
+from large_scale_recommendation_tpu.utils.checkpoint import (
+    CheckpointManager,
+    restore_mf_model,
+    restore_online_state,
+    save_mf_model,
+    save_online_state,
+)
+
+
+class TestCheckpointManager:
+    def test_save_restore_roundtrip(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        a = np.arange(12, dtype=np.float32).reshape(3, 4)
+        mgr.save(5, {"x": a}, {"note": "hello"})
+        ck = mgr.restore()
+        assert ck.step == 5
+        np.testing.assert_array_equal(ck["x"], a)
+        assert ck.meta["note"] == "hello"
+
+    def test_retention_keeps_last_k(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path), keep=2)
+        for s in (1, 2, 3, 4):
+            mgr.save(s, {"x": np.zeros(1)})
+        assert mgr.steps() == [3, 4]
+
+    def test_restore_empty_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            CheckpointManager(str(tmp_path)).restore()
+
+    def test_no_tmp_litter(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        mgr.save(1, {"x": np.zeros(3)})
+        leftovers = [p for p in tmp_path.iterdir()
+                     if p.suffix == ".tmp"]
+        assert leftovers == []
+
+
+class TestModelRoundtrip:
+    def test_mf_model_roundtrip(self, tmp_path):
+        gen = SyntheticMFGenerator(num_users=40, num_items=30, rank=4, seed=0)
+        train = gen.generate(3000)
+        model = DSGD(DSGDConfig(num_factors=6, iterations=3,
+                                minibatch_size=128)).fit(train)
+        mgr = CheckpointManager(str(tmp_path))
+        save_mf_model(mgr, model, step=3)
+        restored, ck = restore_mf_model(mgr)
+        assert ck.meta["kind"] == "mf_model"
+        np.testing.assert_array_equal(np.asarray(restored.U),
+                                      np.asarray(model.U))
+        # scoring equivalence incl. the id→row lookup tables
+        test = gen.generate(500)
+        assert abs(restored.rmse(test) - model.rmse(test)) < 1e-6
+
+    def test_online_state_roundtrip(self, tmp_path):
+        gen = SyntheticMFGenerator(num_users=30, num_items=20, rank=3, seed=1)
+        m = OnlineMF(OnlineMFConfig(num_factors=4, minibatch_size=64))
+        for _ in range(4):
+            m.partial_fit(gen.generate(500))
+        mgr = CheckpointManager(str(tmp_path))
+        save_online_state(mgr, m, step=4)
+
+        m2 = OnlineMF(OnlineMFConfig(num_factors=4, minibatch_size=64))
+        restore_online_state(mgr, m2)
+        assert m2.step == 4
+        test = gen.generate(500)
+        assert abs(m2.rmse(test) - m.rmse(test)) < 1e-6
+        # rows were re-registered in saved order → tables bit-identical
+        np.testing.assert_array_equal(
+            np.asarray(m2.users.array[: m2.users.num_rows]),
+            np.asarray(m.users.array[: m.users.num_rows]))
+
+
+class TestSegmentedDSGD:
+    def test_segmented_equals_straight_run(self, tmp_path):
+        """Checkpoint boundaries must not change the math: the t0 offset
+        keeps the η/√t schedule continuous across segments."""
+        gen = SyntheticMFGenerator(num_users=60, num_items=50, rank=4, seed=2)
+        train = gen.generate(4000)
+        cfg = DSGDConfig(num_factors=4, iterations=6, seed=0,
+                         minibatch_size=128)  # default inverse_sqrt decay
+        straight = DSGD(cfg).fit(train, num_blocks=2)
+
+        mgr = CheckpointManager(str(tmp_path))
+        segmented = DSGD(cfg).fit(train, num_blocks=2,
+                                  checkpoint_manager=mgr,
+                                  checkpoint_every=2)
+        np.testing.assert_allclose(np.asarray(segmented.U),
+                                   np.asarray(straight.U),
+                                   rtol=1e-5, atol=1e-6)
+        assert mgr.latest_step() == 6
+
+    def test_resume_from_partial(self, tmp_path):
+        gen = SyntheticMFGenerator(num_users=60, num_items=50, rank=4, seed=3)
+        train = gen.generate(4000)
+        cfg = DSGDConfig(num_factors=4, iterations=6, seed=0,
+                         minibatch_size=128)
+        mgr = CheckpointManager(str(tmp_path))
+        # simulate a crash after 4 of 6 iterations
+        half_cfg = DSGDConfig(num_factors=4, iterations=4, seed=0,
+                              minibatch_size=128)
+        DSGD(half_cfg).fit(train, num_blocks=2, checkpoint_manager=mgr,
+                           checkpoint_every=2)
+        assert mgr.latest_step() == 4
+
+        resumed = DSGD(cfg).fit(train, num_blocks=2, checkpoint_manager=mgr,
+                                checkpoint_every=2, resume=True)
+        straight = DSGD(cfg).fit(train, num_blocks=2)
+        np.testing.assert_allclose(np.asarray(resumed.U),
+                                   np.asarray(straight.U),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_resume_shape_mismatch_raises(self, tmp_path):
+        gen = SyntheticMFGenerator(num_users=30, num_items=20, rank=3, seed=4)
+        train = gen.generate(1000)
+        mgr = CheckpointManager(str(tmp_path))
+        DSGD(DSGDConfig(num_factors=4, iterations=2,
+                        minibatch_size=64)).fit(
+            train, checkpoint_manager=mgr, checkpoint_every=1)
+        with pytest.raises(ValueError, match="shape mismatch"):
+            DSGD(DSGDConfig(num_factors=8, iterations=2,
+                            minibatch_size=64)).fit(
+                train, checkpoint_manager=mgr, resume=True)
+
+
+class TestAdaptiveCheckpoint:
+    def test_periodic_snapshot_and_resume(self, tmp_path):
+        gen = SyntheticMFGenerator(num_users=30, num_items=20, rank=3, seed=5)
+        cfg = AdaptiveMFConfig(num_factors=4, offline_every=None,
+                               minibatch_size=64, checkpoint_every=2,
+                               checkpoint_dir=str(tmp_path))
+        a = AdaptiveMF(cfg)
+        for _ in range(5):
+            a.process(gen.generate(300))
+        assert a._manager.latest_step() is not None
+
+        b = AdaptiveMF(cfg)
+        assert b.resume()
+        assert b.online.step == a._manager.restore().meta["step"]
+
+
+class TestMetrics:
+    def test_step_timer_blocks_on_device_values(self):
+        import jax.numpy as jnp
+
+        t = M.StepTimer("matmul")
+        out = []
+        with t.time(out):
+            out.append(jnp.ones((64, 64)) @ jnp.ones((64, 64)))
+        assert t.count == 1 and t.last_s > 0
+
+    def test_throughput_meter(self):
+        m = M.ThroughputMeter()
+        m.record(1000, 2.0)
+        m.record(1000, 2.0)
+        assert m.rate == 500.0
+
+    def test_metrics_log(self):
+        log = M.MetricsLog(log_to=None)
+        log.log("epoch", rmse=0.1)
+        log.log("epoch", rmse=0.05)
+        log.log("other", x=1)
+        assert [r["rmse"] for r in log.of("epoch")] == [0.1, 0.05]
+
+    def test_profile_noop_without_dir(self):
+        with M.profile(None):
+            pass
+
+    def test_profile_writes_trace(self, tmp_path):
+        import jax.numpy as jnp
+
+        with M.profile(str(tmp_path)):
+            (jnp.ones((32, 32)) @ jnp.ones((32, 32))).block_until_ready()
+        assert any(tmp_path.rglob("*"))
