@@ -124,7 +124,13 @@ def build_id_index(
     TPU-friendly shard shapes.
     """
     ids = np.asarray(ids)
-    uniq, counts = np.unique(ids, return_counts=True)
+    # native one-pass compaction when built (data/native.py); result sorted
+    # by id so the layout is identical with or without the native library
+    from large_scale_recommendation_tpu.data.native import compact_ids
+
+    uniq, _, counts = compact_ids(ids)
+    order0 = np.argsort(uniq)
+    uniq, counts = uniq[order0], counts[order0]
     n = len(uniq)
     rng = np.random.default_rng(seed if seed is not None else None)
     perm = rng.permutation(n)

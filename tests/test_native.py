@@ -1,0 +1,76 @@
+"""Native fastblock library: build, parse, compact — and fallback parity."""
+
+import numpy as np
+import pytest
+
+from large_scale_recommendation_tpu.data import native
+
+
+class TestNative:
+    def test_builds_and_loads(self):
+        # g++ is in the image; the library must build
+        assert native.native_available()
+
+    def test_parse_csv_with_header(self, tmp_path):
+        p = tmp_path / "r.csv"
+        p.write_text("userId,movieId,rating,timestamp\n"
+                     "1,296,5.0,1147880044\n"
+                     "7,306,3.5,1147868817\n"
+                     "\n"  # blank line skipped
+                     "9,12,4.25,1\n")
+        u, i, v = native.parse_ratings_file(str(p), ",", skip_header=1)
+        assert u.tolist() == [1, 7, 9]
+        assert i.tolist() == [296, 306, 12]
+        np.testing.assert_allclose(v, [5.0, 3.5, 4.25])
+
+    def test_parse_tsv_no_trailing_newline(self, tmp_path):
+        p = tmp_path / "u.data"
+        p.write_text("3\t10\t5\t88\n4\t20\t2\t99")  # no final \n
+        u, i, v = native.parse_ratings_file(str(p), "\t")
+        assert u.tolist() == [3, 4] and i.tolist() == [10, 20]
+        np.testing.assert_allclose(v, [5.0, 2.0])
+
+    def test_parse_missing_file(self):
+        with pytest.raises(FileNotFoundError):
+            native.parse_ratings_file("/nonexistent/x.csv", ",")
+
+    def test_parse_large_roundtrip(self, tmp_path):
+        rng = np.random.default_rng(0)
+        n = 50_000
+        u = rng.integers(0, 10_000, n)
+        i = rng.integers(0, 5_000, n)
+        v = np.round(rng.uniform(0.5, 5.0, n) * 2) / 2
+        p = tmp_path / "big.csv"
+        with open(p, "w") as f:
+            f.write("userId,movieId,rating,timestamp\n")
+            for a, b, c in zip(u, i, v):
+                f.write(f"{a},{b},{c},0\n")
+        pu, pi, pv = native.parse_ratings_file(str(p), ",", skip_header=1)
+        np.testing.assert_array_equal(pu, u)
+        np.testing.assert_array_equal(pi, i)
+        np.testing.assert_allclose(pv, v, rtol=1e-6)
+
+    def test_compact_ids_matches_numpy(self):
+        rng = np.random.default_rng(1)
+        ids = rng.integers(-50, 50, 10_000)
+        uniq, idx, counts = native.compact_ids(ids)
+        # reconstruct: uniq[idx] == ids
+        np.testing.assert_array_equal(uniq[idx], ids)
+        # counts match np.unique
+        ref_u, ref_c = np.unique(ids, return_counts=True)
+        order = np.argsort(uniq)
+        np.testing.assert_array_equal(uniq[order], ref_u)
+        np.testing.assert_array_equal(counts[order], ref_c)
+
+    def test_blocking_layout_same_with_and_without_native(self, monkeypatch):
+        """build_id_index must produce the identical layout whether the
+        native compaction or the numpy fallback ran."""
+        from large_scale_recommendation_tpu.data import blocking
+
+        ids = np.random.default_rng(2).integers(0, 100, 1000)
+        with_native = blocking.build_id_index(ids, num_blocks=4, seed=3)
+        monkeypatch.setattr(native, "_lib", None)
+        monkeypatch.setattr(native, "_build_failed", True)
+        without = blocking.build_id_index(ids, num_blocks=4, seed=3)
+        np.testing.assert_array_equal(with_native.ids, without.ids)
+        np.testing.assert_array_equal(with_native.omega, without.omega)
