@@ -1,25 +1,39 @@
-"""Benchmark: DSGD training throughput on one chip.
+"""Benchmark: DSGD training throughput on one chip (+ ALS, RMSE context).
 
-Metric: ratings/sec/chip on a synthetic ML-25M-shaped DSGD workload
-(BASELINE.md north star: ratings/sec/chip; the reference publishes no
-numbers, so the baseline is the reference's own inner-loop style — a
-sequential per-rating NumPy SGD loop, the direct analogue of
-DSGDforMF.scala:398-417 / netlib ddot — measured here on the same host).
+Primary metric: ratings/sec/chip on a synthetic ML-25M-shaped DSGD workload
+(BASELINE.md north star). The baseline is the reference's own inner-loop
+style — a sequential per-rating NumPy SGD loop, the direct analogue of
+DSGDforMF.scala:398-417 / netlib ddot — measured on the same host.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Extra context (ALS rows/s, RMSE, wall) rides in an "extra" sub-object and
+on stderr; a hard failure still prints the JSON line with an "error" field.
+
+Structure (round-1 lesson, VERDICT.md: one backend failure must not cost the
+round its perf evidence): the parent process never imports jax. It runs the
+real benchmark in a child subprocess, retries transient TPU-backend failures
+with backoff, and falls back to a reduced CPU run if the chip stays
+unavailable — so a JSON line is ALWAYS emitted.
 
 Env knobs: BENCH_NNZ, BENCH_RANK, BENCH_ITERS, BENCH_USERS, BENCH_ITEMS,
-BENCH_MB (minibatch), BENCH_BLOCKS.
+BENCH_MB (minibatch), BENCH_BLOCKS, BENCH_TIMEOUT (per-attempt seconds).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
+
+# --------------------------------------------------------------------------
+# Child: the actual measurement (runs in a subprocess; may die on backend
+# errors — the parent handles that).
+# --------------------------------------------------------------------------
 
 def _numpy_sequential_baseline(ratings, rank, sample=150_000, lr=0.01,
                                lam=0.1, seed=0):
@@ -42,7 +56,7 @@ def _numpy_sequential_baseline(ratings, rank, sample=150_000, lr=0.01,
     return n / dt
 
 
-def main():
+def run_child() -> None:
     nnz = int(os.environ.get("BENCH_NNZ", 2_000_000))
     rank = int(os.environ.get("BENCH_RANK", 64))
     iters = int(os.environ.get("BENCH_ITERS", 5))
@@ -51,16 +65,27 @@ def main():
     mb = int(os.environ.get("BENCH_MB", 8192))
     blocks = int(os.environ.get("BENCH_BLOCKS", 4))
 
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        # Env JAX_PLATFORMS alone is not enough where a site hook pins the
+        # jax_platforms config to the accelerator (utils/platform.py).
+        from large_scale_recommendation_tpu.utils.platform import force_cpu
+
+        force_cpu()
+
     import jax
 
     from large_scale_recommendation_tpu.core.generators import (
         SyntheticMFGenerator,
     )
+    from large_scale_recommendation_tpu.models.als import ALS, ALSConfig
     from large_scale_recommendation_tpu.models.dsgd import DSGD, DSGDConfig
+
+    device = jax.devices()[0]
 
     gen = SyntheticMFGenerator(num_users=num_users, num_items=num_items,
                                rank=min(rank, 32), noise=0.1, seed=0)
     ratings = gen.generate(nnz)
+    holdout = gen.generate(100_000)
 
     cfg = DSGDConfig(
         num_factors=rank, lambda_=0.05, iterations=iters,
@@ -80,30 +105,149 @@ def main():
     t0 = time.perf_counter()
     model = solver.fit(ratings, num_blocks=blocks)
     model.U.block_until_ready()
-    dt = time.perf_counter() - t0
-    # NOTE: dt includes the host blocking pass (fair: the reference's
+    dsgd_wall = time.perf_counter() - t0
+    # NOTE: wall includes the host blocking pass (fair: the reference's
     # supersteps include their shuffles).
-    throughput = nnz * iters / dt
+    throughput = nnz * iters / dsgd_wall
+    rmse = model.rmse(holdout)
 
     baseline = _numpy_sequential_baseline(ratings, rank)
 
-    rmse = model.rmse(gen.generate(100_000))
+    # ALS: the MXU-heavy path — rows solved (normal-equation Cholesky) per
+    # second, ≙ the reference's MLlib ALS retrain branch
+    # (OnlineSpark.scala:125-131).
+    als_nnz = min(nnz, 1_000_000)
+    als_cfg = ALSConfig(num_factors=rank, lambda_=0.1, iterations=2,
+                        seed=0, chunk_size=65536)
+    als_ratings = ratings if als_nnz == nnz else gen.generate(als_nnz)
+    als = ALS(als_cfg)
+    als.fit(als_ratings).U.block_until_ready()  # compile warm-up
+    t0 = time.perf_counter()
+    als_model = ALS(als_cfg).fit(als_ratings)
+    als_model.U.block_until_ready()
+    als_wall = time.perf_counter() - t0
+    als_rows = (als_model.U.shape[0] + als_model.V.shape[0]) * als_cfg.iterations
+    als_rows_per_s = als_rows / als_wall
+
     result = {
         "metric": f"ratings/sec/chip (synthetic DSGD rank={rank}, "
-                  f"{nnz // 1_000_000}M ratings, {blocks}x{blocks} strata)",
+                  f"{nnz / 1e6:g}M ratings, {blocks}x{blocks} strata)",
         "value": round(throughput, 1),
         "unit": "ratings/s",
         "vs_baseline": round(throughput / baseline, 2),
+        "extra": {
+            "device": str(device),
+            "dsgd_wall_s": round(dsgd_wall, 2),
+            "dsgd_rmse_holdout": round(float(rmse), 4),
+            "numpy_seq_baseline_ratings_per_s": round(baseline, 1),
+            "als_rows_solved_per_s": round(als_rows_per_s, 1),
+            "als_wall_s": round(als_wall, 2),
+            "als_nnz": als_nnz,
+        },
     }
     print(json.dumps(result))
-    # Extra context on stderr (not part of the one-line contract)
-    import sys
     print(
-        f"# wall={dt:.2f}s iters={iters} rmse={rmse:.4f} "
-        f"numpy_baseline={baseline:.0f} r/s device={jax.devices()[0]}",
+        f"# wall={dsgd_wall:.2f}s iters={iters} rmse={rmse:.4f} "
+        f"numpy_baseline={baseline:.0f} r/s als={als_rows_per_s:.0f} rows/s "
+        f"device={device}",
         file=sys.stderr,
     )
 
 
+# --------------------------------------------------------------------------
+# Parent: retry orchestration. Never imports jax itself.
+# --------------------------------------------------------------------------
+
+def _attempt(env_overrides: dict[str, str], timeout: float):
+    """Run one child attempt; return (json_dict | None, tail_of_output)."""
+    env = dict(os.environ)
+    env.update(env_overrides)
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--child"],
+            env=env, capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired as e:
+        tail = ((e.stderr or b"")[-2000:] if isinstance(e.stderr, bytes)
+                else (e.stderr or "")[-2000:])
+        return None, f"timeout after {timeout}s; stderr tail: {tail}"
+    out_lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    if proc.returncode == 0 and out_lines:
+        try:
+            parsed = json.loads(out_lines[-1])
+            if "value" in parsed:
+                return parsed, proc.stderr[-1000:]
+        except json.JSONDecodeError:
+            pass
+    tail = (proc.stderr or proc.stdout)[-2000:]
+    return None, f"rc={proc.returncode}; tail: {tail}"
+
+
+def _looks_transient(tail: str) -> bool:
+    """Backend/availability failures are worth a retry; a deterministic
+    crash (ImportError, assertion) is not — retrying it only delays the
+    CPU fallback and misattributes the root cause."""
+    needles = ("timeout", "UNAVAILABLE", "backend", "Backend", "TPU",
+               "axon", "pjrt", "PJRT", "DEADLINE", "RESOURCE_EXHAUSTED")
+    return any(n in tail for n in needles)
+
+
+def main() -> None:
+    per_attempt = float(os.environ.get("BENCH_TIMEOUT", 1500))
+    errors: list[str] = []
+
+    # Attempt on whatever backend the environment provides (TPU when
+    # healthy); retry once with backoff only if the failure looks like a
+    # transient backend problem — round-1's failure mode was a transient
+    # "TPU backend setup/compile error (Unavailable)".
+    result, tail = _attempt({}, per_attempt)
+    if result is not None:
+        print(json.dumps(result))
+        return
+    errors.append(f"attempt 1: {tail}")
+    print(f"# bench attempt 1 failed: {tail[-300:]}", file=sys.stderr)
+    if _looks_transient(tail):
+        time.sleep(15)
+        result, tail = _attempt({}, per_attempt)
+        if result is not None:
+            print(json.dumps(result))
+            return
+        errors.append(f"attempt 2: {tail}")
+        print(f"# bench attempt 2 failed: {tail[-300:]}", file=sys.stderr)
+
+    # CPU fallback on a reduced workload — a real (if slower) number beats
+    # no number; the error field records the actual per-attempt failures
+    # (which may or may not be the accelerator's fault).
+    cpu_env = {
+        "JAX_PLATFORMS": "cpu",
+        "BENCH_FORCE_CPU": "1",
+        "BENCH_NNZ": os.environ.get("BENCH_NNZ_CPU", "400000"),
+        "BENCH_ITERS": "2",
+        "BENCH_USERS": "40000",
+        "BENCH_ITEMS": "10000",
+    }
+    result, tail = _attempt(cpu_env, per_attempt)
+    if result is not None:
+        result["error"] = (
+            "default-backend attempts failed; value is a reduced "
+            "CPU-fallback run. " + " | ".join(e[:300] for e in errors)
+        )
+        print(json.dumps(result))
+        return
+    errors.append(f"cpu fallback: {tail}")
+
+    # Total failure: still emit the one-line JSON contract.
+    print(json.dumps({
+        "metric": "ratings/sec/chip (synthetic DSGD)",
+        "value": 0.0,
+        "unit": "ratings/s",
+        "vs_baseline": 0.0,
+        "error": " | ".join(e[:500] for e in errors),
+    }))
+
+
 if __name__ == "__main__":
-    main()
+    if "--child" in sys.argv:
+        run_child()
+    else:
+        main()

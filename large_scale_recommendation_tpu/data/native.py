@@ -31,11 +31,18 @@ _SO = os.path.join(os.path.dirname(_SRC), "libfastblock.so")
 _lib = None
 _lib_lock = threading.Lock()
 _build_failed = False
+_build_error: str | None = None
 
 
 def _load() -> ctypes.CDLL | None:
-    """Load (building if needed) the native library; None if unavailable."""
-    global _lib, _build_failed
+    """Load (building if needed) the native library; None if unavailable.
+
+    A build/load failure is NOT silent (round-1 lesson: a broken .cpp
+    shipped unnoticed because every caller quietly fell back to NumPy):
+    it warns once with the compiler error tail, and the message is kept
+    in ``native_build_error()`` for tests/diagnostics.
+    """
+    global _lib, _build_failed, _build_error
     if _lib is not None:
         return _lib
     if _build_failed:
@@ -52,8 +59,23 @@ def _load() -> ctypes.CDLL | None:
                     check=True, capture_output=True, timeout=120,
                 )
             lib = ctypes.CDLL(_SO)
-        except (OSError, subprocess.SubprocessError, FileNotFoundError):
+        except (OSError, subprocess.SubprocessError, FileNotFoundError) as e:
             _build_failed = True
+            detail = ""
+            if isinstance(e, subprocess.CalledProcessError) and e.stderr:
+                stderr = e.stderr
+                if isinstance(stderr, bytes):
+                    stderr = stderr.decode(errors="replace")
+                detail = ": " + stderr[-1000:]
+            _build_error = f"{type(e).__name__}: {e}{detail}"
+            import warnings
+
+            warnings.warn(
+                "fastblock native build/load failed; using NumPy fallback "
+                f"(~100x slower ingest). {_build_error}",
+                RuntimeWarning,
+                stacklevel=3,
+            )
             return None
 
         LP64 = ctypes.POINTER(ctypes.c_int64)
@@ -76,6 +98,12 @@ def _load() -> ctypes.CDLL | None:
 
 def native_available() -> bool:
     return _load() is not None
+
+
+def native_build_error() -> str | None:
+    """Compiler/loader error from the last failed build attempt, if any."""
+    _load()
+    return _build_error
 
 
 def _take_array(lib, ptr, n, ctype, dtype) -> np.ndarray:

@@ -27,6 +27,12 @@ def make_block_mesh(num_devices: int | None = None,
     is ``lax.ppermute`` around this ring.
     """
     if devices is None:
+        # NOTE: ``jax.devices()`` initializes every backend the
+        # ``jax_platforms`` config names, and a broken accelerator plugin
+        # can raise or hang that init — nothing recoverable here. Entry
+        # points that must never touch the accelerator (tests,
+        # dryrun_multichip) call ``utils.platform.force_cpu()`` before the
+        # first backend init and/or pass explicit ``devices=``.
         devices = jax.devices()
         if num_devices is not None and len(devices) < num_devices:
             # Single-accelerator hosts still expose N virtual CPU devices

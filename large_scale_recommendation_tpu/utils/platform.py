@@ -1,0 +1,59 @@
+"""Backend-selection hardening.
+
+Round-1 lesson (VERDICT.md): initializing the default accelerator backend
+can hang forever when the chip is unavailable, and ``jax.devices("cpu")``
+is NOT safe — JAX's ``backends()`` initializes *every* platform named by
+the ``jax_platforms`` config, which site hooks may have pinned to include
+the accelerator regardless of the ``JAX_PLATFORMS`` env var. The only
+reliable CPU-only path is updating the config *before the first backend
+initialization*. This module centralizes that dance for every entry point
+that must never touch the accelerator (tests, multichip dryrun, bench CPU
+fallback).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def force_cpu(n_devices: int | None = None):
+    """Restrict JAX to the CPU backend; returns the imported ``jax`` module.
+
+    Handles three caller states:
+    (a) jax not yet imported — set env vars first (covers vanilla
+        environments with no site hook);
+    (b) jax imported but no backend initialized — update the
+        ``jax_platforms`` config, which wins over any hook-set value;
+    (c) backends already initialized — nothing can be done safely;
+        callers get whatever exists (``jax.devices("cpu")`` is then fine
+        since initialization already happened).
+
+    ``n_devices``: also request that many virtual CPU devices via
+    ``xla_force_host_platform_device_count`` when we are early enough for
+    the flag to take effect (states a/b before CPU client creation).
+    """
+    if "jax" not in sys.modules:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags
+                + f" --xla_force_host_platform_device_count={n_devices}"
+            ).strip()
+
+    import jax
+
+    try:
+        from jax._src import xla_bridge as _xb
+
+        initialized = _xb.backends_are_initialized()
+    except Exception:
+        initialized = False
+    if not initialized:
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+    return jax

@@ -3,15 +3,17 @@
 Multi-chip TPU hardware is not available in CI; every sharding/collective
 code path is exercised on XLA's host-platform virtual devices instead
 (SURVEY §4: multi-device tests via xla_force_host_platform_device_count).
-This must run before anything imports jax.
+``force_cpu`` must run before anything initializes a jax backend — env vars
+alone are not enough where a site hook pins the ``jax_platforms`` config
+(see utils/platform.py), so it updates the config too.
 """
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from large_scale_recommendation_tpu.utils.platform import force_cpu  # noqa: E402
+
+force_cpu(n_devices=8)

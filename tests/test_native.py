@@ -62,6 +62,56 @@ class TestNative:
         np.testing.assert_array_equal(uniq[order], ref_u)
         np.testing.assert_array_equal(counts[order], ref_c)
 
+    def test_build_error_surfaced_when_broken(self, monkeypatch, tmp_path):
+        """A failed build must be loud (warning) and inspectable, not a
+        silent NumPy fallback (round-1 shipped a broken .cpp unnoticed)."""
+        bad_src = tmp_path / "broken.cpp"
+        bad_src.write_text("this is not C++\n")
+        monkeypatch.setattr(native, "_lib", None)
+        monkeypatch.setattr(native, "_build_failed", False)
+        monkeypatch.setattr(native, "_build_error", None)
+        monkeypatch.setattr(native, "_SRC", str(bad_src))
+        monkeypatch.setattr(native, "_SO", str(tmp_path / "broken.so"))
+        with pytest.warns(RuntimeWarning, match="fastblock native build"):
+            assert not native.native_available()
+        err = native.native_build_error()
+        assert err is not None and "CalledProcessError" in err
+
+    def test_parse_speedup_vs_numpy(self, tmp_path):
+        """Record the native parse rate on an ML-25M-shaped (scaled) file
+        and require a real speedup over the NumPy fallback path."""
+        import time
+
+        rng = np.random.default_rng(7)
+        n = 300_000  # same row format as ml-25m ratings.csv, scaled down
+        u = rng.integers(1, 162_000, n)
+        i = rng.integers(1, 59_000, n)
+        v = np.round(rng.uniform(0.5, 5.0, n) * 2) / 2
+        p = tmp_path / "ratings.csv"
+        with open(p, "w") as f:
+            f.write("userId,movieId,rating,timestamp\n")
+            for a, b, c in zip(u, i, v):
+                f.write(f"{a},{b},{c},1147880044\n")
+
+        assert native.native_available()
+        t0 = time.perf_counter()
+        pu, pi, pv = native.parse_ratings_file(str(p), ",", skip_header=1)
+        native_dt = time.perf_counter() - t0
+        assert len(pu) == n
+        native_rate = n / native_dt
+
+        m = 30_000  # numpy fallback measured on a slice, rate extrapolates
+        t0 = time.perf_counter()
+        data = np.genfromtxt(p, delimiter=",", skip_header=1, max_rows=m,
+                             usecols=(0, 1, 2))
+        numpy_rate = m / (time.perf_counter() - t0)
+        assert len(data) == m
+
+        print(f"\nnative parse: {native_rate / 1e6:.1f}M rows/s, "
+              f"numpy: {numpy_rate / 1e6:.2f}M rows/s, "
+              f"speedup {native_rate / numpy_rate:.0f}x")
+        assert native_rate > 3 * numpy_rate
+
     def test_blocking_layout_same_with_and_without_native(self, monkeypatch):
         """build_id_index must produce the identical layout whether the
         native compaction or the numpy fallback ran."""
