@@ -40,6 +40,7 @@ Design departures from the reference (deliberate, documented):
 from __future__ import annotations
 
 import dataclasses
+import functools
 
 import numpy as np
 
@@ -56,7 +57,6 @@ class IdIndex:
     """
 
     ids: np.ndarray  # int64[num_rows_padded]; -1 marks padding rows
-    row_of: dict  # id -> global row
     num_blocks: int
     rows_per_block: int
     omega: np.ndarray  # float32[num_rows_padded] occurrence counts (0 on padding)
@@ -66,6 +66,13 @@ class IdIndex:
     @property
     def num_rows(self) -> int:
         return self.ids.shape[0]
+
+    @functools.cached_property
+    def row_of(self) -> dict:
+        """id → global row as a dict — built lazily; the hot paths use the
+        sorted arrays (an eager 1M-entry dict build costs ~100 ms + memory
+        for callers that never touch it)."""
+        return dict(zip(self.sorted_ids.tolist(), self.sorted_rows.tolist()))
 
     def rows_for(self, ids: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """Map external ids to rows; unknown ids get row 0 with mask 0.
@@ -133,7 +140,14 @@ def build_id_index(
     uniq, counts = uniq[order0], counts[order0]
     n = len(uniq)
     rng = np.random.default_rng(seed if seed is not None else None)
+    # Seeded shuffle first (equal-count ties land in random blocks), then a
+    # stable sort by descending occurrence count: the serpentine deal below
+    # assigns the hottest rows round-robin with alternating direction, so
+    # per-block nnz sums stay near-equal even on power-law data — the
+    # load-balancing the reference's ExponentialRatingGen exists to stress
+    # (RandomGenerator.scala:20-26; SURVEY §7 hard part (e)).
     perm = rng.permutation(n)
+    perm = perm[np.argsort(-counts[perm], kind="stable")]
 
     rows_per_block = max(-(-n // num_blocks), 1)  # ceil, ≥1
     rows_per_block = -(-rows_per_block // row_multiple) * row_multiple
@@ -141,18 +155,20 @@ def build_id_index(
 
     out_ids = np.full(total, -1, dtype=np.int64)
     omega = np.zeros(total, dtype=np.float32)
-    # Deal shuffled ids round-robin (vectorized): the k-th shuffled id goes
-    # to block k mod B at in-block offset k div B, i.e. global row
-    # (k mod B)·rows_per_block + k div B.
+    # Serpentine (boustrophedon) deal, vectorized: round r visits blocks in
+    # order 0..B-1 when r is even, B-1..0 when odd, which cancels the
+    # systematic imbalance a plain round-robin deal of a sorted sequence
+    # would give block 0.
     k_idx = np.arange(n)
-    rows = (k_idx % num_blocks) * rows_per_block + k_idx // num_blocks
+    rnd, pos = k_idx // num_blocks, k_idx % num_blocks
+    block = np.where(rnd % 2 == 0, pos, num_blocks - 1 - pos)
+    rows = block * rows_per_block + rnd
     shuffled_ids = uniq[perm].astype(np.int64)
     out_ids[rows] = shuffled_ids
     omega[rows] = counts[perm]
     order = np.argsort(shuffled_ids)
     return IdIndex(
         ids=out_ids,
-        row_of=dict(zip(shuffled_ids.tolist(), rows.tolist())),
         num_blocks=num_blocks,
         rows_per_block=rows_per_block,
         omega=omega,

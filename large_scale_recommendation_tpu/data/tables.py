@@ -7,7 +7,8 @@ FlinkOnlineMF.scala:92-93,129, OfflineSpark.scala:180-181). A device array
 cannot grow — SURVEY §7 hard part (a). The TPU-native equivalent is:
 
 - a dense ``float32[capacity, rank]`` device table,
-- a host-side id → row dict (the only dynamic structure),
+- a host-side sorted id index (the only dynamic structure — fully
+  vectorized binary search, no per-id Python anywhere),
 - geometric capacity doubling, so a stream of n distinct ids causes only
   O(log n) reallocations / recompilations of downstream jitted fns,
 - new rows initialized from the pluggable ``FactorInitializer`` **by id**
@@ -38,7 +39,8 @@ class GrowableFactorTable:
 
     ≙ the PS server's ``HashMap[Int, P]`` shard with pull-side init
     (SimplePSLogic.scala:13-18) and the online operators' state maps
-    (FlinkOnlineMF.scala:92-93,129).
+    (FlinkOnlineMF.scala:92-93,129). Row assignment is first-seen order,
+    exactly as the sequential getOrElseUpdate would produce.
     """
 
     def __init__(
@@ -49,11 +51,12 @@ class GrowableFactorTable:
     ):
         self.initializer = initializer
         self.rank = initializer.rank
-        self._row_of: dict[int, int] = {}
-        self._ids: list[int] = []
         self._sorted_cache: tuple[np.ndarray, np.ndarray] | None = None
         self._device_put = device_put or (lambda x: x)
         self.capacity = max(_next_pow2(capacity), 8)
+        # registered ids in row order; row of _ids_buf[j] is j
+        self._ids_buf = np.empty(self.capacity, np.int64)
+        self._n = 0
         self.array: jax.Array = self._device_put(
             jnp.zeros((self.capacity, self.rank), jnp.float32)
         )
@@ -62,34 +65,60 @@ class GrowableFactorTable:
 
     @property
     def num_rows(self) -> int:
-        return len(self._ids)
+        return self._n
 
     def __contains__(self, ident: int) -> bool:
-        return int(ident) in self._row_of
+        _, found = self.rows_for(np.asarray([ident]))
+        return bool(found[0])
 
     def ensure(self, ids: np.ndarray) -> np.ndarray:
         """Register any unseen ids (initializing their rows) and return the
         row for every input id. ≙ ``getOrElseUpdate(id, init.nextFactor(id))``
-        (SimplePSLogic.scala:14), batched."""
+        (SimplePSLogic.scala:14), batched.
+
+        Fully vectorized (bulk binary search + np.unique): a per-id Python
+        loop is fine at test scale but a bottleneck at ML-25M batch sizes
+        (round-1 weak spot #6); 1M fresh ids must register in well under a
+        second."""
         ids = np.asarray(ids).astype(np.int64)
-        new_ids = []
-        row_of = self._row_of
-        next_row = len(self._ids)
-        for ident in ids.tolist():
-            if ident not in row_of:
-                row_of[ident] = next_row
-                new_ids.append(ident)
-                next_row += 1
-        if new_ids:
-            self._ids.extend(new_ids)
-            if next_row > self.capacity:
-                self._grow(next_row)
-            rows = jnp.asarray(
-                [row_of[i] for i in new_ids], dtype=jnp.int32
+        rows, found_f = self.rows_for(ids)
+        known = found_f > 0
+        if known.all():
+            return rows
+        new_mask = ~known
+        # dense rows for the unseen ids, in first-seen order (matching the
+        # sequential getOrElseUpdate semantics id-for-id)
+        stream = ids[new_mask]
+        uniq, first_idx, inv = np.unique(stream, return_index=True,
+                                         return_inverse=True)
+        order = np.argsort(first_idx, kind="stable")
+        rank_of = np.empty(len(uniq), dtype=np.int64)
+        rank_of[order] = np.arange(len(uniq))
+        base = self._n
+        rows[new_mask] = base + rank_of[inv]
+
+        m = len(uniq)
+        if base + m > self.capacity:
+            self._grow(base + m)
+        self._ids_buf[base:base + m] = uniq[order]
+        self._n = base + m
+        if self._sorted_cache is not None:
+            # Merge the m new ids (already value-sorted in ``uniq``) into
+            # the existing sorted index: O(n + m), not a full O(n log n)
+            # re-sort — an online stream calls ensure() per micro-batch and
+            # must not re-sort the whole table each time.
+            s_ids, s_rows = self._sorted_cache
+            pos = np.searchsorted(s_ids, uniq)
+            self._sorted_cache = (
+                np.insert(s_ids, pos, uniq),
+                np.insert(s_rows, pos, base + rank_of),
             )
-            fresh = self.initializer(jnp.asarray(new_ids, dtype=jnp.int32))
-            self.array = self._device_put(self.array.at[rows].set(fresh))
-        return np.asarray([row_of[i] for i in ids.tolist()], dtype=np.int64)
+        fresh = self.initializer(
+            jnp.asarray(self._ids_buf[base:base + m], dtype=jnp.int32)
+        )
+        new_rows = jnp.arange(base, base + m, dtype=jnp.int32)
+        self.array = self._device_put(self.array.at[new_rows].set(fresh))
+        return rows
 
     def rows_for(self, ids: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """Look up rows WITHOUT registering; unknown ids → row 0, mask 0
@@ -109,23 +138,29 @@ class GrowableFactorTable:
         return rows, found.astype(np.float32)
 
     def _sorted_index(self) -> tuple[np.ndarray, np.ndarray]:
-        if self._sorted_cache is None or self._sorted_cache[0].size != len(self._ids):
-            all_ids = np.asarray(self._ids, dtype=np.int64)
-            order = np.argsort(all_ids)
-            self._sorted_cache = (all_ids[order], order.astype(np.int64))
+        if self._sorted_cache is None or self._sorted_cache[0].size != self._n:
+            all_ids = self._ids_buf[:self._n]
+            order = np.argsort(all_ids).astype(np.int64)
+            self._sorted_cache = (all_ids[order], order)
         return self._sorted_cache
 
     def _grow(self, need: int) -> None:
         new_cap = _next_pow2(need)
         pad = jnp.zeros((new_cap - self.capacity, self.rank), jnp.float32)
         self.array = self._device_put(jnp.concatenate([self.array, pad]))
+        ids_buf = np.empty(new_cap, np.int64)
+        ids_buf[:self._n] = self._ids_buf[:self._n]
+        self._ids_buf = ids_buf
         self.capacity = new_cap
 
     # -- access ------------------------------------------------------------
 
     def lookup(self, ids: np.ndarray) -> np.ndarray:
         """Factor vectors for ids (must be registered)."""
-        rows = np.asarray([self._row_of[int(i)] for i in np.asarray(ids)])
+        rows, found = self.rows_for(ids)
+        if not np.all(found > 0):
+            missing = np.asarray(ids)[found == 0]
+            raise KeyError(f"unregistered ids: {missing[:10].tolist()}")
         return np.asarray(self.array[jnp.asarray(rows)])
 
     def factor_vectors(self, ids=None):
@@ -138,19 +173,23 @@ class GrowableFactorTable:
         Only the requested rows are gathered off the device — per-batch
         updates-only output must not scale with table capacity."""
         if ids is None:
-            ids = self._ids
-        ids = [int(i) for i in ids]
-        if not ids:
+            ids = self._ids_buf[:self._n]
+        ids = np.asarray(ids, dtype=np.int64)
+        if ids.size == 0:
             return
-        rows = jnp.asarray([self._row_of[i] for i in ids], dtype=jnp.int32)
-        host = np.asarray(self.array[rows])
-        for j, ident in enumerate(ids):
+        rows, found = self.rows_for(ids)
+        if not np.all(found > 0):
+            missing = ids[found == 0]
+            raise KeyError(f"unregistered ids: {missing[:10].tolist()}")
+        host = np.asarray(self.array[jnp.asarray(rows, dtype=jnp.int32)])
+        for j, ident in enumerate(ids.tolist()):
             yield FactorVector(ident, host[j])
 
     def as_dict(self) -> dict[int, np.ndarray]:
         """Full model export as id → vector (host)."""
         host = np.asarray(self.array)
-        return {i: host[r] for i, r in self._row_of.items()}
+        return {int(i): host[r]
+                for r, i in enumerate(self._ids_buf[:self._n].tolist())}
 
     def ids(self) -> list[int]:
-        return list(self._ids)
+        return self._ids_buf[:self._n].tolist()

@@ -71,8 +71,13 @@ class _MFWorkerLogic:
     ratings per item; per epoch pull each item chunk, update local users,
     push item deltas."""
 
-    def __init__(self, cfg: PSOfflineMFConfig, worker_id: int):
+    def __init__(self, cfg: PSOfflineMFConfig, worker_id: int,
+                 item_holders: dict[int, int] | None = None):
         self.cfg = cfg
+        # item id -> number of workers holding >=1 rating for it; the
+        # per-item push scale (None: assume every worker holds every item,
+        # which over-damps rare items on skewed data — see on_pull_answer)
+        self._holders = item_holders
         init = PseudoRandomFactorInitializer(cfg.num_factors,
                                              scale=cfg.init_scale)
         self.users = GrowableFactorTable(init)
@@ -108,6 +113,17 @@ class _MFWorkerLogic:
         # of compiled kernel variants
         n_chunks = max(1, -(-len(items) // self.cfg.chunk_size))
         self._chunks = np.array_split(items, n_chunks)
+        # per-chunk push scale, computed ONCE (chunks are disjoint, so the
+        # first item id keys the chunk) — the answer hot path must not
+        # re-derive it with per-item dict lookups every epoch
+        self._scale_by_chunk: dict[int, np.ndarray] = {}
+        for chunk in self._chunks:
+            if self._holders is not None:
+                s = np.asarray([self._holders[int(i)] for i in chunk],
+                               dtype=np.float32)[:, None]
+            else:
+                s = np.float32(self.cfg.worker_parallelism)
+            self._scale_by_chunk[int(chunk[0])] = s
         self._issue_epoch(ps)
 
     def _issue_epoch(self, ps) -> None:
@@ -161,11 +177,14 @@ class _MFWorkerLogic:
             t0=self._epoch,  # advance the η/√t schedule across epochs
         )
         self.users.array = U_new
-        # W workers push a full local update for the same item computed from
-        # the same (stale) pulled value each epoch — averaging keeps the
-        # combined item step at the intended magnitude (the user side is
-        # worker-exclusive and needs no scaling).
-        deltas = np.asarray(V_new - V_old) / cfg.worker_parallelism
+        # The workers holding ratings for an item each push a full local
+        # update computed from the same (stale) pulled value — averaging
+        # over the HOLDERS keeps the combined step at the intended
+        # magnitude. Dividing by the total worker count instead would train
+        # an item seen by one worker W x slower (skewed data: most items are
+        # rare). The user side is worker-exclusive and needs no scaling.
+        scale = self._scale_by_chunk[int(items[0])]
+        deltas = np.asarray(V_new - V_old) / scale
         ps.push(items, deltas)
 
         self._answered_in_epoch += 1
@@ -205,7 +224,12 @@ class PSOfflineMF:
                      rv[shard == w].tolist()))
             for w in range(cfg.worker_parallelism)
         ]
-        workers = [_MFWorkerLogic(cfg, w)
+        # per-item holder counts, computable at partition time: how many
+        # workers hold >=1 rating of each item
+        pairs = np.unique(np.stack([shard, ri]), axis=1)
+        hold_items, hold_counts = np.unique(pairs[1], return_counts=True)
+        item_holders = dict(zip(hold_items.tolist(), hold_counts.tolist()))
+        workers = [_MFWorkerLogic(cfg, w, item_holders=item_holders)
                    for w in range(cfg.worker_parallelism)]
         init = PseudoRandomFactorInitializer(cfg.num_factors,
                                              scale=cfg.init_scale)

@@ -36,6 +36,7 @@ from __future__ import annotations
 import collections
 import queue
 import threading
+import time
 from typing import Any, Iterable, Sequence
 
 import numpy as np
@@ -133,6 +134,11 @@ _EOF = object()
 _STOP = object()
 
 
+class _TopologyFailed(Exception):
+    """Secondary unwind signal: another component already recorded the root
+    cause; threads raising this just exit quietly."""
+
+
 class PSTopology:
     """A running PS topology. Prefer the ``ps_transform`` entry point."""
 
@@ -157,6 +163,19 @@ class PSTopology:
         self.ps_outputs: list[Any] = []
         self._ps_lock = threading.Lock()
         self._errors: list[BaseException] = []
+        self._failed = threading.Event()
+        self._last_activity = time.monotonic()
+
+    def _fail(self, e: BaseException) -> None:
+        """Record the root cause and wake every blocked thread so the
+        topology unwinds instead of deadlocking (round-1 weak spot: a dead
+        shard left workers parked in ``q.get()`` forever)."""
+        self._errors.append(e)
+        self._failed.set()
+        for q in self._worker_queues:
+            q.put(("failed", None))
+        for q in self._shard_queues:
+            q.put(_STOP)
 
     # -- routing (≙ partitionCustom by id, FlinkPS.scala:185-189) -----------
 
@@ -188,6 +207,8 @@ class PSTopology:
             self._worker_queues[w]
         try:
             for x in inputs:
+                if self._failed.is_set():
+                    return
                 logic.on_recv(x, client)
                 self._drain_answers(w)
             hook = getattr(logic, "on_input_end", None)
@@ -196,12 +217,17 @@ class PSTopology:
                 # (PSOfflineMF.scala:99-134)
             while not client.drained:
                 tag, payload = q.get()
+                if tag == "failed":
+                    return
                 self._handle_answer(w, payload)
             logic.close(client)
+        except _TopologyFailed:
+            pass  # root cause already recorded by the failing component
         except BaseException as e:  # surface worker crashes to run()
-            self._errors.append(e)
+            self._fail(e)
 
     def _handle_answer(self, w: int, part) -> None:
+        self._last_activity = time.monotonic()
         client, logic = self._clients[w], self.workers[w]
         answer = client._on_answer_part(part)
         if answer is not None:
@@ -215,6 +241,8 @@ class PSTopology:
                 tag, payload = q.get(block=False)
             except queue.Empty:
                 return
+            if tag == "failed":
+                raise _TopologyFailed
             self._handle_answer(w, payload)
 
     def _shard_main(self, s: int) -> None:
@@ -224,6 +252,7 @@ class PSTopology:
                 req = q.get()
                 if req is _STOP:
                     return
+                self._last_activity = time.monotonic()
                 if isinstance(req, PullRequest):
                     values = logic.on_pull(req.ids)
                     self._worker_queues[req.worker_id].put(
@@ -237,7 +266,7 @@ class PSTopology:
                         with self._ps_lock:
                             self.ps_outputs.extend(out)
         except BaseException as e:
-            self._errors.append(e)
+            self._fail(e)
 
     # -- run ------------------------------------------------------------------
 
@@ -250,6 +279,13 @@ class PSTopology:
         — the two sides of the reference's Either split
         (FlinkPS.scala:227-236)."""
         assert len(worker_inputs) == len(self.workers)
+        if timeout is None:
+            # Finite default IDLE timeout: a wedged topology must eventually
+            # raise, not hang the process. Like the reference's
+            # iterationWaitTime (FlinkPS.scala:123,242) this is a SILENCE
+            # window — it only fires after no pull/push/answer traffic for
+            # this long, so healthy long runs are never cut short.
+            timeout = 600.0
         shard_threads = [
             threading.Thread(target=self._shard_main, args=(s,), daemon=True)
             for s in range(len(self.store.shards))
@@ -261,11 +297,17 @@ class PSTopology:
         ]
         for t in shard_threads + worker_threads:
             t.start()
+        self._last_activity = time.monotonic()
         for t in worker_threads:
-            t.join(timeout)
-            if t.is_alive():
-                raise TimeoutError("PS worker did not finish "
-                                   f"(iteration_wait_time={timeout})")
+            while True:
+                t.join(min(1.0, timeout))
+                if not t.is_alive() or self._errors:
+                    break
+                if time.monotonic() - self._last_activity > timeout:
+                    raise TimeoutError(
+                        "PS topology idle: no pull/push/answer traffic for "
+                        f"{timeout}s (iteration_wait_time)"
+                    )
         for q in self._shard_queues:
             q.put(_STOP)
         for t in shard_threads:

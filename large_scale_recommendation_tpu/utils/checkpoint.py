@@ -153,7 +153,6 @@ def restore_mf_model(manager: CheckpointManager, step: int | None = None):
         order = np.argsort(ids[real])
         return IdIndex(
             ids=ids,
-            row_of={int(i): int(r) for i, r in zip(ids[real], rows)},
             num_blocks=int(blocks[0]),
             rows_per_block=int(blocks[1]),
             omega=omega.astype(np.float32),

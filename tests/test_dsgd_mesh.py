@@ -94,6 +94,22 @@ class TestMeshDSGD:
         rmse = model.rmse(test)
         assert rmse < 0.12, f"mesh RMSE {rmse}"
 
+    def test_convergence_on_skewed_data(self):
+        """Power-law user/item popularity (≙ ExponentialRatingGen workloads)
+        must not break mesh-DSGD convergence or blow up stratum padding."""
+        gen = SyntheticMFGenerator(num_users=240, num_items=160, rank=8,
+                                   noise=0.05, seed=11, skew_lam=2.5)
+        train = gen.generate(20000)
+        test = gen.generate(2000)
+        prob = blocking.block_problem(train, num_blocks=8, seed=0)
+        assert prob.ratings.max_pad_ratio < 1.5, prob.ratings.max_pad_ratio
+        cfg = MeshDSGDConfig(num_factors=8, lambda_=0.01, iterations=30,
+                             learning_rate=0.1, lr_schedule="constant",
+                             seed=0, minibatch_size=32, init_scale=0.3)
+        model = MeshDSGD(cfg, mesh=make_block_mesh(8)).fit(train)
+        rmse = model.rmse(test)
+        assert rmse < 0.12, f"skewed mesh RMSE {rmse}"
+
     def test_output_sharded_over_mesh(self, gen):
         train = gen.generate(5000)
         mesh = make_block_mesh(4)

@@ -45,6 +45,48 @@ class TestGrowableFactorTable:
         np.testing.assert_array_equal(np.asarray(t.array[:6]), before)
         assert t.num_rows == 100
 
+    def test_ensure_mixed_known_unknown_interleaved(self):
+        """Rows for a batch mixing seen/unseen/duplicate ids must match the
+        sequential getOrElseUpdate semantics id-for-id."""
+        init = PseudoRandomFactorInitializer(3, scale=1.0)
+        t = GrowableFactorTable(init, capacity=8)
+        t.ensure(np.array([50, 60]))
+        rows = t.ensure(np.array([60, 9, 50, 9, 8]))
+        # 60→1 (seen), 9→2 (first new), 50→0 (seen), 9→2 (dup), 8→3
+        assert rows.tolist() == [1, 2, 0, 2, 3]
+        assert t.ids() == [50, 60, 9, 8]
+        import jax.numpy as jnp
+
+        expected = np.asarray(init(jnp.asarray([9, 8])))
+        np.testing.assert_allclose(np.asarray(t.array[2:4]), expected,
+                                   rtol=1e-6)
+
+    def test_ensure_1m_fresh_ids_is_fast(self):
+        """Bulk registration must be vectorized: 1M fresh ids in well under
+        a second (round-1 weak spot #6 — per-id loops are fatal at the
+        10M x 1M synthetic target)."""
+        import time
+
+        init = PseudoRandomFactorInitializer(8)
+        ids = np.random.default_rng(0).permutation(1_000_000)
+        # warm every jit cache on a throwaway table (same shapes): the timed
+        # region measures registration machinery, not one-off XLA compiles
+        GrowableFactorTable(init, capacity=1024).ensure(ids)
+        t = GrowableFactorTable(init, capacity=1024)
+        t0 = time.perf_counter()
+        rows = t.ensure(ids)
+        dt = time.perf_counter() - t0
+        assert t.num_rows == 1_000_000
+        assert rows.max() == 999_999
+        # bound leaves headroom for a contended CI host: measured ~0.5s idle
+        # vectorized vs >2s idle for the pre-vectorization per-id loop
+        assert dt < 2.0, f"ensure(1M fresh ids) took {dt:.2f}s"
+        # re-ensure (all known) must also be fast
+        t0 = time.perf_counter()
+        rows2 = t.ensure(ids[:500_000])
+        assert time.perf_counter() - t0 < 1.0
+        np.testing.assert_array_equal(rows2, rows[:500_000])
+
     def test_rows_for_unknown_ids_masked(self):
         t = GrowableFactorTable(PseudoRandomFactorInitializer(2), capacity=8)
         t.ensure(np.array([5]))
