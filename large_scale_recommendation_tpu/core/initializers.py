@@ -28,10 +28,27 @@ Two semantics match the reference exactly:
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 from typing import Callable, Protocol
 
 import jax
 import jax.numpy as jnp
+
+
+@partial(jax.jit, static_argnames=("rank",))
+def _keyed_uniform_rows(key: jax.Array, ids: jax.Array, rank: int,
+                        scale: jax.Array) -> jax.Array:
+    """rows[i] = scale * uniform(fold_in(key, ids[i]), (rank,)).
+
+    Shared jitted kernel for both initializers (they differ only in what
+    ``ids`` means: the external id for PseudoRandom, the call position for
+    Random). Jitted at module level so repeated table builds with the same
+    shape hit the compile cache — the eager vmapped threefry this replaces
+    cost ~seconds per 100K-row table, dominating DSGD fit setup.
+    """
+    keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(key, ids)
+    draw = lambda k: jax.random.uniform(k, (rank,), dtype=jnp.float32)
+    return scale * jax.vmap(draw)(keys)
 
 
 class FactorInitializer(Protocol):
@@ -66,13 +83,12 @@ class RandomFactorInitializer:
     def __call__(self, ids: jax.Array) -> jax.Array:
         ids = jnp.asarray(ids, dtype=jnp.int32)
         key = jax.random.fold_in(jax.random.PRNGKey(self.seed), self.salt)
-        # Draw per-id keys from the stream key *and* the position so repeated
-        # ids in one call still get independent draws (stream semantics).
-        keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
-            key, jnp.arange(ids.shape[0], dtype=jnp.int32)
+        # Draw per-position keys from the stream key so repeated ids in one
+        # call still get independent draws (stream semantics).
+        return _keyed_uniform_rows(
+            key, jnp.arange(ids.shape[0], dtype=jnp.int32), self.rank,
+            jnp.float32(self.scale),
         )
-        draw = lambda k: jax.random.uniform(k, (self.rank,), dtype=jnp.float32)
-        return self.scale * jax.vmap(draw)(keys)
 
     def open(self) -> "RandomFactorInitializer":
         """API-parity alias for ``FactorInitializerDescriptor.open()``
@@ -95,10 +111,8 @@ class PseudoRandomFactorInitializer:
 
     def __call__(self, ids: jax.Array) -> jax.Array:
         ids = jnp.asarray(ids, dtype=jnp.int32)
-        base = jax.random.PRNGKey(0)
-        keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(base, ids)
-        draw = lambda k: jax.random.uniform(k, (self.rank,), dtype=jnp.float32)
-        return self.scale * jax.vmap(draw)(keys)
+        return _keyed_uniform_rows(jax.random.PRNGKey(0), ids, self.rank,
+                                   jnp.float32(self.scale))
 
     def open(self) -> "PseudoRandomFactorInitializer":
         return self

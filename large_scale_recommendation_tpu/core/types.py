@@ -53,13 +53,22 @@ class Ratings:
     def from_arrays(
         users: Any, items: Any, ratings: Any, weights: Any | None = None
     ) -> "Ratings":
-        users = jnp.asarray(users, dtype=jnp.int32)
-        items = jnp.asarray(items, dtype=jnp.int32)
-        ratings = jnp.asarray(ratings, dtype=jnp.float32)
+        """Build a batch, keeping the arrays HOST-side (numpy).
+
+        Ratings are ingest data: blocking, vocabulary building and PS routing
+        all consume them on host, and drivers place the *blocked* arrays on
+        device themselves. Eager device placement here costs a full
+        device→host round trip per preprocessing pass (painful through a
+        remote-TPU tunnel); jitted consumers can pass a host batch directly —
+        jax transfers at trace time.
+        """
+        users = np.asarray(users, dtype=np.int32)
+        items = np.asarray(items, dtype=np.int32)
+        ratings = np.asarray(ratings, dtype=np.float32)
         if weights is None:
-            weights = jnp.ones_like(ratings)
+            weights = np.ones_like(ratings)
         else:
-            weights = jnp.asarray(weights, dtype=jnp.float32)
+            weights = np.asarray(weights, dtype=np.float32)
         return Ratings(users=users, items=items, ratings=ratings, weights=weights)
 
     def pad_to(self, n: int) -> "Ratings":
@@ -71,11 +80,14 @@ class Ratings:
         if cur == n:
             return self
         pad = n - cur
+        # Stay in whatever memory space the batch already lives in: padding a
+        # host batch must not force a device transfer (and vice versa).
+        xp = np if isinstance(self.users, np.ndarray) else jnp
         return Ratings(
-            users=jnp.concatenate([self.users, jnp.zeros(pad, jnp.int32)]),
-            items=jnp.concatenate([self.items, jnp.zeros(pad, jnp.int32)]),
-            ratings=jnp.concatenate([self.ratings, jnp.zeros(pad, jnp.float32)]),
-            weights=jnp.concatenate([self.weights, jnp.zeros(pad, jnp.float32)]),
+            users=xp.concatenate([self.users, xp.zeros(pad, xp.int32)]),
+            items=xp.concatenate([self.items, xp.zeros(pad, xp.int32)]),
+            ratings=xp.concatenate([self.ratings, xp.zeros(pad, xp.float32)]),
+            weights=xp.concatenate([self.weights, xp.zeros(pad, xp.float32)]),
         )
 
     def to_numpy(self) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:

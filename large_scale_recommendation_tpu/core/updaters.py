@@ -39,6 +39,7 @@ sequential semantics.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Callable, Protocol
 
 import jax
@@ -51,14 +52,115 @@ LearningRateSchedule = Callable[[jax.Array, jax.Array], jax.Array]
 
 
 def constant_lr(base_lr: jax.Array, t: jax.Array) -> jax.Array:
-    """≙ LearningRateMethod.Default: η_t = η."""
+    """≙ LearningRateMethod.Constant: η_t = η."""
     del t
     return base_lr
 
 
 def inverse_sqrt_lr(base_lr: jax.Array, t: jax.Array) -> jax.Array:
-    """≙ the reference's η/√t decay (DSGDforMF.scala:118)."""
+    """≙ LearningRateMethod.Default, the reference's η/√t decay
+    (DSGDforMF.scala:118,167-168)."""
     return base_lr / jnp.sqrt(jnp.asarray(t, jnp.float32))
+
+
+def inv_scaling_lr(decay: float = 0.5) -> LearningRateSchedule:
+    """≙ LearningRateMethod.InvScaling(decay): η_t = η / t^decay (the FlinkML
+    family the reference's setLearningRateMethod accepts,
+    DSGDforMF.scala:147-152)."""
+    # Normalize before the cache so f(), f(0.5) and f(decay=0.5) all return
+    # the SAME callable (lru_cache keys raw call signatures) — schedule
+    # identity is what makes updater dataclasses equal as static jit args.
+    return _inv_scaling_lr(float(decay))
+
+
+@functools.lru_cache(maxsize=None)
+def _inv_scaling_lr(decay: float) -> LearningRateSchedule:
+    def schedule(base_lr: jax.Array, t: jax.Array) -> jax.Array:
+        return base_lr / jnp.power(jnp.asarray(t, jnp.float32), decay)
+
+    return schedule
+
+
+def bottou_lr(lambda_: float,
+              optimal_init: float | None = None) -> LearningRateSchedule:
+    """≙ LearningRateMethod.Bottou(optimalInit): η_t = 1/(λ·(t₀ + t − 1)).
+
+    Bottou's asymptotically-optimal schedule for λ-strongly-convex losses;
+    requires λ > 0 (the schedule is undefined for the unregularized case —
+    validated here so λ=0 fails fast instead of silently training on NaN).
+    With an explicit ``optimal_init`` the FlinkML semantics apply verbatim
+    (the base learning rate is ignored — and η₁ = 1/(λ·t₀) can be enormous
+    for small λ; FlinkML makes callers pick t₀ for exactly this reason).
+    Default ``None`` picks t₀ = 1/(λ·η₀) so the schedule *starts at the
+    configured base rate* and decays as η₀/(1 + η₀λ(t−1)) — the safe form
+    for the by-name config layer, where a diverging default would be a trap.
+    """
+    if lambda_ <= 0:
+        raise ValueError(
+            f"bottou schedule requires lambda > 0, got {lambda_}"
+        )
+    return _bottou_lr(float(lambda_),
+                      None if optimal_init is None else float(optimal_init))
+
+
+@functools.lru_cache(maxsize=None)
+def _bottou_lr(lambda_: float,
+               optimal_init: float | None) -> LearningRateSchedule:
+    def schedule(base_lr: jax.Array, t: jax.Array) -> jax.Array:
+        t = jnp.asarray(t, jnp.float32)
+        lam = jnp.float32(lambda_)
+        if optimal_init is None:
+            t0 = 1.0 / (lam * base_lr)
+        else:
+            t0 = jnp.float32(optimal_init)
+        return 1.0 / (lam * (t0 - 1.0 + t))
+
+    return schedule
+
+
+def xu_lr(lambda_: float, decay: float = -0.75) -> LearningRateSchedule:
+    """≙ LearningRateMethod.Xu(decay): η_t = η·(1 + λ·η·t)^decay
+    (Xu 2011 averaged-SGD schedule; FlinkML uses a negative decay)."""
+    return _xu_lr(float(lambda_), float(decay))
+
+
+@functools.lru_cache(maxsize=None)
+def _xu_lr(lambda_: float, decay: float) -> LearningRateSchedule:
+    def schedule(base_lr: jax.Array, t: jax.Array) -> jax.Array:
+        return base_lr * jnp.power(
+            1.0 + jnp.float32(lambda_) * base_lr * jnp.asarray(t, jnp.float32),
+            decay,
+        )
+
+    return schedule
+
+
+def schedule_from_name(name: str, lambda_: float = 1.0,
+                       **kwargs) -> LearningRateSchedule:
+    """Config-layer registry: schedule name → callable.
+
+    ≙ the pluggable ``setLearningRateMethod(learningRateMethodTrait)`` seam
+    (DSGDforMF.scala:147-152); λ is captured here because the FlinkML
+    contract passes the regularization constant into
+    ``calculateLearningRate`` (DSGDforMF.scala:383-386).
+    """
+    if name in ("inverse_sqrt", "default"):
+        return inverse_sqrt_lr
+    if name == "constant":
+        return constant_lr
+    # The factories are lru_cached so repeated configs yield the SAME
+    # callable — updater dataclasses carrying them stay equal/hashable and
+    # hit the jit compile cache.
+    if name == "inv_scaling":
+        return inv_scaling_lr(**kwargs)
+    if name == "bottou":
+        return bottou_lr(lambda_, **kwargs)
+    if name == "xu":
+        return xu_lr(lambda_, **kwargs)
+    raise ValueError(
+        f"unknown learning-rate schedule {name!r}; expected one of "
+        "inverse_sqrt|default|constant|inv_scaling|bottou|xu"
+    )
 
 
 class FactorUpdater(Protocol):

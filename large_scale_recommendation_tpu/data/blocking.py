@@ -122,20 +122,26 @@ def build_id_index(
     num_blocks: int,
     seed: int | None,
     row_multiple: int = 8,
-) -> IdIndex:
+    return_rows: bool = False,
+) -> IdIndex | tuple[IdIndex, np.ndarray]:
     """Compact ids to dense rows and deal rows into equal-size blocks.
 
     ≙ initFactorBlockAndIndices (DSGDforMF.scala:513-588): distinct ids,
     random block assignment (here: seeded shuffle + round-robin deal for
     balance), omega counts. ``row_multiple`` pads rows_per_block up for
     TPU-friendly shard shapes.
+
+    With ``return_rows=True`` also returns the per-occurrence row array
+    (``rows_of_each_input_id``, int64[len(ids)]) — the compaction pass
+    already knows each occurrence's position, so callers blocking the same
+    rating list skip a redundant O(n log m) ``rows_for`` binary search.
     """
     ids = np.asarray(ids)
     # native one-pass compaction when built (data/native.py); result sorted
     # by id so the layout is identical with or without the native library
     from large_scale_recommendation_tpu.data.native import compact_ids
 
-    uniq, _, counts = compact_ids(ids)
+    uniq, inverse, counts = compact_ids(ids)
     order0 = np.argsort(uniq)
     uniq, counts = uniq[order0], counts[order0]
     n = len(uniq)
@@ -167,7 +173,7 @@ def build_id_index(
     out_ids[rows] = shuffled_ids
     omega[rows] = counts[perm]
     order = np.argsort(shuffled_ids)
-    return IdIndex(
+    index = IdIndex(
         ids=out_ids,
         num_blocks=num_blocks,
         rows_per_block=rows_per_block,
@@ -175,6 +181,14 @@ def build_id_index(
         sorted_ids=shuffled_ids[order],
         sorted_rows=rows[order],
     )
+    if not return_rows:
+        return index
+    # occurrence → row: invert the two reorderings (id-sort, then deal perm)
+    row_of_sorted_pos = np.empty(n, dtype=np.int64)
+    row_of_sorted_pos[perm] = rows
+    inv_order0 = np.empty(n, dtype=np.int64)
+    inv_order0[order0] = np.arange(n)
+    return index, row_of_sorted_pos[inv_order0[inverse]]
 
 
 def block_ratings(
@@ -183,11 +197,19 @@ def block_ratings(
     items: IdIndex,
     minibatch_multiple: int = 1,
     seed: int | None = 0,
+    precomputed_rows: tuple[np.ndarray, np.ndarray] | None = None,
 ) -> BlockedRatings:
     """Bucket ratings into the k×k grid in stratum-major layout.
 
     ≙ rating-block construction (DSGDforMF.scala:301-333): join ratings with
     block indices, group by ``ratingBlockId = uBlk*k + iBlk``.
+
+    Input contract: a ``Ratings`` batch may contain weight-0 padding (it is
+    filtered here); a raw ``(ru, ri, rv)`` tuple must contain REAL ratings
+    only — no padding, every id present in the indices. ``precomputed_rows``
+    skips the id→row search for callers (``block_problem``) whose index
+    build already produced the per-occurrence rows; the rows must align 1:1
+    with the (already-filtered) rating arrays.
 
     Within each block, ratings are SHUFFLED with a seeded RNG — deterministic,
     but order-decorrelated. The reference shuffles each block before every
@@ -209,30 +231,28 @@ def block_ratings(
     k = users.num_blocks
     assert items.num_blocks == k, "user and item block counts must match"
 
-    urow, umask = users.rows_for(ru)
-    irow, imask = items.rows_for(ri)
-    if not (umask.all() and imask.all()):
-        raise ValueError("block_ratings: ratings contain ids absent from the "
-                         "id indices")
+    if precomputed_rows is not None:
+        urow, irow = precomputed_rows
+    else:
+        urow, umask = users.rows_for(ru)
+        irow, imask = items.rows_for(ri)
+        if not (umask.all() and imask.all()):
+            raise ValueError("block_ratings: ratings contain ids absent from "
+                             "the id indices")
     ublk = urow // users.rows_per_block
     iblk = irow // items.rows_per_block
     # stratum step s at which block (p, q) is visited: q = (p+s) mod k
     strat = (iblk - ublk) % k
 
-    # Sort by (stratum, user block, user row, item row): blocks become
-    # contiguous runs in a deterministic base order...
-    order = np.lexsort((irow, urow, ublk, strat))
-    # ...then decorrelate inside each block with one seeded global shuffle
-    # (stable re-sort of shuffled positions keeps blocks contiguous but the
-    # within-block order random — ≙ the reference's per-visit shuffle,
-    # DSGDforMF.scala:392-393, made deterministic).
+    # One seeded shuffle, then a stable sort by block key: blocks become
+    # contiguous runs whose WITHIN-block order is random — ≙ the reference's
+    # per-visit shuffle (DSGDforMF.scala:392-393), made deterministic. Beyond
+    # SGD folklore this matters mechanically: a user-sorted block puts all of
+    # one row's ratings into the same minibatch, maximizing intra-minibatch
+    # row collisions (SURVEY §7 hard part (b)).
     rng = np.random.default_rng(0 if seed is None else seed + 7919)
-    perm = rng.permutation(len(order))
-    shuffled = order[perm]
-    reorder = np.argsort(
-        strat[shuffled] * k + ublk[shuffled], kind="stable"
-    )
-    order = shuffled[reorder]
+    perm = rng.permutation(len(urow))
+    order = perm[np.argsort(strat[perm] * k + ublk[perm], kind="stable")]
     urow, irow = urow[order], irow[order]
     vals = np.asarray(rv, dtype=np.float32)[order]
     strat_s, ublk_s = strat[order], ublk[order]
@@ -280,13 +300,16 @@ def block_problem(
 
     Weight-0 (padding) entries are excluded everywhere: they neither register
     ids nor contribute to omegas nor train."""
-    ru, ri, _, rw = ratings.to_numpy()
+    ru, ri, rv, rw = ratings.to_numpy()
     real = rw > 0
-    ru, ri = ru[real], ri[real]
-    users = build_id_index(ru, num_blocks, seed, row_multiple)
-    items = build_id_index(
-        ri, num_blocks, None if seed is None else seed + 1, row_multiple
+    if not real.all():
+        ru, ri, rv = ru[real], ri[real], rv[real]
+    users, urow = build_id_index(ru, num_blocks, seed, row_multiple,
+                                 return_rows=True)
+    items, irow = build_id_index(
+        ri, num_blocks, None if seed is None else seed + 1, row_multiple,
+        return_rows=True,
     )
-    blocked = block_ratings(ratings, users, items, minibatch_multiple,
-                            seed=seed)
+    blocked = block_ratings((ru, ri, rv), users, items, minibatch_multiple,
+                            seed=seed, precomputed_rows=(urow, irow))
     return BlockedProblem(users=users, items=items, ratings=blocked)

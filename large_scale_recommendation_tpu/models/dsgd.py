@@ -31,8 +31,7 @@ from large_scale_recommendation_tpu.core.initializers import (
 )
 from large_scale_recommendation_tpu.core.updaters import (
     RegularizedSGDUpdater,
-    constant_lr,
-    inverse_sqrt_lr,
+    schedule_from_name,
 )
 from large_scale_recommendation_tpu.core.types import Ratings
 from large_scale_recommendation_tpu.data import blocking
@@ -50,14 +49,16 @@ class DSGDConfig:
     iterations: int = 10
     num_blocks: int | None = None  # None → auto (devices or 1; ≙ Blocks None→1)
     learning_rate: float = 0.001
-    lr_schedule: str = "inverse_sqrt"  # "inverse_sqrt" (ref default) | "constant"
+    # any core.updaters.schedule_from_name name:
+    # inverse_sqrt (ref default) | constant | inv_scaling | bottou | xu
+    lr_schedule: str = "inverse_sqrt"
     seed: int | None = 0
     minibatch_size: int = 1024
     init_scale: float = 1.0  # factor init upper bound (nextDouble ∈ [0,1))
     collision_mode: str = "mean"  # minibatch row-collision handling (ops.sgd)
 
     def schedule_fn(self):
-        return inverse_sqrt_lr if self.lr_schedule == "inverse_sqrt" else constant_lr
+        return schedule_from_name(self.lr_schedule, self.lambda_)
 
 
 class DSGD:

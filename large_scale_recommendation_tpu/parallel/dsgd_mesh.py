@@ -154,14 +154,13 @@ class MeshDSGD:
                  mesh: Mesh | None = None, updater: Any = None):
         from large_scale_recommendation_tpu.core.updaters import (
             RegularizedSGDUpdater,
-            constant_lr,
-            inverse_sqrt_lr,
+            schedule_from_name,
         )
 
         self.config = config or MeshDSGDConfig()
         self.mesh = mesh or make_block_mesh()
-        sched = (inverse_sqrt_lr if self.config.lr_schedule == "inverse_sqrt"
-                 else constant_lr)
+        sched = schedule_from_name(self.config.lr_schedule,
+                                   self.config.lambda_)
         self.updater = updater or RegularizedSGDUpdater(
             learning_rate=self.config.learning_rate,
             lambda_=self.config.lambda_,

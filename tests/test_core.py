@@ -154,6 +154,66 @@ class TestUpdaters:
         """≙ η/√t decay (DSGDforMF.scala:118)."""
         assert float(inverse_sqrt_lr(jnp.float32(1.0), jnp.float32(4.0))) == 0.5
 
+    def test_schedule_family(self):
+        """≙ the FlinkML LearningRateMethod family behind
+        setLearningRateMethod (DSGDforMF.scala:147-152): closed-form values
+        at (η=0.1, λ=0.5, t=4)."""
+        from large_scale_recommendation_tpu.core.updaters import (
+            schedule_from_name,
+        )
+
+        lr, lam, t = jnp.float32(0.1), 0.5, jnp.float32(4.0)
+        cases = {
+            "constant": 0.1,
+            "inverse_sqrt": 0.05,
+            "default": 0.05,
+            "inv_scaling": 0.1 / 4.0 ** 0.5,
+            # default t₀ = 1/(λη₀): starts at η₀, decays η₀/(1+η₀λ(t−1))
+            "bottou": 0.1 / (1 + 0.1 * lam * 3.0),
+            "xu": 0.1 * (1 + lam * 0.1 * 4.0) ** -0.75,
+        }
+        for name, want in cases.items():
+            got = float(schedule_from_name(name, lam)(lr, t))
+            np.testing.assert_allclose(got, want, rtol=1e-6, err_msg=name)
+        # explicit optimal_init → verbatim FlinkML Bottou: 1/(λ(t₀+t−1))
+        got = float(schedule_from_name("bottou", lam, optimal_init=2.0)(lr, t))
+        np.testing.assert_allclose(got, 1.0 / (lam * 5.0), rtol=1e-6)
+
+    def test_schedule_registry_returns_singletons(self):
+        """Two configs with the same schedule must produce the SAME callable
+        (static jit-arg equality → compile-cache hits across refits)."""
+        from large_scale_recommendation_tpu.core.updaters import (
+            schedule_from_name,
+        )
+
+        for name in ("constant", "inverse_sqrt", "inv_scaling", "bottou", "xu"):
+            assert schedule_from_name(name, 0.5) is schedule_from_name(name, 0.5)
+        # ...including across calling conventions (positional vs kwarg vs
+        # default) — lru_cache alone would key these separately
+        from large_scale_recommendation_tpu.core.updaters import (
+            bottou_lr,
+            inv_scaling_lr,
+        )
+
+        assert inv_scaling_lr() is inv_scaling_lr(0.5)
+        assert inv_scaling_lr(0.5) is inv_scaling_lr(decay=0.5)
+        assert bottou_lr(0.5) is bottou_lr(0.5, None)
+
+    def test_bottou_rejects_zero_lambda(self):
+        """λ=0 makes Bottou's 1/(λ·t) undefined — must fail fast, not NaN."""
+        from large_scale_recommendation_tpu.core.updaters import bottou_lr
+
+        with pytest.raises(ValueError, match="lambda"):
+            bottou_lr(0.0)
+
+    def test_schedule_unknown_name_raises(self):
+        from large_scale_recommendation_tpu.core.updaters import (
+            schedule_from_name,
+        )
+
+        with pytest.raises(ValueError, match="unknown learning-rate"):
+            schedule_from_name("nope")
+
     def test_mock_is_identity(self):
         upd = MockFactorUpdater()
         un, vn = upd.next_factors(jnp.array(self.r), jnp.array(self.u),
