@@ -1,0 +1,144 @@
+"""Two-process CPU demo of the multi-host DSGD path.
+
+Run ME on every host of the process group (here: two local processes):
+
+    LSR_COORDINATOR=127.0.0.1:<port> LSR_NUM_PROCESSES=2 LSR_PROCESS_ID=0 \
+        python examples/distributed_demo.py &
+    LSR_COORDINATOR=127.0.0.1:<port> LSR_NUM_PROCESSES=2 LSR_PROCESS_ID=1 \
+        python examples/distributed_demo.py
+
+Each process owns 2 virtual CPU devices → a global 4-device block ring
+spanning both processes. The demo shows the three multi-host pieces the
+reference delegates to its engines (SURVEY §2.3):
+
+1. **cluster bring-up** — ``initialize_distributed`` (≙ Flink/Spark
+   job-manager → task-manager wiring);
+2. **per-host ingest** — ``host_rating_shard`` + a cross-process ``psum``
+   proving the shards tile the dataset (≙ partitionCustom shipping rating
+   partitions, PSOfflineMF.scala:70-72);
+3. **global mesh training** — the UNCHANGED jitted mesh-DSGD superstep loop
+   (``parallel.dsgd_mesh.build_mesh_dsgd_step``) over a mesh whose ppermute
+   ring crosses the process boundary — the DCN/ICI hop the engines' network
+   shuffles become (DSGDforMF.scala:611-619 ≙ one collective permute).
+
+Process 0 prints ``DISTRIBUTED DEMO PASS`` when the trained model reaches
+the planted noise floor.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N_LOCAL_DEVICES = 2
+
+
+def main() -> None:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={N_LOCAL_DEVICES}"
+    )
+    from large_scale_recommendation_tpu.utils.platform import force_cpu
+
+    force_cpu(n_devices=N_LOCAL_DEVICES)
+
+    from large_scale_recommendation_tpu.parallel.distributed import (
+        DistributedConfig,
+        initialize_distributed,
+        host_rating_shard,
+        make_global_array,
+    )
+
+    cfg = DistributedConfig.from_env()
+    multi = initialize_distributed(cfg)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    pid = jax.process_index()
+    nproc = jax.process_count()
+    assert multi == (nproc > 1)
+    devices = np.asarray(jax.devices())  # global, all processes
+    k = len(devices)
+    mesh = Mesh(devices, ("blocks",))
+    print(f"[p{pid}] {nproc} processes, global devices: {k}", flush=True)
+
+    # -- per-host ingest (every host range-reads the same seeded synthetic
+    # stream; the shard filter keeps only its part) -------------------------
+    from large_scale_recommendation_tpu.core.generators import (
+        SyntheticMFGenerator,
+    )
+
+    gen = SyntheticMFGenerator(num_users=400, num_items=200, rank=4,
+                               noise=0.05, seed=7)
+    ratings = gen.generate(30_000)
+    test = gen.generate(3_000)
+    ru, ri, rv, _ = ratings.to_numpy()
+    mu, mi, mv = host_rating_shard(ru, ri, rv, pid, nproc)
+
+    # cross-process sum proves the shards tile the dataset exactly
+    spec = P("blocks")
+    counts = make_global_array(
+        np.full(k, len(mu) / N_LOCAL_DEVICES, np.float32), mesh, spec
+    )
+    total = jax.jit(
+        lambda c: jnp.sum(c), out_shardings=NamedSharding(mesh, P())
+    )(counts)
+    # each process wrote its count spread over its local shard entries
+    print(f"[p{pid}] local={len(mu)}", flush=True)
+
+    # -- global-mesh DSGD: identical blocking on every host (deterministic
+    # given the same seed), global arrays assembled from local shards -------
+    from large_scale_recommendation_tpu.data import blocking
+    from large_scale_recommendation_tpu.models.dsgd import DSGD, DSGDConfig
+    from large_scale_recommendation_tpu.parallel.dsgd_mesh import (
+        build_mesh_dsgd_step,
+        device_major_local_strata,
+    )
+    from large_scale_recommendation_tpu.core.updaters import (
+        RegularizedSGDUpdater,
+        constant_lr,
+    )
+
+    mb = 32
+    problem = blocking.block_problem(ratings, num_blocks=k, seed=0,
+                                     minibatch_multiple=mb)
+    sru, sri, srv, srw = device_major_local_strata(problem)
+    U0, V0 = DSGD(DSGDConfig(num_factors=8, seed=0, init_scale=0.3)
+                  )._init_factors(problem)
+
+    ga = lambda x: make_global_array(np.asarray(x), mesh, spec)
+    U = ga(U0)
+    V = ga(V0)
+    args = tuple(ga(x) for x in (sru, sri, srv, srw))
+    ou = ga(problem.users.omega)
+    ov = ga(problem.items.omega)
+
+    updater = RegularizedSGDUpdater(learning_rate=0.1, lambda_=0.01,
+                                    schedule=constant_lr)
+    step = build_mesh_dsgd_step(mesh, updater, mb, k, iterations=20)
+    U, V = step(U, V, *args, ou, ov, jnp.asarray(0, jnp.int32))
+
+    # gather the trained tables to every host for scoring
+    rep = NamedSharding(mesh, P())
+    Uh = np.asarray(jax.jit(lambda x: x, out_shardings=rep)(U))
+    Vh = np.asarray(jax.jit(lambda x: x, out_shardings=rep)(V))
+
+    tu, ti, tv, _ = test.to_numpy()
+    urow, um = problem.users.rows_for(tu)
+    irow, im = problem.items.rows_for(ti)
+    m = (um * im) > 0
+    pred = np.einsum("nk,nk->n", Uh[urow[m]], Vh[irow[m]])
+    rmse = float(np.sqrt(np.mean((tv[m] - pred) ** 2)))
+    print(f"[p{pid}] rmse={rmse:.4f} total_ratings={float(total):.0f}",
+          flush=True)
+    assert abs(float(total) - len(ru)) < 1e-3, (float(total), len(ru))
+    assert rmse < 0.1, rmse
+    if pid == 0:
+        print("DISTRIBUTED DEMO PASS", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,7 +1,7 @@
 """large_scale_recommendation_tpu — a TPU-native framework for large-scale
 recommendation via distributed matrix factorization.
 
-A ground-up JAX/XLA/pallas/pjit rebuild of the capabilities of the reference
+A ground-up JAX/XLA/pjit rebuild of the capabilities of the reference
 Flink+Spark framework (Mallik-G/large-scale-recommendation):
 
 - batch DSGD (Gemulla-style stratified SGD) with stratum rotation mapped to
@@ -25,8 +25,8 @@ Flink+Spark framework (Mallik-G/large-scale-recommendation):
 Packages:
     core      engine-agnostic math contract (types, initializers, updaters,
               synthetic generators, throughput limiter)
-    ops       jitted numeric kernels (SGD stratum sweep, ALS normal equations,
-              pallas kernels)
+    ops       jitted numeric kernels (SGD stratum sweep, ALS normal
+              equations)
     models    user-facing solvers/drivers (DSGD, ALS, online MF, combined,
               PS-mode)
     parallel  device-mesh utilities, shard_map DSGD, collectives

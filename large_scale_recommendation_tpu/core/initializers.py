@@ -33,22 +33,37 @@ from typing import Callable, Protocol
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 @partial(jax.jit, static_argnames=("rank",))
-def _keyed_uniform_rows(key: jax.Array, ids: jax.Array, rank: int,
-                        scale: jax.Array) -> jax.Array:
-    """rows[i] = scale * uniform(fold_in(key, ids[i]), (rank,)).
-
-    Shared jitted kernel for both initializers (they differ only in what
-    ``ids`` means: the external id for PseudoRandom, the call position for
-    Random). Jitted at module level so repeated table builds with the same
-    shape hit the compile cache — the eager vmapped threefry this replaces
-    cost ~seconds per 100K-row table, dominating DSGD fit setup.
-    """
+def _keyed_uniform_rows_padded(key: jax.Array, ids: jax.Array, rank: int,
+                               scale: jax.Array) -> jax.Array:
     keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(key, ids)
     draw = lambda k: jax.random.uniform(k, (rank,), dtype=jnp.float32)
     return scale * jax.vmap(draw)(keys)
+
+
+def _keyed_uniform_rows(key: jax.Array, ids, rank: int,
+                        scale: jax.Array) -> jax.Array:
+    """rows[i] = scale * uniform(fold_in(key, ids[i]), (rank,)).
+
+    Shared kernel for both initializers (they differ only in what ``ids``
+    means: the external id for PseudoRandom, the call position for Random).
+    Jitted at module level so repeated table builds hit the compile cache —
+    the eager vmapped threefry this replaces cost ~seconds per 100K-row
+    table, dominating DSGD fit setup. The id batch is padded to a power of
+    2 before the jitted draw (each row depends only on its own id, so
+    padding changes nothing): streaming callers (GrowableFactorTable.ensure)
+    pass a different fresh-id count every micro-batch, and per-length
+    compiles would grow the jit cache without bound.
+    """
+    ids = np.asarray(ids, dtype=np.int32)
+    n = ids.shape[0]
+    padded = max(8, 1 << (max(n - 1, 1)).bit_length())
+    if padded != n:
+        ids = np.concatenate([ids, np.zeros(padded - n, np.int32)])
+    return _keyed_uniform_rows_padded(key, jnp.asarray(ids), rank, scale)[:n]
 
 
 class FactorInitializer(Protocol):
@@ -81,12 +96,12 @@ class RandomFactorInitializer:
     salt: int = 0
 
     def __call__(self, ids: jax.Array) -> jax.Array:
-        ids = jnp.asarray(ids, dtype=jnp.int32)
+        n = np.asarray(ids).shape[0]
         key = jax.random.fold_in(jax.random.PRNGKey(self.seed), self.salt)
         # Draw per-position keys from the stream key so repeated ids in one
         # call still get independent draws (stream semantics).
         return _keyed_uniform_rows(
-            key, jnp.arange(ids.shape[0], dtype=jnp.int32), self.rank,
+            key, np.arange(n, dtype=np.int32), self.rank,
             jnp.float32(self.scale),
         )
 
@@ -110,7 +125,6 @@ class PseudoRandomFactorInitializer:
     scale: float = 1.0
 
     def __call__(self, ids: jax.Array) -> jax.Array:
-        ids = jnp.asarray(ids, dtype=jnp.int32)
         return _keyed_uniform_rows(jax.random.PRNGKey(0), ids, self.rank,
                                    jnp.float32(self.scale))
 

@@ -131,12 +131,13 @@ class AdaptiveMF:
             if self._thread is not None and self._thread.is_alive():
                 # ≙ enqueue to onlinePullQueue (PSOfflineOnlineMF.scala:142)
                 self._buffer.append(batch)
-                return BatchUpdates([], [])
+                return BatchUpdates([], [], rank=cfg.num_factors)
             # retrain finished: swap + replay the queue
             updates = self._finish_batch()
             more = self.online.partial_fit(batch)
             return BatchUpdates(updates.user_updates + more.user_updates,
-                                updates.item_updates + more.item_updates)
+                                updates.item_updates + more.item_updates,
+                                rank=cfg.num_factors)
 
         out = self.online.partial_fit(batch)
         self._batches_since_retrain += 1
@@ -201,7 +202,7 @@ class AdaptiveMF:
         (≙ batch-finished sign propagation, PSOfflineOnlineMF.scala:316-323).
         """
         if self._state != "Batch":
-            return BatchUpdates([], [])
+            return BatchUpdates([], [], rank=cfg.num_factors)
         if self._thread is not None:
             self._thread.join()
         return self._finish_batch()

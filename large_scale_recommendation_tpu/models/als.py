@@ -6,10 +6,12 @@ OnlineSpark.scala:125-131 — ``ALS.train(ratingsHistory, rank,
 numberOfIterations, 0.1)``). Capability parity per SURVEY §7 step 5: the
 second offline algorithm behind the same fit/predict surface as DSGD.
 
-The whole solver is one jitted computation (``ops.als.als_train``):
-normal-equation gram assembly via chunked scatter-add and batched Cholesky
-solves on the MXU — the ALX-style formulation (see PAPERS.md) rather than
-MLlib's block-routed LAPACK calls.
+The solver uses the bucketed-matmul formulation (``ops.als``): a one-time
+host plan sorts each orientation by output row and pads per-row rating
+lists to power-of-2 buckets, so gram assembly is batched ``[rows, pad, k]``
+einsums and the solve is batched Cholesky — all MXU work, no scatter in the
+hot path (the ALX-style formulation, see PAPERS.md) rather than MLlib's
+block-routed LAPACK calls.
 """
 
 from __future__ import annotations
@@ -39,7 +41,8 @@ class ALSConfig:
     iterations: int = 10
     reg_mode: str = "direct"  # "direct" (MLlib ALS.train) | "als_wr" (ω-scaled)
     seed: int | None = 0
-    chunk_size: int = 4096  # gram-assembly scatter chunk
+    min_pad: int = 8  # smallest per-row bucket width (ops.als plans)
+    chunk_size: int = 4096  # mesh-path gram chunk (parallel.als_mesh only)
     init_scale: float = 0.1
 
 
@@ -66,24 +69,17 @@ class ALS:
         u_rows, _ = users.rows_for(ru)
         i_rows, _ = items.rows_for(ri)
 
-        n = len(ru)
-        padded = -(-n // cfg.chunk_size) * cfg.chunk_size
-        ur = np.zeros(padded, np.int32)
-        ir = np.zeros(padded, np.int32)
-        vals = np.zeros(padded, np.float32)
-        w = np.zeros(padded, np.float32)
-        ur[:n], ir[:n], vals[:n], w[:n] = u_rows, i_rows, rv, 1.0
+        # one-time host plans, one per orientation (epoch-invariant)
+        user_plan = als_ops.build_solve_plan(
+            u_rows, i_rows, rv, users.num_rows, min_pad=cfg.min_pad)
+        item_plan = als_ops.build_solve_plan(
+            i_rows, u_rows, rv, items.num_rows, min_pad=cfg.min_pad)
 
         U, V = self._init_factors(users, items)
-        U, V = als_ops.als_train(
-            U, V,
-            jnp.asarray(ur), jnp.asarray(ir),
-            jnp.asarray(vals), jnp.asarray(w),
-            jnp.asarray(users.omega), jnp.asarray(items.omega),
+        U, V = als_ops.als_train_planned(
+            U, V, user_plan, item_plan,
+            users.omega, items.omega,
             lambda_=cfg.lambda_,
-            num_u_rows=users.num_rows,
-            num_i_rows=items.num_rows,
-            chunk=cfg.chunk_size,
             iterations=cfg.iterations,
             reg_mode=cfg.reg_mode,
         )
@@ -97,15 +93,15 @@ class ALS:
         if cfg.seed is not None:
             init = PseudoRandomFactorInitializer(cfg.num_factors,
                                                  scale=cfg.init_scale)
-            U = init(jnp.asarray(np.maximum(users.ids, 0)))
-            V = init(jnp.asarray(np.maximum(items.ids, 0)))
+            U = init(np.maximum(users.ids, 0))
+            V = init(np.maximum(items.ids, 0))
         else:
             U = RandomFactorInitializer(cfg.num_factors, seed=0, salt=0,
                                         scale=cfg.init_scale)(
-                jnp.arange(users.num_rows))
+                np.arange(users.num_rows))
             V = RandomFactorInitializer(cfg.num_factors, seed=0, salt=1,
                                         scale=cfg.init_scale)(
-                jnp.arange(items.num_rows))
+                np.arange(items.num_rows))
         return U, V
 
     # -- scoring passthroughs (same surface as DSGD) -----------------------

@@ -173,8 +173,8 @@ class DSGD:
                                              scale=cfg.init_scale)
             init_v = RandomFactorInitializer(cfg.num_factors, seed=0, salt=1,
                                              scale=cfg.init_scale)
-        U = init_u(jnp.asarray(np.maximum(problem.users.ids, 0)))
-        V = init_v(jnp.asarray(np.maximum(problem.items.ids, 0)))
+        U = init_u(np.maximum(problem.users.ids, 0))
+        V = init_v(np.maximum(problem.items.ids, 0))
         return U, V
 
     # -- scoring passthroughs (Predictor-style surface,

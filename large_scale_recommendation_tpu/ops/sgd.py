@@ -244,6 +244,50 @@ def online_train(
     return U, V
 
 
+def pad_minibatches(
+    u_rows,
+    i_rows,
+    values,
+    minibatch: int,
+    buffers: dict | None = None,
+):
+    """Pad COO arrays to a power-of-2 number of ``minibatch``-sized chunks
+    with weight-0 no-op entries — the divisibility contract of
+    ``online_train``/``sgd_block_sweep``, shared by every micro-batch caller
+    (streaming OnlineMF, the PS epoch loops, the PS online+batch combo).
+
+    The pow2 bucket bounds the jitted kernel to O(log n) compiled shape
+    variants on variable-size batches. ``buffers`` (optional dict keyed by
+    padded length) reuses the four numpy staging arrays across calls.
+    Returns ``(ur, ir, vals, w)`` int32/int32/float32/float32 of the padded
+    length.
+    """
+    import numpy as np
+
+    n = len(u_rows)
+    n_mb = max(1, -(-n // minibatch))
+    bucket = 1 << (n_mb - 1).bit_length() if n_mb > 1 else 1
+    padded = bucket * minibatch
+    if buffers is not None:
+        if padded not in buffers:
+            buffers[padded] = (
+                np.zeros(padded, np.int32), np.zeros(padded, np.int32),
+                np.zeros(padded, np.float32), np.zeros(padded, np.float32),
+            )
+        ur, ir, vals_out, w = buffers[padded]
+        ur[n:] = 0
+        ir[n:] = 0
+        vals_out[n:] = 0.0
+        w[n:] = 0.0
+    else:
+        ur = np.zeros(padded, np.int32)
+        ir = np.zeros(padded, np.int32)
+        vals_out = np.zeros(padded, np.float32)
+        w = np.zeros(padded, np.float32)
+    ur[:n], ir[:n], vals_out[:n], w[:n] = u_rows, i_rows, values, 1.0
+    return ur, ir, vals_out, w
+
+
 def predict_rows(U: jax.Array, V: jax.Array, u_rows: jax.Array,
                  i_rows: jax.Array) -> jax.Array:
     """Batched score: r̂ = u·v. ≙ ``blas.ddot`` in predict

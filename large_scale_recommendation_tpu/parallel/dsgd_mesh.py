@@ -80,8 +80,9 @@ def build_mesh_dsgd_step(
 ):
     """Build the jitted multi-chip training function.
 
-    Returns ``fn(U, V, ru, ri, rv, rw, omega_u, omega_v) -> (U, V)`` where
-    every argument is sharded on dim 0 over the block axis. The full
+    Returns ``fn(U, V, ru, ri, rv, rw, omega_u, omega_v, t0) -> (U, V)``
+    where every array argument is sharded on dim 0 over the block axis and
+    ``t0`` is a replicated scalar (iterations already completed). The full
     ``iterations × k`` superstep loop (≙ the reference's
     ``.iterate(iterations * k)`` bulk iteration, DSGDforMF.scala:337-344)
     runs as one XLA computation with k·iterations ppermutes on the ICI ring.
@@ -93,10 +94,10 @@ def build_mesh_dsgd_step(
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(spec,) * 8,
+        in_specs=(spec,) * 8 + (P(),),
         out_specs=(spec, spec),
     )
-    def run(U_l, V_l, ru_l, ri_l, rv_l, rw_l, ou_l, ov_l):
+    def run(U_l, V_l, ru_l, ri_l, rv_l, rw_l, ou_l, ov_l, t0):
         # shard_map gives [1, k, b] for the device-major strata; drop the
         # leading sharded dim.
         ru, ri = ru_l[0], ri_l[0]
@@ -105,7 +106,10 @@ def build_mesh_dsgd_step(
         def step(carry, idx):
             U, V, ov = carry
             s = idx % k
-            t = idx // k + 1
+            # t0 = iterations already completed (checkpoint segments) so the
+            # η/√t schedule continues instead of restarting (same contract
+            # as ops.sgd.dsgd_train)
+            t = idx // k + 1 + t0
             U, V = sgd_ops.sgd_block_sweep(
                 U, V, ru[s], ri[s], rv[s], rw[s], ou_l, ov,
                 updater, t, minibatch, collision,
@@ -172,7 +176,20 @@ class MeshDSGD:
     def num_blocks(self) -> int:
         return self.mesh.shape[BLOCK_AXIS]
 
-    def fit(self, ratings: Ratings) -> MFModel:
+    def fit(
+        self,
+        ratings: Ratings,
+        checkpoint_manager=None,
+        checkpoint_every: int | None = None,
+        resume: bool = False,
+    ) -> MFModel:
+        """Train on the mesh. The checkpoint contract is identical to the
+        single-device driver (models/dsgd.py fit): with
+        ``checkpoint_manager`` + ``checkpoint_every`` the superstep loop
+        runs in segments with a durable snapshot at each boundary
+        (≙ the TemporaryPath persistence barriers, DSGDforMF.scala:291-296),
+        and ``resume=True`` restarts from the latest snapshot — valid
+        because blocking is deterministic given the same ratings + seed."""
         cfg = self.config
         if ratings.n == 0:
             raise ValueError("cannot fit on an empty ratings set")
@@ -192,6 +209,21 @@ class MeshDSGD:
                        init_scale=cfg.init_scale)
         )._init_factors(problem)
 
+        done = 0
+        if resume:
+            if checkpoint_manager is None:
+                raise ValueError("resume=True requires a checkpoint_manager")
+            latest = checkpoint_manager.latest_step()
+            if latest is not None:
+                ck = checkpoint_manager.restore(latest)
+                if (ck["U"].shape != U.shape or ck["V"].shape != V.shape):
+                    raise ValueError(
+                        "checkpoint shape mismatch — resumed fit must use "
+                        "the same ratings, seed, rank and mesh size"
+                    )
+                U, V = jnp.asarray(ck["U"]), jnp.asarray(ck["V"])
+                done = latest
+
         shard = block_sharding(self.mesh)
         put = lambda x: jax.device_put(jnp.asarray(x), shard)
         U, V = put(U), put(V)
@@ -199,11 +231,22 @@ class MeshDSGD:
         ou = put(problem.users.omega)
         ov = put(problem.items.omega)
 
-        step_fn = build_mesh_dsgd_step(
-            self.mesh, self.updater, cfg.minibatch_size, k, cfg.iterations,
-            cfg.collision_mode,
-        )
-        U, V = step_fn(U, V, *args, ou, ov)
+        segment = checkpoint_every or cfg.iterations
+        while done < cfg.iterations:
+            seg = min(segment, cfg.iterations - done)
+            step_fn = build_mesh_dsgd_step(
+                self.mesh, self.updater, cfg.minibatch_size, k, seg,
+                cfg.collision_mode,
+            )
+            U, V = step_fn(U, V, *args, ou, ov,
+                           jnp.asarray(done, jnp.int32))
+            done += seg
+            if checkpoint_manager is not None:
+                checkpoint_manager.save(
+                    done, {"U": np.asarray(U), "V": np.asarray(V)},
+                    {"kind": "mesh_dsgd_segment",
+                     "iterations": cfg.iterations},
+                )
         self.model = MFModel(U=U, V=V, users=problem.users,
                              items=problem.items)
         return self.model

@@ -17,8 +17,16 @@ Modules:
                   running async topology (≙ psTransform, C8)
 - ``mf``        — PS-based offline matrix factorization driver
                   (≙ PSOfflineMF.scala, C12)
+- ``adaptive``  — PS-hosted combined online + periodic-batch MF with the
+                  Online/BatchInit/Batch worker+server state machines
+                  (≙ PSOfflineOnlineMF.scala, C13)
 """
 
+from large_scale_recommendation_tpu.ps.adaptive import (
+    BATCH_TRIGGER,
+    PSOnlineBatchConfig,
+    PSOnlineBatchMF,
+)
 from large_scale_recommendation_tpu.ps.core import (
     ParameterServerClient,
     ParameterServerLogic,
@@ -28,10 +36,13 @@ from large_scale_recommendation_tpu.ps.server import SimplePSLogic
 from large_scale_recommendation_tpu.ps.transform import PSTopology, ps_transform
 
 __all__ = [
+    "BATCH_TRIGGER",
     "ParameterServerClient",
     "ParameterServerLogic",
-    "WorkerLogic",
+    "PSOnlineBatchConfig",
+    "PSOnlineBatchMF",
     "SimplePSLogic",
+    "WorkerLogic",
     "PSTopology",
     "ps_transform",
 ]

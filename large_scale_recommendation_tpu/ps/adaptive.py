@@ -1,0 +1,527 @@
+"""PS-hosted combined online + periodic-batch matrix factorization.
+
+TPU-native rebuild of the reference's most intricate machinery
+(reference: flink-adaptive-recom/.../mf/PSOfflineOnlineMF.scala:24-401, C13):
+continuous online SGD over a rating stream, with an external trigger that
+switches BOTH the workers and the PS shards through a three-state machine
+
+    Online  →  BatchInit  →  Batch  →  Online
+
+- **Online** (:140-190): each rating updates the local user vector and
+  pushes an item delta computed from a pulled item vector; updated user
+  vectors stream out via ``ps.output``; ratings accumulate in the history
+  ``rs``; in-flight pulls are bounded by ``pull_limit_online`` with overflow
+  parked in the online queue (≙ onlinePullQueue + trySendingPulls,
+  :72,154-165).
+- **Trigger** (:74-138): the worker flips to BatchInit, sends an in-band
+  "batch start" control to EVERY PS shard (≙ push ``(−psId, Array())``,
+  :89-92), discards answers to still-in-flight online pulls (:191-203), and
+  once drained starts the batch replay.
+- **Batch** (:112-133, 204-237): the worker replays its whole history
+  ``iterations`` times against the PS (in-flight window = ``pull_limit``);
+  ratings that arrive meanwhile only park in the online queue. When the
+  replay drains, the worker sends "batch end" to every shard
+  (≙ ``(−psId, Array(−1.0))``, :223-227), folds the parked online ratings
+  into the history (:230), flips back to Online and resumes pulling.
+- **Server mirror** (:244-359): the first "batch start" sign flips the shard
+  to BatchInit and CLEARS its parameters — the batch is a retrain from
+  scratch over worker histories (:313-314). Pushes from workers that have
+  not yet signed are ignored (:349-353). All workers signed → Batch; all
+  "batch end" signs → Online.
+
+Deliberate departures (reference bugs per SURVEY §2.4 — not replicated):
+
+- *BatchInit pull admission*: the reference DROPS pulls from workers that
+  have not yet signed batch start (:260-265). Per-channel FIFO ordering
+  makes that a deadlock: such a pull left its worker before the trigger
+  reached it, so the worker will flip to BatchInit and wait for exactly that
+  answer (:103-108) — which never comes. Shards here ALWAYS answer pulls;
+  a worker in BatchInit discards the answer anyway, which is the admission
+  the state machine actually needs.
+- *Online push persistence*: the reference's Online/BatchInit push branch
+  emits the updated vector but never writes it back to ``params``
+  (:326-336 — ``normalUpdate`` lacks the ``params += `` of the Batch
+  branch), so online training never actually updates the server model.
+  Pushes here always persist, and emit in Online.
+- The batch replay pulls item CHUNKS through the jitted online kernel
+  (like ``ps.mf``, whose chunked design the per-item reference variant
+  anticipated) instead of one rating at a time; the worker-side math is
+  identical, amortized over the chunk.
+
+The reference worker needs a background thread plus a ReentrantLock/
+Condition dance (:94-137) because its PS client blocks on the pull window.
+This runtime's client never blocks (``ps.transform``), so the whole state
+machine runs on the worker's single thread — the lock, the condition and
+the thread liveness checks (:204-215) dissolve.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from large_scale_recommendation_tpu.core.initializers import (
+    PseudoRandomFactorInitializer,
+)
+from large_scale_recommendation_tpu.core.types import Ratings
+from large_scale_recommendation_tpu.core.updaters import (
+    SGDUpdater,
+    schedule_from_name,
+)
+from large_scale_recommendation_tpu.data.tables import GrowableFactorTable
+from large_scale_recommendation_tpu.ops import sgd as sgd_ops
+from large_scale_recommendation_tpu.ps.core import PullAnswer
+from large_scale_recommendation_tpu.ps.server import ShardedParameterStore
+from large_scale_recommendation_tpu.ps.transform import ps_transform
+
+
+class _BatchTrigger:
+    """Marker event: start a periodic batch retrain now.
+
+    ≙ one element of the ``batchTrainingTrigger: DataStream[Unit]``
+    (PSOfflineOnlineMF.scala:37), which the driver broadcasts to every
+    worker as the marker rating ``(workerId, −1, −1.0)`` (:385). A typed
+    sentinel replaces the magic triple."""
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return "BATCH_TRIGGER"
+
+
+BATCH_TRIGGER = _BatchTrigger()
+
+ONLINE, BATCH_INIT, BATCH = "online", "batch_init", "batch"
+
+
+@dataclasses.dataclass(frozen=True)
+class PSOnlineBatchConfig:
+    """≙ the ``offlineOnlinePS(...)`` parameter list
+    (PSOfflineOnlineMF.scala:36-46), incl. the separate ``pullLimit`` /
+    ``pullLimitOnline`` (:43-44)."""
+
+    num_factors: int = 10
+    iterations: int = 5  # history replays per batch retrain
+    learning_rate: float = 0.05
+    lr_schedule: str = "inverse_sqrt"  # batch replay decay (online is t=1)
+    worker_parallelism: int = 4
+    ps_parallelism: int = 4
+    pull_limit: int = 4  # batch in-flight chunk window
+    pull_limit_online: int = 8  # online in-flight rating window
+    chunk_size: int = 256  # items per batch pull
+    minibatch_size: int = 256
+    seed: int = 0
+    init_scale: float = 0.1
+
+
+class OnlineBatchWorkerLogic:
+    """The worker state machine (PSOfflineOnlineMF.scala:52-242)."""
+
+    def __init__(self, cfg: PSOnlineBatchConfig, worker_id: int):
+        self.cfg = cfg
+        self.worker_id = worker_id
+        init = PseudoRandomFactorInitializer(cfg.num_factors,
+                                             scale=cfg.init_scale)
+        self.users = GrowableFactorTable(init)  # ≙ userVectors (:55)
+        self.state = ONLINE
+        self.history: list[tuple[int, int, float]] = []  # ≙ rs (:54)
+        # ratings awaiting an online pull slot (≙ onlinePullQueue, :72)
+        self.online_queue: collections.deque = collections.deque()
+        # item → FIFO of (user, rating) awaiting that item's answer
+        # (≙ itemRatings, :56)
+        self._item_fifo: dict[int, collections.deque] = {}
+        self._outstanding = 0  # ≙ pullCounter (:66)
+        self.updater = SGDUpdater(learning_rate=cfg.learning_rate)
+        self._batch_sched = schedule_from_name(cfg.lr_schedule)
+        self._rng = np.random.default_rng(cfg.seed + 31 * worker_id)
+        # batch replay bookkeeping
+        self._chunks: list[np.ndarray] = []
+        self._chunk_data: dict[int, tuple] = {}  # first-id → (us, ips, vals)
+        self._chunk_cursor = 0
+        self._epoch = 0
+        self._queue_in_history = 0  # online_queue prefix already in history
+        self.batches_run = 0
+
+    # -- WorkerLogic ---------------------------------------------------------
+
+    def on_recv(self, data: Any, ps) -> None:
+        if data is BATCH_TRIGGER:
+            self._on_trigger(ps)
+            return
+        user, item, value = data
+        rating = (int(user), int(item), float(value))
+        # every arrival parks in the online queue (:142); only Online also
+        # appends to history and tries to pull (:144-150)
+        self.online_queue.append(rating)
+        if self.state == ONLINE:
+            self.history.append(rating)
+            self._try_sending_pulls(ps)
+
+    def on_pull_answer(self, answer: PullAnswer, ps) -> None:
+        self._outstanding -= 1
+        if self.state == ONLINE:
+            self._online_update(answer, ps)  # ≙ vectorUpdateAndPush (:167-180)
+            self._try_sending_pulls(ps)
+        elif self.state == BATCH_INIT:
+            # throw away the answer; batch must start ASAP (:191-203)
+            item = int(answer.ids[0])
+            self._item_fifo[item].popleft()
+            if self._outstanding == 0:
+                self._start_batch(ps)
+        else:  # BATCH
+            self._batch_chunk_update(answer, ps)
+
+    def close(self, ps) -> None:
+        """Emit final user vectors (the reference's close is empty — its
+        model only escapes via the online output stream; a final dump costs
+        nothing and matches ps.mf's contract)."""
+        for fv in self.users.factor_vectors():
+            ps.output((fv.id, fv.factors))
+
+    # -- Online (:140-190) ---------------------------------------------------
+
+    def _try_sending_pulls(self, ps) -> None:
+        """≙ trySendingPulls (:154-165): admit parked ratings while the
+        online window has room."""
+        while (self._outstanding < self.cfg.pull_limit_online
+               and self.online_queue):
+            user, item, value = self.online_queue.popleft()
+            self._item_fifo.setdefault(item, collections.deque()).append(
+                (user, value)
+            )
+            self._outstanding += 1
+            ps.pull(np.asarray([item], dtype=np.int64))
+
+    def _online_update(self, answer: PullAnswer, ps) -> None:
+        """≙ vectorUpdateAndPush (:167-180): update the local user vector,
+        push the item delta, emit the updated user vector."""
+        item = int(answer.ids[0])
+        item_vec = answer.values[0]
+        user, value = self._item_fifo[item].popleft()
+        urow = int(self.users.ensure(np.asarray([user], np.int64))[0])
+        user_vec = np.asarray(self.users.array[urow])
+        du, dv = self.updater.delta(
+            jnp.asarray([value], jnp.float32),
+            jnp.asarray(user_vec)[None, :],
+            jnp.asarray(item_vec, jnp.float32)[None, :],
+        )
+        new_user = user_vec + np.asarray(du[0])
+        self.users.array = self.users.array.at[urow].set(
+            jnp.asarray(new_user))
+        ps.push(np.asarray([item], np.int64), np.asarray(dv))
+        ps.output((user, new_user))  # ≙ ps.output(user, ...) (:176)
+
+    # -- Trigger → BatchInit (:74-138) ---------------------------------------
+
+    def _on_trigger(self, ps) -> None:
+        if self.state != ONLINE:
+            # ≙ the IllegalStateException (:81-83)
+            raise RuntimeError(
+                "previous batch training has not finished yet — wait longer "
+                "between periodic batch triggers"
+            )
+        self.state = BATCH_INIT
+        # Entries currently parked in the online queue were appended to the
+        # history when they arrived (Online on_recv); everything enqueued
+        # from here on was not. Remember the boundary so the batch-end fold
+        # adds only the genuinely-new tail — the reference's
+        # ``rs ++= onlinePullQueue`` (:230) re-adds the already-in-rs prefix,
+        # silently double-weighting those ratings in every later retrain
+        # (SURVEY §2.4 spirit: not replicated).
+        self._queue_in_history = len(self.online_queue)
+        for p in range(self.cfg.ps_parallelism):
+            ps.control(p, "batch_start")  # ≙ push (−psId, Array()) (:89-92)
+        if self._outstanding == 0:
+            self._start_batch(ps)
+
+    # -- Batch replay (:112-133, 204-237) ------------------------------------
+
+    def _start_batch(self, ps) -> None:
+        self.state = BATCH
+        self._epoch = 0
+        if not self.history:
+            self._finish_batch(ps)
+            return
+        # Group history by item into near-equal chunks (like ps.mf; ≙ the
+        # per-item itemRatings grouping, :124-125) and precompute each
+        # chunk's (user, item-position, value) arrays ONCE per retrain —
+        # the per-answer hot path must not re-derive them with per-rating
+        # Python loops every epoch.
+        hu = np.asarray([r[0] for r in self.history], dtype=np.int64)
+        hi = np.asarray([r[1] for r in self.history], dtype=np.int64)
+        hv = np.asarray([r[2] for r in self.history], dtype=np.float32)
+        items = np.unique(hi)
+        n_chunks = max(1, -(-len(items) // self.cfg.chunk_size))
+        self._chunks = list(np.array_split(items, n_chunks))
+        order = np.argsort(hi, kind="stable")
+        hu, hi, hv = hu[order], hi[order], hv[order]
+        starts = np.searchsorted(hi, items)
+        ends = np.append(starts[1:], len(hi))
+        self._chunk_data = {}
+        for chunk in self._chunks:
+            a = starts[np.searchsorted(items, chunk[0])]
+            b = ends[np.searchsorted(items, chunk[-1])]
+            # item position within the chunk, aligned with the pull answer
+            ips = np.searchsorted(chunk, hi[a:b])
+            self._chunk_data[int(chunk[0])] = (hu[a:b], ips, hv[a:b])
+        self._issue_epoch(ps)
+
+    def _issue_epoch(self, ps) -> None:
+        """≙ one ``for (u,i,r) <- rs`` replay round under the pullLimit
+        window (:112-133); the epoch reshuffle actually happens (the
+        reference's ``Random.shuffle(rs)`` discards its result — SURVEY
+        §2.4)."""
+        self._order = self._rng.permutation(len(self._chunks))
+        self._chunk_cursor = 0
+        self._answered_in_epoch = 0
+        self._pump_batch_pulls(ps)
+
+    def _pump_batch_pulls(self, ps) -> None:
+        while (self._chunk_cursor < len(self._chunks)
+               and self._outstanding < self.cfg.pull_limit):
+            chunk = self._chunks[self._order[self._chunk_cursor]]
+            self._chunk_cursor += 1
+            self._outstanding += 1
+            ps.pull(chunk)
+
+    def _batch_chunk_update(self, answer: PullAnswer, ps) -> None:
+        """One replayed chunk: same math as the online rule, batched through
+        the jitted kernel on the worker's local user table (t follows the
+        epoch so the η/√t decay spans the whole retrain)."""
+        cfg = self.cfg
+        items, V_chunk = answer.ids, answer.values
+        us, ips, vals = self._chunk_data[int(items[0])]
+        perm = self._rng.permutation(len(us))
+        us = us[perm]
+        ips = ips[perm]
+        vals = vals[perm]
+        u_rows = self.users.ensure(us)
+
+        mb = cfg.minibatch_size
+        ur, ir, rv, w = sgd_ops.pad_minibatches(u_rows, ips, vals, mb)
+
+        V_old = jnp.asarray(V_chunk, dtype=jnp.float32)
+        batch_updater = SGDUpdater(learning_rate=cfg.learning_rate,
+                                   schedule=self._batch_sched)
+        U_new, V_new = sgd_ops.online_train(
+            self.users.array, V_old,
+            jnp.asarray(ur), jnp.asarray(ir), jnp.asarray(rv), jnp.asarray(w),
+            updater=batch_updater, minibatch=mb, iterations=1,
+            t0=self._epoch,
+        )
+        self.users.array = U_new
+        ps.push(items, np.asarray(V_new - V_old))
+
+        self._answered_in_epoch += 1
+        if self._answered_in_epoch == len(self._chunks):
+            self._epoch += 1
+            if self._epoch < cfg.iterations:
+                self._issue_epoch(ps)
+            elif self._outstanding == 0:
+                self._finish_batch(ps)
+        else:
+            self._pump_batch_pulls(ps)
+
+    def _finish_batch(self, ps) -> None:
+        """≙ the batch-done branch (:216-236): sign every shard, fold the
+        parked online ratings into the history, resume Online."""
+        for p in range(self.cfg.ps_parallelism):
+            ps.control(p, "batch_end")  # ≙ push (−psId, Array(−1.0))
+        # ≙ rs ++= onlinePullQueue (:230), minus the already-in-history
+        # prefix (see _on_trigger)
+        new_tail = list(self.online_queue)[self._queue_in_history:]
+        self.history.extend(new_tail)
+        self.state = ONLINE
+        self.batches_run += 1
+        self._try_sending_pulls(ps)
+
+
+class AdaptivePSLogic:
+    """The server state machine (PSOfflineOnlineMF.scala:244-359): a
+    parameter shard whose behavior depends on the batch lifecycle."""
+
+    def __init__(self, initializer, worker_parallelism: int, device=None):
+        import jax
+
+        put = (lambda x: jax.device_put(x, device)) if device is not None \
+            else None
+        self._initializer = initializer
+        self._device_put = put
+        self.table = GrowableFactorTable(initializer, device_put=put)
+        self.state = ONLINE
+        self.worker_parallelism = worker_parallelism
+        # ≙ workerHasStartedBatch / workerHasFinishedBatch bitsets (:268,283)
+        self._started: set[int] = set()
+        self._finished: set[int] = set()
+        self.batches_seen = 0
+
+    # -- ParameterServerLogic ------------------------------------------------
+
+    def on_pull(self, ids: np.ndarray) -> np.ndarray:
+        """Always answers — including during BatchInit for workers that have
+        not signed yet (the reference drops those, :260-265; see the module
+        docstring for why that deadlocks a FIFO channel)."""
+        rows = self.table.ensure(ids)
+        return np.asarray(self.table.array[jnp.asarray(rows)])
+
+    def on_push(self, ids: np.ndarray, deltas: np.ndarray, outputs: list,
+                worker_id: int = -1) -> None:
+        if self.state == BATCH_INIT and worker_id not in self._started:
+            # a stale online push from a worker still pre-trigger (:349-353)
+            return
+        rows = self.table.ensure(ids)
+        jrows = jnp.asarray(rows)
+        self.table.array = self.table.array.at[jrows].add(
+            jnp.asarray(deltas, dtype=jnp.float32)
+        )
+        if self.state == ONLINE:
+            # Online pushes emit the updated vectors (:335) — and persist,
+            # which the reference's normalUpdate forgets (module docstring)
+            new = np.asarray(self.table.array[jrows])
+            outputs.extend(
+                (int(i), new[j]) for j, i in enumerate(ids.tolist())
+            )
+
+    def on_control(self, worker_id: int, payload: Any,
+                   outputs: list) -> None:
+        if payload == "batch_start":
+            self._batch_started_sign(worker_id)
+        elif payload == "batch_end":
+            self._batch_finished_sign(worker_id)
+        else:
+            raise ValueError(f"unknown control payload {payload!r}")
+
+    # -- state transitions ---------------------------------------------------
+
+    def _batch_started_sign(self, worker_id: int) -> None:
+        """≙ batchStartedSign + the onPushRecv dispatch (:286-315).
+
+        ``_started`` stays populated until the whole batch completes (the
+        reference clears it on entering Batch, :292): a fast worker can
+        finish its entire replay before a slow worker has even signed start,
+        so end-signs must remain attributable to started workers."""
+        if worker_id in self._started:
+            raise RuntimeError(
+                f"duplicate batch-start sign from worker {worker_id}"
+            )
+        if self.state == ONLINE:
+            self.state = BATCH_INIT
+            # retrain from scratch: drop every parameter (:313-314)
+            self.table = GrowableFactorTable(self._initializer,
+                                             device_put=self._device_put)
+        self._started.add(worker_id)
+        if len(self._started) == self.worker_parallelism:
+            self.state = BATCH  # (:289-295)
+
+    def _batch_finished_sign(self, worker_id: int) -> None:
+        """≙ batchFinishedSign (:271-281, 316-323) — accepted in BatchInit
+        too (the reference throws there, :318-320, which makes a fast
+        worker's early finish fatal; worker skew is normal, not an error)."""
+        if worker_id not in self._started:
+            raise RuntimeError(
+                f"batch-end sign from worker {worker_id} that never signed "
+                "batch start"
+            )
+        if worker_id in self._finished:
+            raise RuntimeError(
+                f"duplicate batch-end sign from worker {worker_id}"
+            )
+        self._finished.add(worker_id)
+        if len(self._finished) == self.worker_parallelism:
+            self._finished.clear()
+            self._started.clear()
+            self.state = ONLINE
+            self.batches_seen += 1
+
+    def snapshot(self) -> dict[int, np.ndarray]:
+        return self.table.as_dict()
+
+
+class PSOnlineBatchMF:
+    """Driver: stream ratings and triggers through the PS topology.
+
+    ≙ ``PSOfflineOnlineMF.offlineOnlinePS(ratings, batchTrainingTrigger,
+    ...)`` (PSOfflineOnlineMF.scala:36-46). The single event stream may
+    contain ``BATCH_TRIGGER`` sentinels; each is broadcast to every worker
+    (≙ trigger.flatMap to per-worker markers, :385), ratings are routed by
+    ``user % workerParallelism`` (:374-383).
+    """
+
+    def __init__(self, config: PSOnlineBatchConfig | None = None):
+        self.config = config or PSOnlineBatchConfig()
+        self.user_factors: dict[int, np.ndarray] = {}
+        self.item_factors: dict[int, np.ndarray] = {}
+        self.online_user_updates: list = []
+        self.online_item_updates: list = []
+
+    def run(self, events, iteration_wait_time: float | None = None):
+        """Consume a finite event stream to completion and return the final
+        (user_factors, item_factors)."""
+        cfg = self.config
+        W = cfg.worker_parallelism
+        inputs: list[list] = [[] for _ in range(W)]
+        for ev in events:
+            if ev is BATCH_TRIGGER:
+                for w in range(W):
+                    inputs[w].append(BATCH_TRIGGER)
+            else:
+                u = int(ev[0])
+                inputs[abs(u) % W].append(ev)
+
+        workers = [OnlineBatchWorkerLogic(cfg, w) for w in range(W)]
+        init = PseudoRandomFactorInitializer(cfg.num_factors,
+                                             scale=cfg.init_scale)
+        import jax
+
+        devices = jax.local_devices()
+        store = ShardedParameterStore(
+            lambda p: AdaptivePSLogic(init, W,
+                                      device=devices[p % len(devices)]),
+            cfg.ps_parallelism,
+        )
+        # pull windows are enforced by the worker state machine itself
+        # (pull_limit vs pull_limit_online by state), so the client-level
+        # window stays open
+        worker_outs, ps_outs = ps_transform(
+            inputs, workers, store, pull_limit=None,
+            iteration_wait_time=iteration_wait_time,
+        )
+
+        # online emissions: (user, vec) from workers, (item, vec) from PS —
+        # the two sides of the reference's Either output (:46)
+        self.online_user_updates = [x for out in worker_outs for x in out]
+        self.online_item_updates = list(ps_outs)
+        # final model: last emission per user + server snapshot
+        self.user_factors = {int(i): np.asarray(v)
+                             for (i, v) in self.online_user_updates}
+        self.item_factors = store.snapshot()
+        self.workers = workers
+        self.store = store
+        return self.user_factors, self.item_factors
+
+    # -- scoring (same contract as ps.mf) ------------------------------------
+
+    def predict(self, user_ids, item_ids) -> np.ndarray:
+        from large_scale_recommendation_tpu.ps.mf import PSOfflineMF
+
+        user_ids = np.asarray(user_ids, dtype=np.int64)
+        item_ids = np.asarray(item_ids, dtype=np.int64)
+        rank = self.config.num_factors
+        uu, u_ok = PSOfflineMF._lookup(self.user_factors, user_ids, rank)
+        vv, i_ok = PSOfflineMF._lookup(self.item_factors, item_ids, rank)
+        return np.einsum("nk,nk->n", uu, vv) * u_ok * i_ok
+
+    def rmse(self, data: Ratings) -> float:
+        """RMSE over pairs whose user AND item are known (predict masks
+        unknown pairs to exactly 0)."""
+        ru, ri, rv, rw = data.to_numpy()
+        real = rw > 0
+        ru, ri, rv = ru[real], ri[real], rv[real]
+        pred = self.predict(ru, ri)
+        known = pred != 0
+        if not known.any():
+            return float("nan")
+        res = rv[known] - pred[known]
+        return float(np.sqrt(np.mean(res * res)))

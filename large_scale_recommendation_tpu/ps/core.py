@@ -58,6 +58,25 @@ class PushRequest:
 
 
 @dataclasses.dataclass
+class ControlMessage:
+    """Worker → one specific PS shard: a control-plane event, delivered
+    through the SAME queue as that worker's pulls/pushes (so it is ordered
+    after everything the worker already sent — the property the reference's
+    in-band encoding exists to provide).
+
+    ≙ the magic pushes ``(−psId, Array())`` = batch start and
+    ``(−psId, Array(−1.0))`` = batch end (PSOfflineOnlineMF.scala:89-92,
+    223-227) together with the partitioner special-case that routes them to
+    shard ``−psIndex`` (:361-368). Flink's homogeneous wire format forces
+    that encoding; an in-process runtime can say what it means — a typed
+    envelope with a ``payload`` string — while keeping the identical in-band
+    ordering semantics."""
+
+    worker_id: int
+    payload: Any
+
+
+@dataclasses.dataclass
 class PullAnswer:
     """PS → worker: the requested rows.
     ≙ ``WorkerIn(id, workerPartitionIndex, P)``.
@@ -82,6 +101,12 @@ class ParameterServerClient(Protocol):
     def pull(self, ids: np.ndarray) -> None: ...
 
     def push(self, ids: np.ndarray, deltas: np.ndarray) -> None: ...
+
+    def control(self, shard_id: int, payload: Any) -> None:
+        """Send a control event to one shard, ordered after this worker's
+        earlier traffic (≙ the −psId control pushes,
+        PSOfflineOnlineMF.scala:89-92)."""
+        ...
 
     def output(self, value: Any) -> None: ...
 
@@ -115,7 +140,18 @@ class ParameterServerLogic(Protocol):
         ...
 
     def on_push(self, ids: np.ndarray, deltas: np.ndarray,
-                outputs: list) -> None:
+                outputs: list, worker_id: int = -1) -> None:
         """Apply deltas; append any (id, new_value) emissions to outputs.
-        ≙ ``onPushRecv`` emitting via ``ps.output``."""
+        ≙ ``onPushRecv(id, delta, workerPartitionIndex, ps)`` emitting via
+        ``ps.output`` — ``worker_id`` is the workerPartitionIndex, which
+        state-machine servers use for per-worker admission
+        (PSOfflineOnlineMF.scala:298-356)."""
+        ...
+
+    def on_control(self, worker_id: int, payload: Any,
+                   outputs: list) -> None:
+        """Handle an in-band control event. Optional — only state-machine
+        servers implement it; sending control to a shard whose logic lacks
+        it fails the topology fast (AttributeError), matching the
+        reference's throw-on-protocol-violation style (SURVEY §5)."""
         ...

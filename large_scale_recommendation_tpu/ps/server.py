@@ -48,7 +48,7 @@ class SimplePSLogic:
         return np.asarray(self.table.array[jnp.asarray(rows)])
 
     def on_push(self, ids: np.ndarray, deltas: np.ndarray,
-                outputs: list) -> None:
+                outputs: list, worker_id: int = -1) -> None:
         """push → merge delta, optionally emit (id, newValue)
         (SimplePSLogic.scala:20-24).
 

@@ -42,6 +42,7 @@ from typing import Any, Iterable, Sequence
 import numpy as np
 
 from large_scale_recommendation_tpu.ps.core import (
+    ControlMessage,
     PullAnswer,
     PullRequest,
     PushRequest,
@@ -86,6 +87,12 @@ class _WorkerClient:
             PushRequest(self._id, np.asarray(ids, np.int64),
                         np.asarray(deltas, np.float32))
         )
+
+    def control(self, shard_id: int, payload: Any) -> None:
+        """≙ the −psId control pushes routed straight to shard psId
+        (PSOfflineOnlineMF.scala:89-92,361-368) — same shard queue as data
+        traffic, so it stays ordered after this worker's earlier messages."""
+        self._topo._route_control(shard_id, ControlMessage(self._id, payload))
 
     def output(self, value: Any) -> None:
         self.outputs.append(value)
@@ -200,6 +207,9 @@ class PSTopology:
                 PushRequest(req.worker_id, req.ids[m], req.deltas[m])
             )
 
+    def _route_control(self, shard_id: int, msg: ControlMessage) -> None:
+        self._shard_queues[shard_id].put(msg)
+
     # -- threads -------------------------------------------------------------
 
     def _worker_main(self, w: int, inputs: Iterable[Any]) -> None:
@@ -259,9 +269,16 @@ class PSTopology:
                         ("answer", PullAnswer(req.ids, values,
                                               request_id=req.request_id))
                     )
+                elif isinstance(req, ControlMessage):
+                    out = []
+                    logic.on_control(req.worker_id, req.payload, out)
+                    if out:
+                        with self._ps_lock:
+                            self.ps_outputs.extend(out)
                 else:
-                    out: list = []
-                    logic.on_push(req.ids, req.deltas, out)
+                    out = []
+                    logic.on_push(req.ids, req.deltas, out,
+                                  worker_id=req.worker_id)
                     if out:
                         with self._ps_lock:
                             self.ps_outputs.extend(out)

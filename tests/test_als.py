@@ -136,8 +136,8 @@ class TestALS:
         gen = SyntheticMFGenerator(num_users=30, num_items=30, rank=3,
                                    noise=0.1, seed=5)
         r = gen.generate(2000)
-        m1 = ALS(ALSConfig(num_factors=4, iterations=3, chunk_size=256)).fit(r)
-        m2 = ALS(ALSConfig(num_factors=4, iterations=3, chunk_size=256)).fit(r)
+        m1 = ALS(ALSConfig(num_factors=4, iterations=3)).fit(r)
+        m2 = ALS(ALSConfig(num_factors=4, iterations=3)).fit(r)
         np.testing.assert_array_equal(np.asarray(m1.U), np.asarray(m2.U))
 
 
@@ -180,3 +180,50 @@ class TestMeshALS:
             mesh=make_block_mesh(4),
         ).fit(gen.generate(8000))
         assert model.rmse(gen.generate(2000)) < 0.12
+
+
+class TestSolvePlan:
+    """The bucketed-matmul gram layout (ops.als.build_solve_plan) — the
+    no-scatter formulation the single-chip ALS driver now runs on."""
+
+    def test_plan_covers_every_rating_exactly_once(self):
+        rng = np.random.default_rng(0)
+        e, n_rows = 5000, 200
+        out_rows = rng.integers(0, n_rows, e)
+        other = rng.integers(0, 300, e)
+        vals = rng.normal(size=e).astype(np.float32)
+        plan = als_ops.build_solve_plan(out_rows, other, vals, n_rows)
+        # every row with >=1 rating appears in exactly one bucket
+        seen_rows = np.concatenate([b[0] for b in plan.buckets])
+        assert len(seen_rows) == len(np.unique(seen_rows))
+        assert set(seen_rows.tolist()) == set(np.unique(out_rows).tolist())
+        # real (weight-1) slots reproduce each row's rating multiset
+        total_real = sum(int(b[3].sum()) for b in plan.buckets)
+        assert total_real == e
+        # bucket widths are pow2 and wide enough for their rows
+        counts = np.bincount(out_rows, minlength=n_rows)
+        for rows, oidx, _, w in plan.buckets:
+            pad = oidx.shape[1]
+            assert pad & (pad - 1) == 0
+            assert (w.sum(axis=1).astype(int) == counts[rows]).all()
+
+    def test_solve_side_matches_dense_normal_equations(self):
+        rng = np.random.default_rng(1)
+        k, n_rows, n_other, e = 4, 30, 25, 600
+        out_rows = rng.integers(0, n_rows, e)
+        other = rng.integers(0, n_other, e)
+        vals = rng.normal(size=e).astype(np.float32)
+        F = rng.normal(size=(n_other, k)).astype(np.float32)
+        lam = 0.3
+        plan = als_ops.build_solve_plan(out_rows, other, vals, n_rows)
+        prep = als_ops.prepare_side(plan, None, k)
+        got = np.asarray(als_ops.solve_side(jnp.asarray(F), prep, n_rows, lam))
+        # dense oracle
+        want = np.zeros((n_rows, k), np.float32)
+        for r in range(n_rows):
+            m = out_rows == r
+            Vr = F[other[m]]
+            A = Vr.T @ Vr + lam * np.eye(k)
+            b = Vr.T @ vals[m]
+            want[r] = np.linalg.solve(A, b)
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)

@@ -189,3 +189,52 @@ class TestMetrics:
         with M.profile(str(tmp_path)):
             (jnp.ones((32, 32)) @ jnp.ones((32, 32))).block_until_ready()
         assert any(tmp_path.rglob("*"))
+
+
+class TestSegmentedMeshDSGD:
+    """Same checkpoint contract on the multi-chip driver (VERDICT r2 #7):
+    segment boundaries and resume must not change the math on the mesh."""
+
+    def _mesh_cfg(self):
+        from large_scale_recommendation_tpu.parallel.dsgd_mesh import (
+            MeshDSGDConfig,
+        )
+
+        return MeshDSGDConfig(num_factors=4, iterations=6, seed=0,
+                              minibatch_size=64)  # default η/√t decay
+
+    def test_segmented_equals_straight_run(self, tmp_path):
+        from large_scale_recommendation_tpu.parallel.dsgd_mesh import MeshDSGD
+
+        gen = SyntheticMFGenerator(num_users=64, num_items=48, rank=4, seed=5)
+        train = gen.generate(4000)
+        straight = MeshDSGD(self._mesh_cfg()).fit(train)
+
+        mgr = CheckpointManager(str(tmp_path))
+        segmented = MeshDSGD(self._mesh_cfg()).fit(
+            train, checkpoint_manager=mgr, checkpoint_every=2)
+        np.testing.assert_allclose(np.asarray(segmented.U),
+                                   np.asarray(straight.U),
+                                   rtol=1e-5, atol=1e-6)
+        assert mgr.latest_step() == 6
+
+    def test_resume_from_partial(self, tmp_path):
+        from large_scale_recommendation_tpu.parallel.dsgd_mesh import (
+            MeshDSGD,
+            MeshDSGDConfig,
+        )
+
+        gen = SyntheticMFGenerator(num_users=64, num_items=48, rank=4, seed=6)
+        train = gen.generate(4000)
+        mgr = CheckpointManager(str(tmp_path))
+        half = MeshDSGDConfig(num_factors=4, iterations=4, seed=0,
+                              minibatch_size=64)
+        MeshDSGD(half).fit(train, checkpoint_manager=mgr, checkpoint_every=2)
+        assert mgr.latest_step() == 4
+
+        resumed = MeshDSGD(self._mesh_cfg()).fit(
+            train, checkpoint_manager=mgr, checkpoint_every=2, resume=True)
+        straight = MeshDSGD(self._mesh_cfg()).fit(train)
+        np.testing.assert_allclose(np.asarray(resumed.U),
+                                   np.asarray(straight.U),
+                                   rtol=1e-5, atol=1e-6)

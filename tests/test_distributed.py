@@ -1,0 +1,88 @@
+"""Multi-host design spike (VERDICT r2 task 5): jax.distributed bring-up,
+per-host rating sharding, and the mesh-DSGD superstep loop running over a
+process-spanning mesh — driven as a REAL 2-process run on localhost.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from large_scale_recommendation_tpu.parallel.distributed import (
+    DistributedConfig,
+    host_rating_shard,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestHostShard:
+    def test_shards_tile_the_dataset(self):
+        """≙ partitionCustom by user (PSOfflineMF.scala:70-72): the per-host
+        filters are disjoint and complete."""
+        rng = np.random.default_rng(0)
+        ru = rng.integers(0, 1000, 5000)
+        ri = rng.integers(0, 300, 5000)
+        rv = rng.normal(size=5000).astype(np.float32)
+        parts = [host_rating_shard(ru, ri, rv, p, 3) for p in range(3)]
+        assert sum(len(p[0]) for p in parts) == 5000
+        seen = np.concatenate([np.stack([p[0], p[1]]) for p in parts], axis=1)
+        assert seen.shape[1] == 5000
+        # user-disjoint: a user's ratings land on exactly one host
+        for p, (u, _, _) in enumerate(parts):
+            assert (np.abs(u) % 3 == p).all()
+
+    def test_config_from_env(self, monkeypatch):
+        monkeypatch.setenv("LSR_COORDINATOR", "1.2.3.4:555")
+        monkeypatch.setenv("LSR_NUM_PROCESSES", "4")
+        monkeypatch.setenv("LSR_PROCESS_ID", "2")
+        cfg = DistributedConfig.from_env()
+        assert cfg == DistributedConfig("1.2.3.4:555", 4, 2)
+
+    def test_single_process_is_noop(self):
+        from large_scale_recommendation_tpu.parallel.distributed import (
+            initialize_distributed,
+        )
+
+        assert initialize_distributed(DistributedConfig()) is False
+
+
+@pytest.mark.slow
+class TestTwoProcessDemo:
+    def test_two_process_cpu_demo(self):
+        """Launch the demo as two REAL processes coordinated over localhost;
+        the global 4-device mesh spans both, so the ppermute ring crosses
+        the process boundary (the DCN hop of SURVEY §2.3)."""
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        env_base = {
+            **os.environ,
+            "LSR_COORDINATOR": f"127.0.0.1:{port}",
+            "LSR_NUM_PROCESSES": "2",
+            "JAX_PLATFORMS": "cpu",
+        }
+        procs = [
+            subprocess.Popen(
+                [sys.executable,
+                 os.path.join(REPO, "examples", "distributed_demo.py")],
+                env={**env_base, "LSR_PROCESS_ID": str(p)},
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+                cwd=REPO,
+            )
+            for p in range(2)
+        ]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=420)
+                outs.append(out)
+        finally:
+            for p in procs:
+                p.kill()
+        assert all(p.returncode == 0 for p in procs), \
+            "\n---\n".join(outs)[-4000:]
+        assert "DISTRIBUTED DEMO PASS" in outs[0], outs[0][-2000:]
