@@ -1,22 +1,28 @@
-"""Benchmark: DSGD training throughput on one chip (+ ALS, RMSE context).
+"""Benchmark: the BASELINE.md north-star configs on one chip.
 
-Primary metric: ratings/sec/chip on a synthetic ML-25M-shaped DSGD workload
-(BASELINE.md north star). The baseline is the reference's own inner-loop
-style — a sequential per-rating NumPy SGD loop, the direct analogue of
-DSGDforMF.scala:398-417 / netlib ddot — measured on the same host.
+Headline metric: ratings/sec/chip for DSGD on the ML-25M-shaped skewed
+workload (162K users x 59K items, ~23.7M train ratings) at rank 128, with
+wall-clock to a pre-registered RMSE target and achieved-bandwidth/MFU
+accounting. Extra lines: bucketed ALS rows-solved/s at rank 128 and 256,
+sustained online-stream ratings/s at rank 128, and PS-mode throughput.
+
+The baseline for ``vs_baseline`` is the reference's own inner-loop style —
+a sequential per-rating NumPy SGD loop, the direct analogue of
+DSGDforMF.scala:398-417 (netlib ddot per rating) — measured on this host.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
-Extra context (ALS rows/s, RMSE, wall) rides in an "extra" sub-object and
-on stderr; a hard failure still prints the JSON line with an "error" field.
+Context rides in "extra" and on stderr; a hard failure still prints the
+JSON line with an "error" field.
 
-Structure (round-1 lesson, VERDICT.md: one backend failure must not cost the
-round its perf evidence): the parent process never imports jax. It runs the
-real benchmark in a child subprocess, retries transient TPU-backend failures
+Structure (round-1 lesson: one backend failure must not cost the round its
+perf evidence): the parent process never imports jax. It runs the real
+benchmark in a child subprocess, retries transient TPU-backend failures
 with backoff, and falls back to a reduced CPU run if the chip stays
-unavailable — so a JSON line is ALWAYS emitted.
+unavailable — a JSON line is ALWAYS emitted.
 
-Env knobs: BENCH_NNZ, BENCH_RANK, BENCH_ITERS, BENCH_USERS, BENCH_ITEMS,
-BENCH_MB (minibatch), BENCH_BLOCKS, BENCH_TIMEOUT (per-attempt seconds).
+Env knobs: BENCH_NNZ, BENCH_RANK, BENCH_ITERS (max sweeps), BENCH_MB,
+BENCH_BLOCKS, BENCH_RMSE_TARGET, BENCH_TIMEOUT (per-attempt seconds),
+BENCH_SKIP_EXTRAS (=1 → DSGD line only).
 """
 
 from __future__ import annotations
@@ -29,17 +35,16 @@ import time
 
 import numpy as np
 
+# v5e (TPU v5 lite) single-chip peaks for the roofline accounting
+HBM_PEAK_GBS = 819.0
+BF16_PEAK_TFLOPS = 197.0
+FP32_PEAK_TFLOPS = 49.0
 
-# --------------------------------------------------------------------------
-# Child: the actual measurement (runs in a subprocess; may die on backend
-# errors — the parent handles that).
-# --------------------------------------------------------------------------
 
-def _numpy_sequential_baseline(ratings, rank, sample=150_000, lr=0.01,
+def _numpy_sequential_baseline(ru, ri, rv, rank, sample=150_000, lr=0.01,
                                lam=0.1, seed=0):
-    """Reference-style sequential per-rating SGD (the Flink/Spark inner loop,
-    DSGDforMF.scala:398-417) in NumPy — ratings/sec on host CPU."""
-    ru, ri, rv, _ = ratings.to_numpy()
+    """Reference-style sequential per-rating SGD (the Flink/Spark inner
+    loop, DSGDforMF.scala:398-417) in NumPy — ratings/sec on host CPU."""
     n = min(sample, len(ru))
     rng = np.random.default_rng(seed)
     nu, ni = int(ru.max()) + 1, int(ri.max()) + 1
@@ -57,101 +62,224 @@ def _numpy_sequential_baseline(ratings, rank, sample=150_000, lr=0.01,
 
 
 def run_child() -> None:
-    nnz = int(os.environ.get("BENCH_NNZ", 2_000_000))
-    rank = int(os.environ.get("BENCH_RANK", 64))
-    iters = int(os.environ.get("BENCH_ITERS", 5))
-    num_users = int(os.environ.get("BENCH_USERS", 100_000))
-    num_items = int(os.environ.get("BENCH_ITEMS", 20_000))
-    mb = int(os.environ.get("BENCH_MB", 8192))
-    blocks = int(os.environ.get("BENCH_BLOCKS", 4))
+    nnz = int(os.environ.get("BENCH_NNZ", 25_000_095))
+    rank = int(os.environ.get("BENCH_RANK", 128))
+    max_iters = int(os.environ.get("BENCH_ITERS", 12))
+    mb = int(os.environ.get("BENCH_MB", 32768))
+    blocks = int(os.environ.get("BENCH_BLOCKS", 8))
+    # Pre-registered target for the ML-25M-shaped stand-in: planted rank-16
+    # structure, noise 0.1 (rating std ≈ 0.27, noise floor 0.1) → holdout
+    # RMSE 0.155 means the model has recovered essentially all learnable
+    # structure (the analogue of "RMSE 0.85 on real ML-25M", whose absolute
+    # value is a property of the real data). Noise 0.1, not the
+    # synthetic_like default 0.3: at 0.3 the SNR is < 1 and NO solver beats
+    # predict-zero — measured, not assumed (ALS plateaus at the data std).
+    rmse_target = float(os.environ.get("BENCH_RMSE_TARGET", 0.155))
+    skip_extras = os.environ.get("BENCH_SKIP_EXTRAS") == "1"
 
     if os.environ.get("BENCH_FORCE_CPU") == "1":
-        # Env JAX_PLATFORMS alone is not enough where a site hook pins the
-        # jax_platforms config to the accelerator (utils/platform.py).
         from large_scale_recommendation_tpu.utils.platform import force_cpu
 
         force_cpu()
 
     import jax
+    import jax.numpy as jnp
 
+    from large_scale_recommendation_tpu.data import blocking
+    from large_scale_recommendation_tpu.data.movielens import synthetic_like
+    from large_scale_recommendation_tpu.models.dsgd import DSGD, DSGDConfig
+    from large_scale_recommendation_tpu.ops import sgd as sgd_ops
+
+    device = jax.devices()[0]
+    extra: dict = {"device": str(device), "nnz": nnz, "rank": rank,
+                   "blocks": blocks, "minibatch": mb,
+                   "rmse_target": rmse_target}
+
+    # ---- data: ML-25M-shaped skewed planted-low-rank stand-in ------------
+    t0 = time.perf_counter()
+    train, holdout = synthetic_like("ml-25m", nnz=nnz, rank=16, noise=0.1,
+                                    seed=0, skew_lam=2.0)
+    extra["gen_wall_s"] = round(time.perf_counter() - t0, 1)
+    ru, ri, rv, _ = train.to_numpy()
+
+    # ---- blocking (one-time host pass) -----------------------------------
+    t0 = time.perf_counter()
+    # λ=0.1 with the λ/ω rule ≈ an lr·λ total shrink per sweep — scaled to
+    # the stand-in's signal magnitude (λ=1 over-regularizes it to the
+    # predict-zero plateau; grid-searched on CPU before pinning)
+    cfg = DSGDConfig(num_factors=rank, lambda_=0.1, iterations=1,
+                     learning_rate=0.3, lr_schedule="constant", seed=0,
+                     minibatch_size=mb, init_scale=0.08,
+                     collision_mode="mean")
+    problem = blocking.block_problem(train, num_blocks=blocks, seed=0,
+                                     minibatch_multiple=mb)
+    icu, icv = blocking.minibatch_inv_counts(problem.ratings, mb)
+    extra["blocking_wall_s"] = round(time.perf_counter() - t0, 1)
+    extra["max_pad_ratio"] = round(problem.ratings.max_pad_ratio, 3)
+
+    # ---- device placement ------------------------------------------------
+    t0 = time.perf_counter()
+    solver = DSGD(cfg)
+    U, V = solver._init_factors(problem)
+    args = (
+        jnp.asarray(problem.ratings.u_rows, jnp.int32),
+        jnp.asarray(problem.ratings.i_rows, jnp.int32),
+        jnp.asarray(problem.ratings.values, jnp.float32),
+        jnp.asarray(problem.ratings.weights, jnp.float32),
+        jnp.asarray(problem.users.omega),
+        jnp.asarray(problem.items.omega),
+        jnp.asarray(icu),
+        jnp.asarray(icv),
+    )
+    hu, hi, hv, _ = holdout.to_numpy()
+    hur, hum = problem.users.rows_for(hu)
+    hir, him = problem.items.rows_for(hi)
+    hmask = jnp.asarray(hum * him)
+    hur_d, hir_d = jnp.asarray(hur), jnp.asarray(hir)
+    hv_d = jnp.asarray(hv)
+    n_eval = float(np.asarray(hum * him).sum())
+    jax.block_until_ready(args)
+    extra["device_put_wall_s"] = round(time.perf_counter() - t0, 1)
+
+    def rmse(U, V):
+        sse = sgd_ops.sse_rows(U, V, hur_d, hir_d, hv_d, hmask)
+        return float(np.sqrt(float(sse) / n_eval))
+
+    kw = dict(updater=solver.updater, minibatch=mb, num_blocks=blocks,
+              iterations=1, collision="mean")
+
+    # warm-up: compile the per-sweep kernel
+    t0 = time.perf_counter()
+    Uw, Vw = sgd_ops.dsgd_train(U, V, *args, **kw, t0=0)
+    jax.block_until_ready((Uw, Vw))
+    extra["compile_wall_s"] = round(time.perf_counter() - t0, 1)
+
+    # ---- timed training: sweep-by-sweep until the RMSE target ------------
+    train_wall = 0.0
+    time_to_target = None
+    sweeps_to_target = None
+    rmse_now = rmse(U, V)
+    curve = [round(rmse_now, 4)]
+    for it in range(max_iters):
+        t0 = time.perf_counter()
+        U, V = sgd_ops.dsgd_train(U, V, *args, **kw, t0=it)
+        jax.block_until_ready((U, V))
+        train_wall += time.perf_counter() - t0
+        rmse_now = rmse(U, V)
+        curve.append(round(rmse_now, 4))
+        if time_to_target is None and rmse_now <= rmse_target:
+            time_to_target = train_wall
+            sweeps_to_target = it + 1
+            break
+    sweeps = sweeps_to_target or max_iters
+    throughput = nnz * sweeps / train_wall
+
+    # roofline accounting: per rating ~4 row transactions (read+write of a
+    # u row and a v row) of rank*4 bytes + 16B of COO stream; FLOPs ~6*rank
+    bytes_per_rating = 4 * rank * 4 + 16
+    flops_per_rating = 6 * rank
+    eff_gbs = throughput * bytes_per_rating / 1e9
+    eff_tflops = throughput * flops_per_rating / 1e12
+    extra.update({
+        "dsgd_train_wall_s": round(train_wall, 2),
+        "dsgd_sweeps": sweeps,
+        "rmse_curve": curve,
+        "rmse_final": round(rmse_now, 4),
+        "time_to_rmse_target_s": (None if time_to_target is None
+                                  else round(time_to_target, 2)),
+        "sweeps_to_target": sweeps_to_target,
+        "effective_hbm_gbs": round(eff_gbs, 1),
+        "pct_of_hbm_peak": round(100 * eff_gbs / HBM_PEAK_GBS, 2),
+        "effective_tflops": round(eff_tflops, 3),
+        "pct_of_fp32_peak": round(100 * eff_tflops / FP32_PEAK_TFLOPS, 3),
+    })
+
+    baseline = _numpy_sequential_baseline(ru, ri, rv, rank)
+    extra["numpy_seq_baseline_ratings_per_s"] = round(baseline, 1)
+
+    if not skip_extras:
+        _extra_lines(extra, rank, jax)
+
+    result = {
+        "metric": (f"ratings/sec/chip (DSGD, ML-25M-shaped skewed, "
+                   f"rank={rank}, {nnz/1e6:.1f}M ratings, "
+                   f"{blocks}x{blocks} strata)"),
+        "value": round(throughput, 1),
+        "unit": "ratings/s",
+        "vs_baseline": round(throughput / baseline, 2),
+        "extra": extra,
+    }
+    print(json.dumps(result))
+    print(f"# {json.dumps(extra)}", file=sys.stderr)
+
+
+def _extra_lines(extra: dict, rank: int, jax) -> None:
+    """ALS (rank 128 + 256), online-stream, and PS-mode lines."""
     from large_scale_recommendation_tpu.core.generators import (
         SyntheticMFGenerator,
     )
     from large_scale_recommendation_tpu.models.als import ALS, ALSConfig
-    from large_scale_recommendation_tpu.models.dsgd import DSGD, DSGDConfig
-
-    device = jax.devices()[0]
-
-    gen = SyntheticMFGenerator(num_users=num_users, num_items=num_items,
-                               rank=min(rank, 32), noise=0.1, seed=0)
-    ratings = gen.generate(nnz)
-    holdout = gen.generate(100_000)
-
-    cfg = DSGDConfig(
-        num_factors=rank, lambda_=0.05, iterations=iters,
-        learning_rate=0.05, lr_schedule="constant", seed=0,
-        minibatch_size=mb, init_scale=0.1,
+    from large_scale_recommendation_tpu.models.online import (
+        OnlineMF,
+        OnlineMFConfig,
     )
 
-    # Warm-up: compile (and one full run, first compile is slow).
-    warm_cfg = DSGDConfig(
-        num_factors=rank, lambda_=0.05, iterations=1,
-        learning_rate=0.05, lr_schedule="constant", seed=0,
-        minibatch_size=mb, init_scale=0.1,
-    )
-    DSGD(warm_cfg).fit(ratings, num_blocks=blocks).U.block_until_ready()
+    # ---- ALS: bucketed-matmul normal equations ---------------------------
+    als_nnz = int(os.environ.get("BENCH_ALS_NNZ", 5_000_000))
+    gen = SyntheticMFGenerator(num_users=162_541, num_items=59_047, rank=16,
+                               noise=0.1, seed=1, skew_lam=2.0)
+    als_ratings = gen.generate(als_nnz)
+    for als_rank, iters in ((rank, 2), (256, 1)):
+        # λ scaled to the stand-in's signal magnitude (see run_child note)
+        cfg = ALSConfig(num_factors=als_rank, lambda_=0.01, iterations=iters,
+                        seed=0)
+        ALS(cfg).fit(als_ratings).U.block_until_ready()  # compile warm-up
+        t0 = time.perf_counter()
+        m = ALS(cfg).fit(als_ratings)
+        m.U.block_until_ready()
+        wall = time.perf_counter() - t0
+        rows = (m.U.shape[0] + m.V.shape[0]) * iters
+        extra[f"als_rank{als_rank}_rows_per_s"] = round(rows / wall, 1)
+        extra[f"als_rank{als_rank}_wall_s"] = round(wall, 2)
+    extra["als_nnz"] = als_nnz
 
-    solver = DSGD(cfg)
+    # ---- online stream: Netflix-shaped micro-batches ---------------------
+    on_batches = int(os.environ.get("BENCH_ONLINE_BATCHES", 20))
+    on_bs = int(os.environ.get("BENCH_ONLINE_BATCH", 200_000))
+    ngen = SyntheticMFGenerator(num_users=480_189, num_items=17_770, rank=16,
+                                noise=0.1, seed=2, skew_lam=2.0)
+    batches = [ngen.generate(on_bs) for _ in range(on_batches)]
+    om = OnlineMF(OnlineMFConfig(num_factors=rank, learning_rate=0.05,
+                                 minibatch_size=16384, init_capacity=1 << 19))
+    om.partial_fit(batches[0])  # warm-up (compile + table growth)
     t0 = time.perf_counter()
-    model = solver.fit(ratings, num_blocks=blocks)
-    model.U.block_until_ready()
-    dsgd_wall = time.perf_counter() - t0
-    # NOTE: wall includes the host blocking pass (fair: the reference's
-    # supersteps include their shuffles).
-    throughput = nnz * iters / dsgd_wall
-    rmse = model.rmse(holdout)
+    for b in batches[1:]:
+        om.partial_fit(b)
+    jax.block_until_ready(om.users.array)
+    wall = time.perf_counter() - t0
+    extra["online_ratings_per_s"] = round(on_bs * (on_batches - 1) / wall, 1)
+    extra["online_wall_s"] = round(wall, 2)
 
-    baseline = _numpy_sequential_baseline(ratings, rank)
-
-    # ALS: the MXU-heavy path — rows solved (normal-equation Cholesky) per
-    # second, ≙ the reference's MLlib ALS retrain branch
-    # (OnlineSpark.scala:125-131).
-    als_nnz = min(nnz, 1_000_000)
-    als_cfg = ALSConfig(num_factors=rank, lambda_=0.1, iterations=2,
-                        seed=0, chunk_size=65536)
-    als_ratings = ratings if als_nnz == nnz else gen.generate(als_nnz)
-    als = ALS(als_cfg)
-    als.fit(als_ratings).U.block_until_ready()  # compile warm-up
-    t0 = time.perf_counter()
-    als_model = ALS(als_cfg).fit(als_ratings)
-    als_model.U.block_until_ready()
-    als_wall = time.perf_counter() - t0
-    als_rows = (als_model.U.shape[0] + als_model.V.shape[0]) * als_cfg.iterations
-    als_rows_per_s = als_rows / als_wall
-
-    result = {
-        "metric": f"ratings/sec/chip (synthetic DSGD rank={rank}, "
-                  f"{nnz / 1e6:g}M ratings, {blocks}x{blocks} strata)",
-        "value": round(throughput, 1),
-        "unit": "ratings/s",
-        "vs_baseline": round(throughput / baseline, 2),
-        "extra": {
-            "device": str(device),
-            "dsgd_wall_s": round(dsgd_wall, 2),
-            "dsgd_rmse_holdout": round(float(rmse), 4),
-            "numpy_seq_baseline_ratings_per_s": round(baseline, 1),
-            "als_rows_solved_per_s": round(als_rows_per_s, 1),
-            "als_wall_s": round(als_wall, 2),
-            "als_nnz": als_nnz,
-        },
-    }
-    print(json.dumps(result))
-    print(
-        f"# wall={dsgd_wall:.2f}s iters={iters} rmse={rmse:.4f} "
-        f"numpy_baseline={baseline:.0f} r/s als={als_rows_per_s:.0f} rows/s "
-        f"device={device}",
-        file=sys.stderr,
+    # ---- PS-mode offline throughput --------------------------------------
+    from large_scale_recommendation_tpu.ps.mf import (
+        PSOfflineMF,
+        PSOfflineMFConfig,
     )
+
+    ps_nnz = int(os.environ.get("BENCH_PS_NNZ", 400_000))
+    pgen = SyntheticMFGenerator(num_users=20_000, num_items=5_000, rank=16,
+                                noise=0.1, seed=3, skew_lam=2.0)
+    ps_ratings = pgen.generate(ps_nnz)
+    ps_cfg = PSOfflineMFConfig(num_factors=rank, iterations=3,
+                               learning_rate=0.05, lr_schedule="inverse_sqrt",
+                               worker_parallelism=4, ps_parallelism=4,
+                               pull_limit=4, chunk_size=512,
+                               minibatch_size=4096)
+    t0 = time.perf_counter()
+    PSOfflineMF(ps_cfg).offline(ps_ratings)
+    wall = time.perf_counter() - t0
+    extra["ps_ratings_per_s"] = round(ps_nnz * ps_cfg.iterations / wall, 1)
+    extra["ps_wall_s"] = round(wall, 2)
 
 
 # --------------------------------------------------------------------------
@@ -193,13 +321,9 @@ def _looks_transient(tail: str) -> bool:
 
 
 def main() -> None:
-    per_attempt = float(os.environ.get("BENCH_TIMEOUT", 1500))
+    per_attempt = float(os.environ.get("BENCH_TIMEOUT", 2400))
     errors: list[str] = []
 
-    # Attempt on whatever backend the environment provides (TPU when
-    # healthy); retry once with backoff only if the failure looks like a
-    # transient backend problem — round-1's failure mode was a transient
-    # "TPU backend setup/compile error (Unavailable)".
     result, tail = _attempt({}, per_attempt)
     if result is not None:
         print(json.dumps(result))
@@ -216,15 +340,16 @@ def main() -> None:
         print(f"# bench attempt 2 failed: {tail[-300:]}", file=sys.stderr)
 
     # CPU fallback on a reduced workload — a real (if slower) number beats
-    # no number; the error field records the actual per-attempt failures
-    # (which may or may not be the accelerator's fault).
+    # no number; the error field records the per-attempt failures.
     cpu_env = {
         "JAX_PLATFORMS": "cpu",
         "BENCH_FORCE_CPU": "1",
-        "BENCH_NNZ": os.environ.get("BENCH_NNZ_CPU", "400000"),
-        "BENCH_ITERS": "2",
-        "BENCH_USERS": "40000",
-        "BENCH_ITEMS": "10000",
+        "BENCH_NNZ": os.environ.get("BENCH_NNZ_CPU", "1000000"),
+        "BENCH_RANK": "32",
+        "BENCH_ITERS": "3",
+        "BENCH_MB": "8192",
+        "BENCH_BLOCKS": "4",
+        "BENCH_SKIP_EXTRAS": "1",
     }
     result, tail = _attempt(cpu_env, per_attempt)
     if result is not None:
@@ -238,7 +363,7 @@ def main() -> None:
 
     # Total failure: still emit the one-line JSON contract.
     print(json.dumps({
-        "metric": "ratings/sec/chip (synthetic DSGD)",
+        "metric": "ratings/sec/chip (DSGD, ML-25M-shaped)",
         "value": 0.0,
         "unit": "ratings/s",
         "vs_baseline": 0.0,

@@ -202,7 +202,7 @@ class AdaptiveMF:
         (≙ batch-finished sign propagation, PSOfflineOnlineMF.scala:316-323).
         """
         if self._state != "Batch":
-            return BatchUpdates([], [], rank=cfg.num_factors)
+            return BatchUpdates([], [], rank=self.config.num_factors)
         if self._thread is not None:
             self._thread.join()
         return self._finish_batch()
@@ -259,7 +259,7 @@ class AdaptiveMF:
             out = self.online.partial_fit(b)
             users.extend(out.user_updates)
             items.extend(out.item_updates)
-        return BatchUpdates(users, items)
+        return BatchUpdates(users, items, rank=self.config.num_factors)
 
     def _install(self, model: MFModel) -> None:
         """Replace the online tables with the retrained factors wholesale.

@@ -56,6 +56,9 @@ class DSGDConfig:
     minibatch_size: int = 1024
     init_scale: float = 1.0  # factor init upper bound (nextDouble ∈ [0,1))
     collision_mode: str = "mean"  # minibatch row-collision handling (ops.sgd)
+    # precompute the "mean"-mode collision scales at blocking time (same
+    # math, removes two full-table scatter+gather rounds per kernel step)
+    precompute_collisions: bool = True
 
     def schedule_fn(self):
         return schedule_from_name(self.lr_schedule, self.lambda_)
@@ -126,6 +129,12 @@ class DSGD:
                 U, V = jnp.asarray(ck["U"]), jnp.asarray(ck["V"])
                 done = latest
 
+        if cfg.precompute_collisions and cfg.collision_mode == "mean":
+            icu, icv = blocking.minibatch_inv_counts(
+                problem.ratings, cfg.minibatch_size)
+            inv = (jnp.asarray(icu), jnp.asarray(icv))
+        else:
+            inv = (None, None)
         args = (
             jnp.asarray(problem.ratings.u_rows, jnp.int32),
             jnp.asarray(problem.ratings.i_rows, jnp.int32),
@@ -133,6 +142,7 @@ class DSGD:
             jnp.asarray(problem.ratings.weights, jnp.float32),
             jnp.asarray(problem.users.omega),
             jnp.asarray(problem.items.omega),
+            *inv,
         )
         segment = checkpoint_every or cfg.iterations
 

@@ -39,6 +39,8 @@ def sgd_minibatch_update(
     updater: Any,
     t: jax.Array | int,
     collision: str = "mean",
+    inv_cu: jax.Array | None = None,
+    inv_cv: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """One minibatch: gather → delta → scatter-add.
 
@@ -53,6 +55,12 @@ def sgd_minibatch_update(
       lr × dup_count and training diverges to NaN.
     - ``collision="sum"``: raw additive accumulation (plain minibatch SGD) —
       closest to sequential semantics when collisions are rare.
+
+    ``inv_cu``/``inv_cv`` are optional PRECOMPUTED per-entry 1/occurrence
+    scales (``data.blocking.minibatch_inv_counts``). When given with
+    ``collision="mean"`` they replace the runtime counters — the counts are
+    a pure function of the static blocked layout, and the runtime form
+    costs two full-table zero+scatter+gather rounds per step.
 
     With ``minibatch=1`` both modes recover the reference's exact sequential
     per-rating semantics.
@@ -73,10 +81,14 @@ def sgd_minibatch_update(
         t=t,
     )
     if collision == "mean":
-        cu = jnp.zeros(U.shape[0], U.dtype).at[u_rows].add(weights)
-        cv = jnp.zeros(V.shape[0], V.dtype).at[i_rows].add(weights)
-        du = du / jnp.maximum(cu[u_rows], 1.0)[:, None]
-        dv = dv / jnp.maximum(cv[i_rows], 1.0)[:, None]
+        if inv_cu is not None:
+            du = du * inv_cu[:, None]
+            dv = dv * inv_cv[:, None]
+        else:
+            cu = jnp.zeros(U.shape[0], U.dtype).at[u_rows].add(weights)
+            cv = jnp.zeros(V.shape[0], V.dtype).at[i_rows].add(weights)
+            du = du / jnp.maximum(cu[u_rows], 1.0)[:, None]
+            dv = dv / jnp.maximum(cv[i_rows], 1.0)[:, None]
     U = U.at[u_rows].add(du)
     V = V.at[i_rows].add(dv)
     return U, V
@@ -95,6 +107,8 @@ def sgd_block_sweep(
     t: jax.Array | int,
     minibatch: int,
     collision: str = "mean",
+    inv_cu: jax.Array | None = None,
+    inv_cv: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Sweep one rating block (or one whole stratum flattened) in minibatch
     chunks via ``lax.scan``.
@@ -111,17 +125,22 @@ def sgd_block_sweep(
     def chunk(a):
         return a.reshape(n_chunks, minibatch)
 
+    pre = inv_cu is not None
+
     def body(carry, xs):
         U, V = carry
-        ur, ir, vals, w = xs
+        ur, ir, vals, w = xs[:4]
+        icu, icv = (xs[4], xs[5]) if pre else (None, None)
         U, V = sgd_minibatch_update(
-            U, V, ur, ir, vals, w, omega_u, omega_v, updater, t, collision
+            U, V, ur, ir, vals, w, omega_u, omega_v, updater, t, collision,
+            icu, icv,
         )
         return (U, V), None
 
-    (U, V), _ = jax.lax.scan(
-        body, (U, V), (chunk(u_rows), chunk(i_rows), chunk(values), chunk(weights))
-    )
+    xs = (chunk(u_rows), chunk(i_rows), chunk(values), chunk(weights))
+    if pre:
+        xs = xs + (chunk(inv_cu), chunk(inv_cv))
+    (U, V), _ = jax.lax.scan(body, (U, V), xs)
     return U, V
 
 
@@ -139,6 +158,8 @@ def dsgd_train(
     sw: jax.Array,
     omega_u: jax.Array,
     omega_v: jax.Array,
+    inv_cu: jax.Array | None = None,  # [k, k, b] precomputed collision
+    inv_cv: jax.Array | None = None,  # scales (blocking.minibatch_inv_counts)
     *,
     updater: Any,
     minibatch: int,
@@ -170,6 +191,8 @@ def dsgd_train(
     flat = (k, k * b)
     su_f, si_f = su.reshape(flat), si.reshape(flat)
     sv_f, sw_f = sv.reshape(flat), sw.reshape(flat)
+    icu_f = None if inv_cu is None else inv_cu.reshape(flat)
+    icv_f = None if inv_cv is None else inv_cv.reshape(flat)
 
     def step(carry, step_idx):
         U, V = carry
@@ -180,6 +203,8 @@ def dsgd_train(
             su_f[s], si_f[s], sv_f[s], sw_f[s],
             omega_u, omega_v,
             updater, t, minibatch, collision,
+            None if icu_f is None else icu_f[s],
+            None if icv_f is None else icv_f[s],
         )
         return (U, V), None
 

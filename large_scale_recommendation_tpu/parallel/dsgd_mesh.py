@@ -35,7 +35,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
 from large_scale_recommendation_tpu.core.types import Ratings
@@ -77,6 +77,7 @@ def build_mesh_dsgd_step(
     num_blocks: int,
     iterations: int,
     collision: str = "mean",
+    with_inv: bool = False,
 ):
     """Build the jitted multi-chip training function.
 
@@ -90,18 +91,23 @@ def build_mesh_dsgd_step(
     k = num_blocks
     perm = ring_backward(k)
     spec = P(BLOCK_AXIS)
+    n_sharded = 10 if with_inv else 8
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(spec,) * 8 + (P(),),
+        in_specs=(spec,) * n_sharded + (P(),),
         out_specs=(spec, spec),
     )
-    def run(U_l, V_l, ru_l, ri_l, rv_l, rw_l, ou_l, ov_l, t0):
+    def run(U_l, V_l, ru_l, ri_l, rv_l, rw_l, ou_l, ov_l, *rest):
         # shard_map gives [1, k, b] for the device-major strata; drop the
         # leading sharded dim.
         ru, ri = ru_l[0], ri_l[0]
         rv, rw = rv_l[0], rw_l[0]
+        if with_inv:
+            icu, icv, t0 = rest[0][0], rest[1][0], rest[2]
+        else:
+            icu, icv, t0 = None, None, rest[0]
 
         def step(carry, idx):
             U, V, ov = carry
@@ -113,6 +119,8 @@ def build_mesh_dsgd_step(
             U, V = sgd_ops.sgd_block_sweep(
                 U, V, ru[s], ri[s], rv[s], rw[s], ou_l, ov,
                 updater, t, minibatch, collision,
+                None if icu is None else icu[s],
+                None if icv is None else icv[s],
             )
             # Rotate the item shard (and its omegas) one step down the ring
             # — ≙ the reference's inter-superstep shuffle of item blocks
@@ -144,6 +152,7 @@ class MeshDSGDConfig:
     minibatch_size: int = 1024
     init_scale: float = 1.0
     collision_mode: str = "mean"  # see ops.sgd.sgd_minibatch_update
+    precompute_collisions: bool = True  # see DSGDConfig
 
 
 class MeshDSGD:
@@ -230,23 +239,41 @@ class MeshDSGD:
         args = tuple(put(x) for x in (ru, ri, rv, rw))
         ou = put(problem.users.omega)
         ov = put(problem.items.omega)
+        with_inv = (cfg.precompute_collisions
+                    and cfg.collision_mode == "mean")
+        inv_args = ()
+        if with_inv:
+            icu, icv = blocking.minibatch_inv_counts(
+                problem.ratings, cfg.minibatch_size)
+            # same device-major [p, s, b] re-layout as the strata
+            inv_args = (put(icu.transpose(1, 0, 2)),
+                        put(icv.transpose(1, 0, 2)))
 
         segment = checkpoint_every or cfg.iterations
         while done < cfg.iterations:
             seg = min(segment, cfg.iterations - done)
             step_fn = build_mesh_dsgd_step(
                 self.mesh, self.updater, cfg.minibatch_size, k, seg,
-                cfg.collision_mode,
+                cfg.collision_mode, with_inv,
             )
-            U, V = step_fn(U, V, *args, ou, ov,
+            U, V = step_fn(U, V, *args, ou, ov, *inv_args,
                            jnp.asarray(done, jnp.int32))
             done += seg
             if checkpoint_manager is not None:
-                checkpoint_manager.save(
-                    done, {"U": np.asarray(U), "V": np.asarray(V)},
-                    {"kind": "mesh_dsgd_segment",
-                     "iterations": cfg.iterations},
-                )
+                # On a multi-process mesh the shards of U/V are not all
+                # addressable — gather to a fully-replicated layout first
+                # (np.asarray on a replicated global array is legal on every
+                # process), and let only process 0 write so hosts don't race
+                # on the checkpoint path.
+                rep = NamedSharding(self.mesh, P())
+                Uh, Vh = jax.jit(lambda u, v: (u, v),
+                                 out_shardings=(rep, rep))(U, V)
+                if jax.process_index() == 0:
+                    checkpoint_manager.save(
+                        done, {"U": np.asarray(Uh), "V": np.asarray(Vh)},
+                        {"kind": "mesh_dsgd_segment",
+                         "iterations": cfg.iterations},
+                    )
         self.model = MFModel(U=U, V=V, users=problem.users,
                              items=problem.items)
         return self.model

@@ -136,3 +136,18 @@ class TestAdaptiveMF:
         for b in stream(gen, 10, 400):
             m.process(b)
         assert m._history_rows <= 1400  # limit + one batch slack
+
+
+def test_flush_outside_batch_returns_empty_updates():
+    """flush() while no retrain is running must return an empty
+    BatchUpdates with (0, rank)-shaped arrays, not crash (review r3)."""
+    from large_scale_recommendation_tpu.models.adaptive import (
+        AdaptiveMF,
+        AdaptiveMFConfig,
+    )
+
+    m = AdaptiveMF(AdaptiveMFConfig(num_factors=4, offline_every=None))
+    out = m.flush()
+    assert out.user_updates == [] and out.item_updates == []
+    ids, vecs = out.user_arrays
+    assert vecs.shape == (0, 4)

@@ -175,3 +175,68 @@ class TestModelExport:
         ru, _, _, _ = gen.generate(0).to_numpy()  # not used; check vs index
         assert ids == sorted(i for i in model.users.ids if i >= 0)
         assert all(fv.factors.shape == (4,) for fv in fvs)
+
+
+class TestPrecomputedCollisions:
+    """Precomputed minibatch collision scales (data.blocking.
+    minibatch_inv_counts) must be the SAME math as the runtime counters —
+    they only move the counting from the kernel hot path to blocking time."""
+
+    def test_precompute_matches_runtime(self):
+        gen = SyntheticMFGenerator(num_users=50, num_items=40, rank=4,
+                                   noise=0.1, seed=0)
+        # small tables + mb > rows_per_block → plenty of collisions
+        train = gen.generate(8000)
+        base = dict(num_factors=4, lambda_=0.05, iterations=4,
+                    learning_rate=0.1, lr_schedule="constant", seed=0,
+                    minibatch_size=128, init_scale=0.3)
+        on = DSGD(DSGDConfig(precompute_collisions=True, **base)).fit(
+            train, num_blocks=2)
+        off = DSGD(DSGDConfig(precompute_collisions=False, **base)).fit(
+            train, num_blocks=2)
+        np.testing.assert_allclose(np.asarray(on.U), np.asarray(off.U),
+                                   rtol=2e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(on.V), np.asarray(off.V),
+                                   rtol=2e-5, atol=1e-6)
+
+    def test_mesh_precompute_matches_runtime(self):
+        from large_scale_recommendation_tpu.parallel.dsgd_mesh import (
+            MeshDSGD,
+            MeshDSGDConfig,
+        )
+
+        gen = SyntheticMFGenerator(num_users=64, num_items=48, rank=4,
+                                   noise=0.1, seed=1)
+        train = gen.generate(6000)
+        base = dict(num_factors=4, lambda_=0.05, iterations=3,
+                    learning_rate=0.1, lr_schedule="constant", seed=0,
+                    minibatch_size=64, init_scale=0.3)
+        on = MeshDSGD(MeshDSGDConfig(precompute_collisions=True,
+                                     **base)).fit(train)
+        off = MeshDSGD(MeshDSGDConfig(precompute_collisions=False,
+                                      **base)).fit(train)
+        np.testing.assert_allclose(np.asarray(on.U), np.asarray(off.U),
+                                   rtol=2e-5, atol=1e-6)
+
+    def test_inv_counts_values(self):
+        from large_scale_recommendation_tpu.data import blocking as blk
+
+        gen = SyntheticMFGenerator(num_users=10, num_items=8, rank=2, seed=2)
+        train = gen.generate(500)
+        prob = blk.block_problem(train, num_blocks=1, seed=0,
+                                 minibatch_multiple=64)
+        icu, icv = blk.minibatch_inv_counts(prob.ratings, 64)
+        flat_rows = prob.ratings.u_rows.reshape(-1)
+        flat_w = prob.ratings.weights.reshape(-1)
+        flat_icu = icu.reshape(-1)
+        # brute-force check every chunk
+        for a in range(0, len(flat_rows), 64):
+            rows = flat_rows[a:a + 64]
+            w = flat_w[a:a + 64]
+            for j in range(64):
+                if w[j] == 0:
+                    assert flat_icu[a + j] == 1.0
+                else:
+                    c = int(((rows == rows[j]) & (w > 0)).sum())
+                    np.testing.assert_allclose(flat_icu[a + j], 1.0 / c,
+                                               rtol=1e-6)
