@@ -134,6 +134,41 @@ int64_t fb_compact_ids(const int64_t* ids, int64_t n, int64_t* idx_out,
   return m;
 }
 
+// Stable counting sort of a pre-permuted index sequence by small integer
+// key: out[j] enumerates perm positions grouped by key (keys[perm[j]]),
+// preserving perm's relative order within each key. The blocking hot path
+// needs exactly "seeded shuffle, then stable sort by block id"
+// (data/blocking.py); numpy's stable argsort is O(n log n) comparison
+// sort — this is two O(n) passes.
+void fb_stable_bucket(const int64_t* keys, const int64_t* perm, int64_t n,
+                      int64_t num_keys, int64_t* out) {
+  std::vector<int64_t> pos(num_keys + 1, 0);
+  for (int64_t j = 0; j < n; ++j) ++pos[keys[perm[j]] + 1];
+  for (int64_t k2 = 0; k2 < num_keys; ++k2) pos[k2 + 1] += pos[k2];
+  for (int64_t j = 0; j < n; ++j) {
+    int64_t p = perm[j];
+    out[pos[keys[p]]++] = p;
+  }
+}
+
+// Per-entry 1/(occurrences of rows[j] within its minibatch chunk), the
+// "mean" collision scale (ops.sgd). weights==0 entries get 1.0 and do not
+// count. One pass with a dense per-chunk counter keyed by row — numpy
+// needs a 25M-element np.unique (sort) per side for the same result.
+void fb_minibatch_inv_counts(const int32_t* rows, const float* weights,
+                             int64_t n, int64_t minibatch, float* out) {
+  std::unordered_map<int32_t, int32_t> cnt;
+  cnt.reserve((size_t)minibatch * 2);
+  for (int64_t a = 0; a < n; a += minibatch) {
+    int64_t b = a + minibatch < n ? a + minibatch : n;
+    cnt.clear();
+    for (int64_t j = a; j < b; ++j)
+      if (weights[j] > 0.0f) ++cnt[rows[j]];
+    for (int64_t j = a; j < b; ++j)
+      out[j] = weights[j] > 0.0f ? 1.0f / (float)cnt[rows[j]] : 1.0f;
+  }
+}
+
 void fb_free(void* p) { std::free(p); }
 
 }  // extern "C"

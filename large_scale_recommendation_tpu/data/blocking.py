@@ -249,10 +249,14 @@ def block_ratings(
     # per-visit shuffle (DSGDforMF.scala:392-393), made deterministic. Beyond
     # SGD folklore this matters mechanically: a user-sorted block puts all of
     # one row's ratings into the same minibatch, maximizing intra-minibatch
-    # row collisions (SURVEY §7 hard part (b)).
+    # row collisions (SURVEY §7 hard part (b)). Native counting sort when
+    # built (the key space is k² block ids; numpy's comparison sort is the
+    # 25M-row host pass's biggest term).
+    from large_scale_recommendation_tpu.data.native import stable_bucket
+
     rng = np.random.default_rng(0 if seed is None else seed + 7919)
     perm = rng.permutation(len(urow))
-    order = perm[np.argsort(strat[perm] * k + ublk[perm], kind="stable")]
+    order = stable_bucket(strat * k + ublk, perm, k * k)
     urow, irow = urow[order], irow[order]
     vals = np.asarray(rv, dtype=np.float32)[order]
     strat_s, ublk_s = strat[order], ublk[order]
@@ -303,16 +307,15 @@ def minibatch_inv_counts(
     weight-0 deltas are zero regardless).
     """
 
+    from large_scale_recommendation_tpu.data.native import (
+        minibatch_inv_counts_flat,
+    )
+
+    w = blocked.weights.reshape(-1)
+
     def side(rows: np.ndarray) -> np.ndarray:
-        flat = rows.reshape(-1).astype(np.int64)
-        chunk = np.arange(flat.size, dtype=np.int64) // minibatch
-        w = blocked.weights.reshape(-1) > 0
-        key = chunk * (int(flat.max()) + 2) + flat
-        key = np.where(w, key, -1)  # all padding shares one ignored key
-        _, inverse, counts = np.unique(key, return_inverse=True,
-                                       return_counts=True)
-        inv = (1.0 / counts[inverse]).astype(np.float32)
-        return np.where(w, inv, 1.0).reshape(rows.shape).astype(np.float32)
+        inv = minibatch_inv_counts_flat(rows.reshape(-1), w, minibatch)
+        return inv.reshape(rows.shape)
 
     return side(blocked.u_rows), side(blocked.i_rows)
 

@@ -90,6 +90,15 @@ def _load() -> ctypes.CDLL | None:
             LP64, ctypes.c_int64, LP64,
             ctypes.POINTER(LP64), ctypes.POINTER(LP64),
         ]
+        lib.fb_stable_bucket.restype = None
+        lib.fb_stable_bucket.argtypes = [
+            LP64, LP64, ctypes.c_int64, ctypes.c_int64, LP64,
+        ]
+        lib.fb_minibatch_inv_counts.restype = None
+        lib.fb_minibatch_inv_counts.argtypes = [
+            ctypes.POINTER(ctypes.c_int32), LPF, ctypes.c_int64,
+            ctypes.c_int64, LPF,
+        ]
         lib.fb_free.restype = None
         lib.fb_free.argtypes = [ctypes.c_void_p]
         _lib = lib
@@ -175,3 +184,60 @@ def compact_ids(
     uniq, idx, counts = np.unique(ids, return_inverse=True,
                                   return_counts=True)
     return uniq, idx, counts
+
+
+def stable_bucket(keys: np.ndarray, perm: np.ndarray,
+                  num_keys: int) -> np.ndarray:
+    """Order indices: ``perm`` stably grouped by ``keys[perm]``.
+
+    Equivalent to ``perm[np.argsort(keys[perm], kind="stable")]`` — the
+    blocking hot path's "seeded shuffle then stable sort by block id"
+    (data/blocking.py). Native two-pass counting sort when available
+    (keys are block ids, so num_keys is tiny)."""
+    keys = np.ascontiguousarray(keys, dtype=np.int64)
+    perm = np.ascontiguousarray(perm, dtype=np.int64)
+    if len(keys) and (keys.min() < 0 or keys.max() >= num_keys):
+        # the native kernel indexes a counter array by key — fail cleanly
+        # instead of corrupting the heap on native builds
+        raise ValueError(
+            f"stable_bucket keys outside [0, {num_keys}): "
+            f"min={keys.min()} max={keys.max()}"
+        )
+    lib = _load()
+    if lib is not None:
+        out = np.empty(len(perm), dtype=np.int64)
+        LP64 = ctypes.POINTER(ctypes.c_int64)
+        lib.fb_stable_bucket(
+            keys.ctypes.data_as(LP64), perm.ctypes.data_as(LP64),
+            len(perm), int(num_keys), out.ctypes.data_as(LP64),
+        )
+        return out
+    return perm[np.argsort(keys[perm], kind="stable")]
+
+
+def minibatch_inv_counts_flat(rows: np.ndarray, weights: np.ndarray,
+                              minibatch: int) -> np.ndarray:
+    """Per-entry 1/(occurrences of rows[j] in its minibatch chunk); weight-0
+    entries get 1.0 and don't count. One native pass when available; the
+    NumPy fallback pays an O(n log n) np.unique."""
+    rows = np.ascontiguousarray(rows, dtype=np.int32)
+    weights = np.ascontiguousarray(weights, dtype=np.float32)
+    lib = _load()
+    if lib is not None:
+        out = np.empty(len(rows), dtype=np.float32)
+        LPF = ctypes.POINTER(ctypes.c_float)
+        lib.fb_minibatch_inv_counts(
+            rows.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            weights.ctypes.data_as(LPF), len(rows), int(minibatch),
+            out.ctypes.data_as(LPF),
+        )
+        return out
+    flat = rows.astype(np.int64)
+    chunk = np.arange(flat.size, dtype=np.int64) // minibatch
+    w = weights > 0
+    key = chunk * (int(flat.max(initial=0)) + 2) + flat
+    key = np.where(w, key, -1)
+    _, inverse, counts = np.unique(key, return_inverse=True,
+                                   return_counts=True)
+    inv = (1.0 / counts[inverse]).astype(np.float32)
+    return np.where(w, inv, 1.0).astype(np.float32)

@@ -42,7 +42,6 @@ class ALSConfig:
     reg_mode: str = "direct"  # "direct" (MLlib ALS.train) | "als_wr" (ω-scaled)
     seed: int | None = 0
     min_pad: int = 8  # smallest per-row bucket width (ops.als plans)
-    chunk_size: int = 4096  # mesh-path gram chunk (parallel.als_mesh only)
     init_scale: float = 0.1
 
 

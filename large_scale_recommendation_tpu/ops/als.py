@@ -137,20 +137,26 @@ def _solve_bucket(
     return out
 
 
+def _chunk_geometry(nb: int, pad: int, k: int,
+                    target_bytes: int) -> tuple[int, int, int]:
+    """Row-chunk size for one bucket: pow2 ``rc`` (bounded compile
+    variants) such that both the [rc, pad, k] gather AND the [rc, k, k]
+    gram tensor stay ≤ target_bytes. Returns (rc, n_chunks, padded_nb)."""
+    rc = max(1, min(target_bytes // (pad * k * 4),
+                    target_bytes // (k * k * 4)))
+    rc = 1 << (rc.bit_length() - 1)  # floor pow2
+    rc = min(rc, 1 << (max(nb - 1, 1)).bit_length())  # don't exceed ~nb
+    n_chunks = -(-nb // rc)
+    return rc, n_chunks, n_chunks * rc
+
+
 def _chunked_bucket(bucket, omega, num_rows, k, target_bytes=256 << 20):
     """Host-side: reshape one bucket into [n_chunks, rc, pad] with pow2 rc
     (bounded compile variants); chunk-padding rows point at the dummy row
     ``num_rows`` with weight 0."""
     rows, oidx, vals, w = bucket
     nb, pad = oidx.shape
-    # chunk bound: both the [rc, pad, k] gather AND the [rc, k, k] gram
-    # tensor must stay ≤ target_bytes
-    rc = max(1, min(target_bytes // (pad * k * 4),
-                    target_bytes // (k * k * 4)))
-    rc = 1 << (rc.bit_length() - 1)  # floor pow2
-    rc = min(rc, 1 << (max(nb - 1, 1)).bit_length())  # don't exceed ~nb
-    n_chunks = -(-nb // rc)
-    padded_nb = n_chunks * rc
+    rc, n_chunks, padded_nb = _chunk_geometry(nb, pad, k, target_bytes)
     if padded_nb != nb:
         extra = padded_nb - nb
         rows = np.concatenate([rows,
@@ -193,6 +199,106 @@ def solve_side(
     return out[:num_rows]
 
 
+def build_sharded_plans(
+    out_rows_local: np.ndarray,  # int64[e] LOCAL row of the solved side
+    shard_of_entry: np.ndarray,  # int64[e] owning device of each rating
+    other_rows: np.ndarray,  # int64[e] GLOBAL rows into the gathered table
+    values: np.ndarray,
+    num_shards: int,
+    rows_per_shard: int,
+    k: int,
+    min_pad: int = 8,
+    target_bytes: int = 64 << 20,
+):
+    """Device-major bucketed solve plans for a SHARDED table.
+
+    Like ``build_solve_plan`` + ``prepare_side``, but produces arrays with a
+    leading ``num_shards`` dim (uniform shapes across devices — shard_map
+    needs one static shape) so a mesh ALS half-step runs the same bucketed
+    matmuls per shard. Bucket pad classes are unified across shards, and
+    every per-shard bucket is padded to the max shard's row count with
+    dummies targeting the local dummy row ``rows_per_shard``.
+
+    Returns a list of per-pad-class tuples
+    ``(rows3 [S, C, rc], oidx3 [S, C, rc, pad], vals3, w3)`` ready to be
+    0-dim-sharded over the mesh.
+    """
+    plans = []
+    for s in range(num_shards):
+        m = shard_of_entry == s
+        plans.append(build_solve_plan(out_rows_local[m], other_rows[m],
+                                      values[m], rows_per_shard,
+                                      min_pad=min_pad))
+    pad_classes = sorted({b[1].shape[1] for p in plans for b in p.buckets})
+    out = []
+    for pad in pad_classes:
+        per_shard = []
+        for p in plans:
+            hit = [b for b in p.buckets if b[1].shape[1] == pad]
+            per_shard.append(hit[0] if hit else None)
+        nb_max = max((b[0].shape[0] if b is not None else 0)
+                     for b in per_shard)
+        if nb_max == 0:
+            continue
+        rc, n_chunks, padded_nb = _chunk_geometry(nb_max, pad, k,
+                                                  target_bytes)
+        S = num_shards
+        rows3 = np.full((S, padded_nb), rows_per_shard, np.int32)
+        oidx3 = np.zeros((S, padded_nb, pad), np.int32)
+        vals3 = np.zeros((S, padded_nb, pad), np.float32)
+        w3 = np.zeros((S, padded_nb, pad), np.float32)
+        for s, b in enumerate(per_shard):
+            if b is None:
+                continue
+            rows, oidx, vals, w = b
+            nb = rows.shape[0]
+            rows3[s, :nb] = rows
+            oidx3[s, :nb] = oidx
+            vals3[s, :nb] = vals
+            w3[s, :nb] = w
+        out.append((
+            rows3.reshape(S, n_chunks, rc),
+            oidx3.reshape(S, n_chunks, rc, pad),
+            vals3.reshape(S, n_chunks, rc, pad),
+            w3.reshape(S, n_chunks, rc, pad),
+        ))
+    return out
+
+
+def solve_side_local(
+    factors_full: jax.Array,  # [n_other_total, k] — the all_gathered side
+    chunked_buckets,  # per-pad-class (rows3[C,rc], oidx3, vals3, w3) LOCAL
+    rows_per_shard: int,
+    lambda_: jax.Array,
+    omega_local: jax.Array | None,
+    varying_zeros_fn,
+) -> jax.Array:
+    """One shard's half-step inside shard_map: bucketed gram + solve + set
+    on the local [rows_per_shard(+1), k] table. ``varying_zeros_fn(shape)``
+    supplies VMA-marked zero accumulators (parallel/als_mesh.py)."""
+    k = factors_full.shape[-1]
+    out = varying_zeros_fn((rows_per_shard + 1, k))
+
+    for (rows3, oidx3, vals3, w3) in chunked_buckets:
+        def body(out, x):
+            rows_c, oi, va, wi = x
+            g = factors_full[oi]
+            gw = g * wi[..., None]
+            A = jnp.einsum("rpk,rpl->rkl", gw, g,
+                           preferred_element_type=jnp.float32)
+            b = jnp.einsum("rpk,rp->rk", gw, va)
+            if omega_local is None:
+                sc = None
+            else:
+                sc = jnp.concatenate(
+                    [omega_local, jnp.ones(1, jnp.float32)])[rows_c]
+            x_c = solve_normal_eq(A, b, lambda_, sc)
+            return out.at[rows_c].set(x_c, unique_indices=True), None
+
+        out, _ = jax.lax.scan(body, out, (rows3, oidx3, vals3, w3))
+    return out[:rows_per_shard]
+
+
 def als_train_planned(
     U: jax.Array,
     V: jax.Array,
@@ -232,6 +338,12 @@ def gram_stats(
     """Accumulate per-row gram matrices and right-hand sides.
 
     Returns ``A: [num_out_rows, k, k]``, ``b: [num_out_rows, k]``.
+
+    This is the straightforward scatter-add formulation, kept as the
+    REFERENCE implementation the unit tests oracle against — both
+    production paths (single-chip ``als_train_planned``, mesh
+    ``solve_side_local``) use the bucketed-matmul plans instead (scatter
+    with duplicate indices is latency-bound on TPU).
     """
     k = factors.shape[-1]
     e = out_rows.shape[0]
@@ -286,6 +398,7 @@ def solve_normal_eq(
 
 # NOTE: the single-jit scatter-add ``als_train`` that round 2 shipped is
 # gone — the bucketed ``als_train_planned`` above replaces it (the scatter
-# formulation measured ~0.004% MFU, VERDICT r2 weak #2). ``gram_stats``
-# stays: the mesh ALS path (parallel/als_mesh.py) still assembles per-shard
-# grams with it.
+# formulation measured ~0.004% MFU, VERDICT r2 weak #2), and the mesh path
+# now runs the same bucketed kernels per shard (``build_sharded_plans`` +
+# ``solve_side_local``). ``gram_stats`` stays as the straightforward
+# scatter-add reference implementation the unit tests oracle against.

@@ -42,91 +42,58 @@ from large_scale_recommendation_tpu.parallel.mesh import (
 )
 
 
-def partition_by_block(
-    rows: np.ndarray,
-    other_rows: np.ndarray,
-    values: np.ndarray,
-    num_blocks: int,
-    rows_per_block: int,
-    chunk_multiple: int,
-) -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
-    """Group ratings by the block of ``rows``; pad every block to the same
-    chunk-aligned size. Solved-side rows are localized (mod rows_per_block);
-    the fixed side keeps GLOBAL rows (it indexes the all_gathered table).
-
-    Returns [k, bmax] arrays: local_rows, other_global_rows, values, weights.
-    """
-    blk = rows // rows_per_block
-    order = np.argsort(blk, kind="stable")
-    rows_s, other_s = rows[order], other_rows[order]
-    vals_s, blk_s = values[order], blk[order]
-    sizes = np.bincount(blk_s, minlength=num_blocks)
-    bmax = max(int(sizes.max()) if sizes.size else 0, 1)
-    bmax = -(-bmax // chunk_multiple) * chunk_multiple
-
-    k = num_blocks
-    out_rows = np.zeros((k, bmax), np.int32)
-    out_other = np.zeros((k, bmax), np.int32)
-    out_vals = np.zeros((k, bmax), np.float32)
-    out_w = np.zeros((k, bmax), np.float32)
-    starts = np.concatenate([[0], np.cumsum(sizes)])
-    for p in range(k):
-        a, b = starts[p], starts[p + 1]
-        m = b - a
-        out_rows[p, :m] = rows_s[a:b] % rows_per_block
-        out_other[p, :m] = other_s[a:b]
-        out_vals[p, :m] = vals_s[a:b]
-        out_w[p, :m] = 1.0
-    return out_rows, out_other, out_vals, out_w
-
-
 @lru_cache(maxsize=32)
 def build_mesh_als_step(
     mesh: Mesh,
     lambda_: float,
     reg_mode: str,
-    chunk: int,
     iterations: int,
+    n_user_buckets: int,
+    n_item_buckets: int,
 ):
-    """Jitted distributed ALS round loop.
+    """Jitted distributed ALS round loop over bucketed solve plans.
 
-    All 0-dim-sharded inputs: U, V, omegas, and the two rating layouts
-    ([k, bmax] each side). Output sharding equals input sharding.
+    Inputs (all 0-dim-sharded): U, V, omegas, then ``n_user_buckets`` ×
+    4 arrays of the user-side plan followed by ``n_item_buckets`` × 4 of the
+    item side (``ops.als.build_sharded_plans`` layouts). Per round: two
+    ``all_gather`` collectives + per-shard bucketed gram/solve — the same
+    no-scatter matmul formulation as the single-chip path.
     """
     spec = P(BLOCK_AXIS)
+    n_arrays = 4 + 4 * (n_user_buckets + n_item_buckets)
 
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(spec,) * 12,
+        in_specs=(spec,) * n_arrays,
         out_specs=(spec, spec),
-        # the gram accumulators start as fresh (replicated) zeros and become
-        # device-varying through the scatter-add — skip the static VMA check
-        # rather than threading pvary through the shared gram_stats kernel
-        check_vma=False,
     )
-    def run(U_l, V_l, ou_l, ov_l,
-            # user-partitioned layout: local user rows, global item rows
-            u_loc, u_oth, u_val, u_w,
-            # item-partitioned layout: local item rows, global user rows
-            i_loc, i_oth, i_val, i_w):
-        # drop the leading sharded dim of the per-device rating blocks
-        u_loc, u_oth, u_val, u_w = u_loc[0], u_oth[0], u_val[0], u_w[0]
-        i_loc, i_oth, i_val, i_w = i_loc[0], i_oth[0], i_val[0], i_w[0]
+    def run(U_l, V_l, ou_l, ov_l, *bucket_arrays):
+        # drop the leading sharded dim of the per-device plan arrays
+        flat = [a[0] for a in bucket_arrays]
+        ub = [tuple(flat[4 * j: 4 * j + 4]) for j in range(n_user_buckets)]
+        ib = [tuple(flat[4 * (n_user_buckets + j):
+                         4 * (n_user_buckets + j) + 4])
+              for j in range(n_item_buckets)]
         nu_l, ni_l = U_l.shape[0], V_l.shape[0]
         scale_u = ou_l if reg_mode == "als_wr" else None
         scale_v = ov_l if reg_mode == "als_wr" else None
+        lam = jnp.float32(lambda_)
+
+        def varying_zeros(shape):
+            # fresh accumulators marked device-varying so the VMA check can
+            # verify the per-shard writes into them
+            return jax.lax.pcast(jnp.zeros(shape, jnp.float32),
+                                 BLOCK_AXIS, to="varying")
 
         def round_(carry, _):
             U_l, V_l = carry
             V_full = jax.lax.all_gather(V_l, BLOCK_AXIS, tiled=True)
-            A, b = als_ops.gram_stats(V_full, u_loc, u_oth, u_val, u_w,
-                                      nu_l, chunk)
-            U_l = als_ops.solve_normal_eq(A, b, lambda_, scale_u)
+            U_l = als_ops.solve_side_local(V_full, ub, nu_l, lam, scale_u,
+                                           varying_zeros)
             U_full = jax.lax.all_gather(U_l, BLOCK_AXIS, tiled=True)
-            A, b = als_ops.gram_stats(U_full, i_loc, i_oth, i_val, i_w,
-                                      ni_l, chunk)
-            V_l = als_ops.solve_normal_eq(A, b, lambda_, scale_v)
+            V_l = als_ops.solve_side_local(U_full, ib, ni_l, lam, scale_v,
+                                           varying_zeros)
             return (U_l, V_l), None
 
         (U_l, V_l), _ = jax.lax.scan(round_, (U_l, V_l), None,
@@ -167,10 +134,19 @@ class MeshALS:
         i_rows, _ = items.rows_for(ri)
         rv = np.asarray(rv, np.float32)
 
-        by_user = partition_by_block(u_rows, i_rows, rv, k,
-                                     users.rows_per_block, cfg.chunk_size)
-        by_item = partition_by_block(i_rows, u_rows, rv, k,
-                                     items.rows_per_block, cfg.chunk_size)
+        # device-major bucketed plans, one per orientation: solved-side rows
+        # localized to their shard, fixed side global (indexes the
+        # all_gathered table)
+        user_plan = als_ops.build_sharded_plans(
+            u_rows % users.rows_per_block, u_rows // users.rows_per_block,
+            i_rows, rv, k, users.rows_per_block, cfg.num_factors,
+            min_pad=cfg.min_pad,
+        )
+        item_plan = als_ops.build_sharded_plans(
+            i_rows % items.rows_per_block, i_rows // items.rows_per_block,
+            u_rows, rv, k, items.rows_per_block, cfg.num_factors,
+            min_pad=cfg.min_pad,
+        )
 
         from large_scale_recommendation_tpu.models.als import ALS
 
@@ -179,12 +155,13 @@ class MeshALS:
         shard = block_sharding(self.mesh)
         put = lambda x: jax.device_put(jnp.asarray(x), shard)
         step_fn = build_mesh_als_step(
-            self.mesh, cfg.lambda_, cfg.reg_mode, cfg.chunk_size,
-            cfg.iterations,
+            self.mesh, cfg.lambda_, cfg.reg_mode, cfg.iterations,
+            len(user_plan), len(item_plan),
         )
         U, V = step_fn(
             put(U), put(V), put(users.omega), put(items.omega),
-            *(put(a) for a in by_user), *(put(a) for a in by_item),
+            *(put(a) for b in user_plan for a in b),
+            *(put(a) for b in item_plan for a in b),
         )
         self.model = MFModel(U=U, V=V, users=users, items=items)
         return self.model

@@ -88,8 +88,7 @@ class TestALS:
         vals = rng.normal(size=e).astype(np.float32)
         lam = 0.1
 
-        cfg = ALSConfig(num_factors=k, lambda_=lam, iterations=1,
-                        chunk_size=4, seed=0)
+        cfg = ALSConfig(num_factors=k, lambda_=lam, iterations=1, seed=0)
         solver = ALS(cfg)
         model = solver.fit(Ratings.from_arrays(users, items, vals))
 
@@ -114,15 +113,15 @@ class TestALS:
                                    noise=0.05, seed=3)
         train = gen.generate(12000)
         test = gen.generate(3000)
-        model = ALS(ALSConfig(num_factors=8, lambda_=0.05, iterations=8,
-                              chunk_size=1024)).fit(train)
+        model = ALS(ALSConfig(num_factors=8, lambda_=0.05,
+                              iterations=8)).fit(train)
         assert model.rmse(test) < 0.12
 
     def test_als_wr_mode_runs_and_converges(self):
         gen = SyntheticMFGenerator(num_users=60, num_items=50, rank=4,
                                    noise=0.1, seed=4)
         model = ALS(ALSConfig(num_factors=6, lambda_=0.02, iterations=6,
-                              reg_mode="als_wr", chunk_size=512)).fit(
+                              reg_mode="als_wr")).fit(
             gen.generate(6000))
         assert model.rmse(gen.generate(1000)) < 0.3
 
@@ -156,8 +155,7 @@ class TestMeshALS:
                                    noise=0.1, seed=6)
         train = gen.generate(4000)
         test = gen.generate(1000)
-        cfg = ALSConfig(num_factors=6, lambda_=0.05, iterations=4,
-                        chunk_size=128, seed=0)
+        cfg = ALSConfig(num_factors=6, lambda_=0.05, iterations=4, seed=0)
 
         mesh_model = MeshALS(cfg, mesh=make_block_mesh(n_dev)).fit(train)
         single_model = ALS(cfg).fit(train)
@@ -175,8 +173,7 @@ class TestMeshALS:
         gen = SyntheticMFGenerator(num_users=96, num_items=64, rank=4,
                                    noise=0.05, seed=7)
         model = MeshALS(
-            ALSConfig(num_factors=8, lambda_=0.05, iterations=6,
-                      chunk_size=256),
+            ALSConfig(num_factors=8, lambda_=0.05, iterations=6),
             mesh=make_block_mesh(4),
         ).fit(gen.generate(8000))
         assert model.rmse(gen.generate(2000)) < 0.12
