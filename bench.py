@@ -154,6 +154,18 @@ def run_child() -> None:
     jax.block_until_ready((Uw, Vw))
     extra["compile_wall_s"] = round(time.perf_counter() - t0, 1)
 
+    # optional profiler capture of ONE sweep (BENCH_PROFILE=dir):
+    # tensorboard-format XLA timeline via utils.metrics.profile
+    profile_dir = os.environ.get("BENCH_PROFILE")
+    if profile_dir:
+        from large_scale_recommendation_tpu.utils.metrics import profile
+
+        with profile(profile_dir):
+            Uw, Vw = sgd_ops.dsgd_train(U, V, *args, **kw, t0=0)
+            jax.block_until_ready((Uw, Vw))
+        extra["profile_trace_dir"] = profile_dir
+    del Uw, Vw
+
     # ---- timed training: sweep-by-sweep until the RMSE target ------------
     train_wall = 0.0
     time_to_target = None

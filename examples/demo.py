@@ -69,6 +69,31 @@ def run_combined():
     return model
 
 
+def run_ps_combo():
+    print("== PS-hosted online + batch combo (≙ offlineOnlinePS) ==")
+    from large_scale_recommendation_tpu.ps import (
+        BATCH_TRIGGER,
+        PSOnlineBatchConfig,
+        PSOnlineBatchMF,
+    )
+
+    events: list = []
+    for j, batch in enumerate(micro_batches()):
+        ru, ri, rv, _ = batch.to_numpy()
+        if j == 2:
+            events.append(BATCH_TRIGGER)  # mid-stream retrain
+        events.extend(zip(ru.tolist(), ri.tolist(), rv.tolist()))
+    solver = PSOnlineBatchMF(PSOnlineBatchConfig(
+        num_factors=RANK, iterations=4, learning_rate=0.1,
+        lr_schedule="constant", worker_parallelism=2, ps_parallelism=2,
+        chunk_size=8, minibatch_size=16,
+    ))
+    users, items = solver.run(events)
+    print(f"PS combo: {len(users)} user vectors, {len(items)} item vectors, "
+          f"batches per worker: {[w.batches_run for w in solver.workers]}")
+    return solver
+
+
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "both"
     if which in ("online", "both"):
@@ -78,7 +103,9 @@ def main():
     if which in ("combined", "both"):
         m = run_combined()
         print(f"combined model: {m.online.users.num_rows} users, "
-              f"{m.online.items.num_rows} items")
+              f"{m.online.items.num_rows} items\n")
+    if which in ("ps", "both"):
+        run_ps_combo()
 
 
 if __name__ == "__main__":

@@ -125,16 +125,24 @@ def _solve_bucket(
 
     def body(out, x):
         rows_c, oi, va, wi, sc = x
-        g = factors[oi]
-        gw = g * wi[..., None]
-        A = jnp.einsum("rpk,rpl->rkl", gw, g,
-                       preferred_element_type=jnp.float32)
-        b = jnp.einsum("rpk,rp->rk", gw, va)
-        x_c = solve_normal_eq(A, b, lambda_, sc)
+        x_c = _gram_solve_chunk(factors, oi, va, wi, sc, lambda_)
         return out.at[rows_c].set(x_c, unique_indices=True), None
 
     out, _ = jax.lax.scan(body, out, (rows3, oidx3, vals3, w3, scale3))
     return out
+
+
+def _gram_solve_chunk(factors, oi, va, wi, sc, lambda_):
+    """The shared per-chunk kernel body: gather the fixed side, batch the
+    per-row grams (two MXU einsums), Cholesky-solve. Used by BOTH the
+    single-chip (_solve_bucket) and mesh (solve_side_local) paths — the
+    mesh==single-device parity tests depend on them staying one body."""
+    g = factors[oi]
+    gw = g * wi[..., None]
+    A = jnp.einsum("rpk,rpl->rkl", gw, g,
+                   preferred_element_type=jnp.float32)
+    b = jnp.einsum("rpk,rp->rk", gw, va)
+    return solve_normal_eq(A, b, lambda_, sc)
 
 
 def _chunk_geometry(nb: int, pad: int, k: int,
@@ -279,20 +287,16 @@ def solve_side_local(
     k = factors_full.shape[-1]
     out = varying_zeros_fn((rows_per_shard + 1, k))
 
+    if omega_local is None:
+        omega_ext = None
+    else:
+        omega_ext = jnp.concatenate([omega_local, jnp.ones(1, jnp.float32)])
+
     for (rows3, oidx3, vals3, w3) in chunked_buckets:
         def body(out, x):
             rows_c, oi, va, wi = x
-            g = factors_full[oi]
-            gw = g * wi[..., None]
-            A = jnp.einsum("rpk,rpl->rkl", gw, g,
-                           preferred_element_type=jnp.float32)
-            b = jnp.einsum("rpk,rp->rk", gw, va)
-            if omega_local is None:
-                sc = None
-            else:
-                sc = jnp.concatenate(
-                    [omega_local, jnp.ones(1, jnp.float32)])[rows_c]
-            x_c = solve_normal_eq(A, b, lambda_, sc)
+            sc = None if omega_ext is None else omega_ext[rows_c]
+            x_c = _gram_solve_chunk(factors_full, oi, va, wi, sc, lambda_)
             return out.at[rows_c].set(x_c, unique_indices=True), None
 
         out, _ = jax.lax.scan(body, out, (rows3, oidx3, vals3, w3))
