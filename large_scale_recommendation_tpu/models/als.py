@@ -43,6 +43,9 @@ class ALSConfig:
     seed: int | None = 0
     min_pad: int = 8  # smallest per-row bucket width (ops.als plans)
     init_scale: float = 0.1
+    # iALS (≙ MLlib ALS.trainImplicit; the BASELINE Criteo-implicit config):
+    # treat ratings as interaction strengths with confidence 1 + α·r
+    implicit_alpha: float | None = None
 
 
 class ALS:
@@ -81,6 +84,7 @@ class ALS:
             lambda_=cfg.lambda_,
             iterations=cfg.iterations,
             reg_mode=cfg.reg_mode,
+            implicit_alpha=cfg.implicit_alpha,
         )
         self.model = MFModel(U=U, V=V, users=users, items=items)
         return self.model
@@ -101,6 +105,14 @@ class ALS:
             V = RandomFactorInitializer(cfg.num_factors, seed=0, salt=1,
                                         scale=cfg.init_scale)(
                 np.arange(items.num_rows))
+        # Padding rows (id −1) start at exactly zero: they solve to zero
+        # anyway (no ratings), and the implicit VᵀV term sums over the WHOLE
+        # table — junk init vectors there would perturb the first half-step
+        # (and differently for single-chip vs mesh, whose padding differs).
+        import jax.numpy as jnp
+
+        U = jnp.asarray(U) * jnp.asarray((users.ids >= 0)[:, None])
+        V = jnp.asarray(V) * jnp.asarray((items.ids >= 0)[:, None])
         return U, V
 
     # -- scoring passthroughs (same surface as DSGD) -----------------------

@@ -28,6 +28,18 @@ Regularization modes:
   weighted-λ scheme per Zhou et al., the same ω-weighting idea the DSGD path
   uses at DSGDforMF.scala:405-413).
 
+Implicit feedback (iALS, Hu/Koren/Volinsky 2008 — the BASELINE.md
+"Criteo-1B implicit interactions" configuration; MLlib exposes it as
+``ALS.trainImplicit``): observations are interaction strengths, confidence
+c = 1 + α·r, preference p = 1, and the per-row system becomes
+
+    (VᵀV + Σ_{i∈obs}(c_i−1)·v_i v_iᵀ + λI) u = Σ_{i∈obs} c_i·v_i.
+
+The dense VᵀV term is ONE [k, k] matmul over the whole fixed table shared
+by every row; the per-row correction reuses the same bucketed plan with
+weights α·r and targets c — so the implicit solver is the explicit solver
+plus one matmul.
+
 Rows with no ratings get A = 0 → (λ I) u = 0 → u = 0: padding rows stay
 exactly zero without masking.
 """
@@ -112,6 +124,7 @@ def _solve_bucket(
     w3: jax.Array,  # float32[n_chunks, rc, pad]
     scale3: jax.Array,  # float32[n_chunks, rc] ridge scale (1 = direct λ)
     lambda_: jax.Array,
+    G: jax.Array | None = None,  # [k, k] shared gram (implicit VᵀV term)
 ) -> jax.Array:
     """Gram + solve + write-back for one bucket, chunk by chunk.
 
@@ -125,23 +138,29 @@ def _solve_bucket(
 
     def body(out, x):
         rows_c, oi, va, wi, sc = x
-        x_c = _gram_solve_chunk(factors, oi, va, wi, sc, lambda_)
+        x_c = _gram_solve_chunk(factors, oi, va, wi, sc, lambda_, G)
         return out.at[rows_c].set(x_c, unique_indices=True), None
 
     out, _ = jax.lax.scan(body, out, (rows3, oidx3, vals3, w3, scale3))
     return out
 
 
-def _gram_solve_chunk(factors, oi, va, wi, sc, lambda_):
+def _gram_solve_chunk(factors, oi, va, wi, sc, lambda_, G=None):
     """The shared per-chunk kernel body: gather the fixed side, batch the
     per-row grams (two MXU einsums), Cholesky-solve. Used by BOTH the
     single-chip (_solve_bucket) and mesh (solve_side_local) paths — the
-    mesh==single-device parity tests depend on them staying one body."""
+    mesh==single-device parity tests depend on them staying one body.
+    ``G`` adds a shared [k, k] term to every row's gram (implicit VᵀV)."""
     g = factors[oi]
     gw = g * wi[..., None]
     A = jnp.einsum("rpk,rpl->rkl", gw, g,
                    preferred_element_type=jnp.float32)
-    b = jnp.einsum("rpk,rp->rk", gw, va)
+    if G is not None:
+        A = A + G
+    # b uses the RAW gathered rows: ``va`` is the per-entry b-weight
+    # (explicit: the already-masked rating, so Σ w·r·v as before;
+    # implicit: the masked confidence c = 1+α·r)
+    b = jnp.einsum("rpk,rp->rk", g, va)
     return solve_normal_eq(A, b, lambda_, sc)
 
 
@@ -183,11 +202,24 @@ def _chunked_bucket(bucket, omega, num_rows, k, target_bytes=256 << 20):
     )
 
 
-def prepare_side(plan: SolvePlan, omega: np.ndarray | None, k: int):
+def prepare_side(plan: SolvePlan, omega: np.ndarray | None, k: int,
+                 implicit_alpha: float | None = None):
     """Device-resident chunked buckets for one orientation — built once per
-    fit, reused every round."""
+    fit, reused every round.
+
+    ``implicit_alpha`` switches the entries to iALS semantics: gram weights
+    become c−1 = α·r and b-targets become c = 1+α·r (masked); the caller
+    adds the shared VᵀV gram via ``solve_side(..., G=...)``."""
+    buckets = plan.buckets
+    if implicit_alpha is not None:
+        a = np.float32(implicit_alpha)
+        buckets = tuple(
+            (rows, oidx, (w * (1.0 + a * vals)).astype(np.float32),
+             (w * a * vals).astype(np.float32))
+            for (rows, oidx, vals, w) in buckets
+        )
     return tuple(
-        _chunked_bucket(b, omega, plan.num_rows, k) for b in plan.buckets
+        _chunked_bucket(b, omega, plan.num_rows, k) for b in buckets
     )
 
 
@@ -196,14 +228,17 @@ def solve_side(
     prepared,
     num_rows: int,
     lambda_: float,
+    G: jax.Array | None = None,
 ) -> jax.Array:
     """One ALS half-step over the prepared buckets. ≙ one orientation of
-    ``ALS.train``'s normal-equation sweep (OnlineSpark.scala:125-131)."""
+    ``ALS.train``'s normal-equation sweep (OnlineSpark.scala:125-131);
+    with ``G`` (the fixed side's VᵀV) this is the iALS half-step
+    (≙ ``ALS.trainImplicit``)."""
     k = factors_other.shape[-1]
     out = jnp.zeros((num_rows + 1, k), jnp.float32)
     lam = jnp.float32(lambda_)
     for chunked in prepared:
-        out = _solve_bucket(factors_other, out, *chunked, lam)
+        out = _solve_bucket(factors_other, out, *chunked, lam, G)
     return out[:num_rows]
 
 
@@ -217,6 +252,7 @@ def build_sharded_plans(
     k: int,
     min_pad: int = 8,
     target_bytes: int = 64 << 20,
+    implicit_alpha: float | None = None,
 ):
     """Device-major bucketed solve plans for a SHARDED table.
 
@@ -234,9 +270,19 @@ def build_sharded_plans(
     plans = []
     for s in range(num_shards):
         m = shard_of_entry == s
-        plans.append(build_solve_plan(out_rows_local[m], other_rows[m],
-                                      values[m], rows_per_shard,
-                                      min_pad=min_pad))
+        p = build_solve_plan(out_rows_local[m], other_rows[m],
+                             values[m], rows_per_shard, min_pad=min_pad)
+        if implicit_alpha is not None:
+            a = np.float32(implicit_alpha)
+            p = SolvePlan(
+                buckets=tuple(
+                    (rows, oidx, (w * (1.0 + a * vals)).astype(np.float32),
+                     (w * a * vals).astype(np.float32))
+                    for (rows, oidx, vals, w) in p.buckets
+                ),
+                num_rows=p.num_rows,
+            )
+        plans.append(p)
     pad_classes = sorted({b[1].shape[1] for p in plans for b in p.buckets})
     out = []
     for pad in pad_classes:
@@ -280,6 +326,7 @@ def solve_side_local(
     lambda_: jax.Array,
     omega_local: jax.Array | None,
     varying_zeros_fn,
+    G: jax.Array | None = None,  # [k, k] shared gram (implicit VᵀV)
 ) -> jax.Array:
     """One shard's half-step inside shard_map: bucketed gram + solve + set
     on the local [rows_per_shard(+1), k] table. ``varying_zeros_fn(shape)``
@@ -296,7 +343,7 @@ def solve_side_local(
         def body(out, x):
             rows_c, oi, va, wi = x
             sc = None if omega_ext is None else omega_ext[rows_c]
-            x_c = _gram_solve_chunk(factors_full, oi, va, wi, sc, lambda_)
+            x_c = _gram_solve_chunk(factors_full, oi, va, wi, sc, lambda_, G)
             return out.at[rows_c].set(x_c, unique_indices=True), None
 
         out, _ = jax.lax.scan(body, out, (rows3, oidx3, vals3, w3))
@@ -314,19 +361,33 @@ def als_train_planned(
     lambda_: float,
     iterations: int,
     reg_mode: str = "direct",
+    implicit_alpha: float | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Full ALS on the bucketed plans: ``iterations`` × (user half-step;
     item half-step). The Python round loop dispatches a few large jitted
     calls per half-step — compile artifacts are shared across rounds because
-    bucket shapes are fixed."""
+    bucket shapes are fixed.
+
+    ``implicit_alpha`` switches to iALS (≙ MLlib ``ALS.trainImplicit``, the
+    BASELINE Criteo-implicit configuration): per half-step the fixed side
+    contributes its whole VᵀV gram (one [k, k] matmul) and the observed
+    entries only the confidence correction."""
     k = U.shape[-1]
     omu = omega_u if reg_mode == "als_wr" else None
     omv = omega_v if reg_mode == "als_wr" else None
-    prep_u = prepare_side(user_plan, omu, k)
-    prep_v = prepare_side(item_plan, omv, k)
+    prep_u = prepare_side(user_plan, omu, k, implicit_alpha)
+    prep_v = prepare_side(item_plan, omv, k, implicit_alpha)
+
+    @jax.jit
+    def full_gram(F):
+        return jnp.einsum("nk,nl->kl", F, F,
+                          preferred_element_type=jnp.float32)
+
     for _ in range(iterations):
-        U = solve_side(V, prep_u, user_plan.num_rows, lambda_)
-        V = solve_side(U, prep_v, item_plan.num_rows, lambda_)
+        Gv = full_gram(V) if implicit_alpha is not None else None
+        U = solve_side(V, prep_u, user_plan.num_rows, lambda_, Gv)
+        Gu = full_gram(U) if implicit_alpha is not None else None
+        V = solve_side(U, prep_v, item_plan.num_rows, lambda_, Gu)
     return U, V
 
 

@@ -50,6 +50,7 @@ def build_mesh_als_step(
     iterations: int,
     n_user_buckets: int,
     n_item_buckets: int,
+    implicit: bool = False,
 ):
     """Jitted distributed ALS round loop over bucketed solve plans.
 
@@ -86,14 +87,22 @@ def build_mesh_als_step(
             return jax.lax.pcast(jnp.zeros(shape, jnp.float32),
                                  BLOCK_AXIS, to="varying")
 
+        def full_gram(F):
+            # the shared iALS VᵀV term — the gathered table is replicated,
+            # so one [k, k] einsum per shard, no extra collective
+            return jnp.einsum("nk,nl->kl", F, F,
+                              preferred_element_type=jnp.float32)
+
         def round_(carry, _):
             U_l, V_l = carry
             V_full = jax.lax.all_gather(V_l, BLOCK_AXIS, tiled=True)
+            Gv = full_gram(V_full) if implicit else None
             U_l = als_ops.solve_side_local(V_full, ub, nu_l, lam, scale_u,
-                                           varying_zeros)
+                                           varying_zeros, Gv)
             U_full = jax.lax.all_gather(U_l, BLOCK_AXIS, tiled=True)
+            Gu = full_gram(U_full) if implicit else None
             V_l = als_ops.solve_side_local(U_full, ib, ni_l, lam, scale_v,
-                                           varying_zeros)
+                                           varying_zeros, Gu)
             return (U_l, V_l), None
 
         (U_l, V_l), _ = jax.lax.scan(round_, (U_l, V_l), None,
@@ -140,12 +149,12 @@ class MeshALS:
         user_plan = als_ops.build_sharded_plans(
             u_rows % users.rows_per_block, u_rows // users.rows_per_block,
             i_rows, rv, k, users.rows_per_block, cfg.num_factors,
-            min_pad=cfg.min_pad,
+            min_pad=cfg.min_pad, implicit_alpha=cfg.implicit_alpha,
         )
         item_plan = als_ops.build_sharded_plans(
             i_rows % items.rows_per_block, i_rows // items.rows_per_block,
             u_rows, rv, k, items.rows_per_block, cfg.num_factors,
-            min_pad=cfg.min_pad,
+            min_pad=cfg.min_pad, implicit_alpha=cfg.implicit_alpha,
         )
 
         from large_scale_recommendation_tpu.models.als import ALS
@@ -157,6 +166,7 @@ class MeshALS:
         step_fn = build_mesh_als_step(
             self.mesh, cfg.lambda_, cfg.reg_mode, cfg.iterations,
             len(user_plan), len(item_plan),
+            implicit=cfg.implicit_alpha is not None,
         )
         U, V = step_fn(
             put(U), put(V), put(users.omega), put(items.omega),
