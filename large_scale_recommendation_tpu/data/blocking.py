@@ -198,6 +198,7 @@ def block_ratings(
     minibatch_multiple: int = 1,
     seed: int | None = 0,
     precomputed_rows: tuple[np.ndarray, np.ndarray] | None = None,
+    minibatch_sort: str | None = None,
 ) -> BlockedRatings:
     """Bucket ratings into the k×k grid in stratum-major layout.
 
@@ -218,7 +219,17 @@ def block_ratings(
     the same minibatch, maximizing intra-minibatch row collisions (SURVEY §7
     hard part (b)) — shuffling spreads them uniformly so the batched kernel's
     collision handling almost never engages.
+
+    ``minibatch_sort`` ("user" | "item" | None) re-orders entries WITHIN
+    each ``minibatch_multiple``-sized chunk by that side's row after the
+    shuffle — a pure memory-locality lever for the device gathers/scatters:
+    minibatch MEMBERSHIP is unchanged, so the minibatch-SGD math (including
+    the "mean" collision counts) is identical up to float reassociation.
     """
+    if minibatch_sort not in (None, "user", "item"):
+        raise ValueError(
+            f"minibatch_sort must be None|'user'|'item', got {minibatch_sort!r}"
+        )
     if isinstance(ratings, Ratings):
         ru, ri, rv, rw = ratings.to_numpy()
         # Weight-0 entries are padding (types.Ratings contract) — they must
@@ -281,6 +292,19 @@ def block_ratings(
             i_out[s, p, :m] = irow[a:b]
             v_out[s, p, :m] = vals[a:b]
             w_out[s, p, :m] = 1.0
+    if minibatch_sort is not None:
+        key = u_out if minibatch_sort == "user" else i_out
+        mb = minibatch_multiple
+        n_mb = bmax // mb if mb > 1 else 0
+        if n_mb:
+            # sort within each [s, p, chunk] independently (weight-0 padding
+            # has row 0 and sorts first within its chunk — harmless no-ops)
+            shape = (k, k, n_mb, mb)
+            order = np.argsort(key.reshape(shape), axis=-1, kind="stable")
+            for arr in (u_out, i_out, v_out, w_out):
+                arr[...] = np.take_along_axis(
+                    arr.reshape(shape), order, axis=-1
+                ).reshape(k, k, bmax)
     nnz = len(urow)
     return BlockedRatings(
         u_rows=u_out,
@@ -326,6 +350,7 @@ def block_problem(
     seed: int | None = 0,
     minibatch_multiple: int = 1,
     row_multiple: int = 8,
+    minibatch_sort: str | None = None,
 ) -> BlockedProblem:
     """Full blocking pass: both id indices + stratum-major rating blocks.
 
@@ -342,5 +367,6 @@ def block_problem(
         return_rows=True,
     )
     blocked = block_ratings((ru, ri, rv), users, items, minibatch_multiple,
-                            seed=seed, precomputed_rows=(urow, irow))
+                            seed=seed, precomputed_rows=(urow, irow),
+                            minibatch_sort=minibatch_sort)
     return BlockedProblem(users=users, items=items, ratings=blocked)

@@ -59,6 +59,9 @@ class DSGDConfig:
     # precompute the "mean"-mode collision scales at blocking time (same
     # math, removes two full-table scatter+gather rounds per kernel step)
     precompute_collisions: bool = True
+    # intra-minibatch ordering ("user"|"item"|None): gather/scatter locality
+    # lever, same math (data.blocking.block_ratings)
+    minibatch_sort: str | None = None
 
     def schedule_fn(self):
         return schedule_from_name(self.lr_schedule, self.lambda_)
@@ -111,6 +114,7 @@ class DSGD:
             num_blocks=k,
             seed=cfg.seed,
             minibatch_multiple=cfg.minibatch_size,
+            minibatch_sort=cfg.minibatch_sort,
         )
         U, V = self._init_factors(problem)
 

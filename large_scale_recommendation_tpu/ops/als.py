@@ -47,7 +47,6 @@ exactly zero without masking.
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
 
 import numpy as np
 

@@ -21,7 +21,6 @@ riding ICI inside one jitted computation.
 
 from __future__ import annotations
 
-import dataclasses
 from functools import lru_cache, partial
 
 import jax

@@ -153,6 +153,7 @@ class MeshDSGDConfig:
     init_scale: float = 1.0
     collision_mode: str = "mean"  # see ops.sgd.sgd_minibatch_update
     precompute_collisions: bool = True  # see DSGDConfig
+    minibatch_sort: str | None = None  # see DSGDConfig
 
 
 class MeshDSGD:
@@ -207,6 +208,7 @@ class MeshDSGD:
         problem = blocking.block_problem(
             ratings, num_blocks=k, seed=cfg.seed,
             minibatch_multiple=cfg.minibatch_size,
+            minibatch_sort=cfg.minibatch_sort,
         )
         ru, ri, rv, rw = device_major_local_strata(problem)
 

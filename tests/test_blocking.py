@@ -172,3 +172,54 @@ class TestPaddingExclusion:
         rows, mask = idx.rows_for(q)
         assert mask.tolist() == [1.0, 1.0, 0.0, 0.0, 1.0]
         assert idx.ids[rows[4]] == 99996
+
+
+class TestMinibatchSort:
+    """minibatch_sort is a locality-only transform: same minibatch
+    membership, same converged model (up to float reassociation)."""
+
+    def test_membership_unchanged_and_sorted(self):
+        from large_scale_recommendation_tpu.core.generators import (
+            SyntheticMFGenerator,
+        )
+
+        gen = SyntheticMFGenerator(num_users=80, num_items=60, rank=4, seed=0)
+        r = gen.generate(6000)
+        mb = 64
+        base = blocking.block_problem(r, 2, seed=0, minibatch_multiple=mb)
+        srt = blocking.block_problem(r, 2, seed=0, minibatch_multiple=mb,
+                                     minibatch_sort="item")
+        bu, su = base.ratings, srt.ratings
+        assert bu.u_rows.shape == su.u_rows.shape
+        k, _, bmax = bu.u_rows.shape
+        for s in range(k):
+            for p in range(k):
+                for a in range(0, bmax, mb):
+                    sl = slice(a, a + mb)
+                    # same multiset of (u, i, v) entries per minibatch
+                    def ms(br):
+                        return sorted(zip(br.u_rows[s, p, sl].tolist(),
+                                          br.i_rows[s, p, sl].tolist(),
+                                          br.values[s, p, sl].tolist()))
+                    assert ms(bu) == ms(su)
+                    # and the sorted layout is item-ordered
+                    assert (np.diff(su.i_rows[s, p, sl]) >= 0).all()
+
+    def test_fit_result_equivalent(self):
+        from large_scale_recommendation_tpu.core.generators import (
+            SyntheticMFGenerator,
+        )
+        from large_scale_recommendation_tpu.models.dsgd import DSGD, DSGDConfig
+
+        gen = SyntheticMFGenerator(num_users=80, num_items=60, rank=4,
+                                   noise=0.1, seed=1)
+        train = gen.generate(6000)
+        test = gen.generate(1000)
+        base = dict(num_factors=4, lambda_=0.05, iterations=5,
+                    learning_rate=0.1, lr_schedule="constant", seed=0,
+                    minibatch_size=64, init_scale=0.3)
+        a = DSGD(DSGDConfig(**base)).fit(train, num_blocks=2)
+        b = DSGD(DSGDConfig(minibatch_sort="item", **base)).fit(
+            train, num_blocks=2)
+        # identical math up to scatter-order float reassociation
+        assert abs(a.rmse(test) - b.rmse(test)) < 1e-3
