@@ -122,9 +122,14 @@ class OnlineBatchWorkerLogic:
     def __init__(self, cfg: PSOnlineBatchConfig, worker_id: int):
         self.cfg = cfg
         self.worker_id = worker_id
-        init = PseudoRandomFactorInitializer(cfg.num_factors,
-                                             scale=cfg.init_scale)
-        self.users = GrowableFactorTable(init)  # ≙ userVectors (:55)
+        self._init = PseudoRandomFactorInitializer(cfg.num_factors,
+                                                   scale=cfg.init_scale)
+        # ≙ userVectors (:55) — a HOST-side map exactly like the reference's
+        # HashMap: the online path touches one vector per rating, and a
+        # device-resident table would cost a gather + a full-table
+        # functional update dispatch per rating. The batch replay builds a
+        # dense device table from this map once per retrain.
+        self.users: dict[int, np.ndarray] = {}
         self.state = ONLINE
         self.history: list[tuple[int, int, float]] = []  # ≙ rs (:54)
         # ratings awaiting an online pull slot (≙ onlinePullQueue, :72)
@@ -138,10 +143,12 @@ class OnlineBatchWorkerLogic:
         self._rng = np.random.default_rng(cfg.seed + 31 * worker_id)
         # batch replay bookkeeping
         self._chunks: list[np.ndarray] = []
-        self._chunk_data: dict[int, tuple] = {}  # first-id → (us, ips, vals)
+        self._chunk_data: dict[int, tuple] = {}  # first-id → (rows, ips, vals)
         self._chunk_cursor = 0
         self._epoch = 0
         self._queue_in_history = 0  # online_queue prefix already in history
+        self._batch_uids: np.ndarray | None = None  # replayed users (rows)
+        self._batch_U = None  # dense device table for the replay
         self.batches_run = 0
 
     # -- WorkerLogic ---------------------------------------------------------
@@ -177,8 +184,16 @@ class OnlineBatchWorkerLogic:
         """Emit final user vectors (the reference's close is empty — its
         model only escapes via the online output stream; a final dump costs
         nothing and matches ps.mf's contract)."""
-        for fv in self.users.factor_vectors():
-            ps.output((fv.id, fv.factors))
+        for ident, vec in self.users.items():
+            ps.output((ident, vec))
+
+    def _user_vec(self, user: int) -> np.ndarray:
+        vec = self.users.get(user)
+        if vec is None:
+            vec = np.asarray(
+                self._init(np.asarray([user], np.int64))[0], np.float32)
+            self.users[user] = vec
+        return vec
 
     # -- Online (:140-190) ---------------------------------------------------
 
@@ -196,21 +211,31 @@ class OnlineBatchWorkerLogic:
 
     def _online_update(self, answer: PullAnswer, ps) -> None:
         """≙ vectorUpdateAndPush (:167-180): update the local user vector,
-        push the item delta, emit the updated user vector."""
+        push the item delta, emit the updated user vector.
+
+        Uses the updater's host-side scalar twin when it has one
+        (``delta_np``): one rating per answer is the reference contract,
+        and an eager device dispatch per rating would bound the online
+        stream at ~2K ratings/s."""
         item = int(answer.ids[0])
-        item_vec = answer.values[0]
+        item_vec = np.asarray(answer.values[0], dtype=np.float32)
         user, value = self._item_fifo[item].popleft()
-        urow = int(self.users.ensure(np.asarray([user], np.int64))[0])
-        user_vec = np.asarray(self.users.array[urow])
-        du, dv = self.updater.delta(
-            jnp.asarray([value], jnp.float32),
-            jnp.asarray(user_vec)[None, :],
-            jnp.asarray(item_vec, jnp.float32)[None, :],
-        )
-        new_user = user_vec + np.asarray(du[0])
-        self.users.array = self.users.array.at[urow].set(
-            jnp.asarray(new_user))
-        ps.push(np.asarray([item], np.int64), np.asarray(dv))
+        user_vec = self._user_vec(user)
+        delta_np = getattr(self.updater, "delta_np", None)
+        if delta_np is not None:
+            du, dv = delta_np(value, user_vec, item_vec)
+            new_user = user_vec + du
+            dv = dv[None, :]
+        else:
+            du_b, dv = self.updater.delta(
+                jnp.asarray([value], jnp.float32),
+                jnp.asarray(user_vec)[None, :],
+                jnp.asarray(item_vec)[None, :],
+            )
+            new_user = np.asarray(user_vec + np.asarray(du_b[0]), np.float32)
+            dv = np.asarray(dv)
+        self.users[user] = np.asarray(new_user, np.float32)
+        ps.push(np.asarray([item], np.int64), dv)
         ps.output((user, new_user))  # ≙ ps.output(user, ...) (:176)
 
     # -- Trigger → BatchInit (:74-138) ---------------------------------------
@@ -246,7 +271,7 @@ class OnlineBatchWorkerLogic:
             return
         # Group history by item into near-equal chunks (like ps.mf; ≙ the
         # per-item itemRatings grouping, :124-125) and precompute each
-        # chunk's (user, item-position, value) arrays ONCE per retrain —
+        # chunk's (user-row, item-position, value) arrays ONCE per retrain —
         # the per-answer hot path must not re-derive them with per-rating
         # Python loops every epoch.
         hu = np.asarray([r[0] for r in self.history], dtype=np.int64)
@@ -255,8 +280,14 @@ class OnlineBatchWorkerLogic:
         items = np.unique(hi)
         n_chunks = max(1, -(-len(items) // self.cfg.chunk_size))
         self._chunks = list(np.array_split(items, n_chunks))
+        # dense device table over exactly the replayed users, built ONCE
+        # from the host map (and written back once at batch end)
+        self._batch_uids = np.unique(hu)
+        U_np = np.stack([self._user_vec(int(u)) for u in self._batch_uids])
+        self._batch_U = jnp.asarray(U_np)
         order = np.argsort(hi, kind="stable")
         hu, hi, hv = hu[order], hi[order], hv[order]
+        hrows = np.searchsorted(self._batch_uids, hu)
         starts = np.searchsorted(hi, items)
         ends = np.append(starts[1:], len(hi))
         self._chunk_data = {}
@@ -265,7 +296,7 @@ class OnlineBatchWorkerLogic:
             b = ends[np.searchsorted(items, chunk[-1])]
             # item position within the chunk, aligned with the pull answer
             ips = np.searchsorted(chunk, hi[a:b])
-            self._chunk_data[int(chunk[0])] = (hu[a:b], ips, hv[a:b])
+            self._chunk_data[int(chunk[0])] = (hrows[a:b], ips, hv[a:b])
         self._issue_epoch(ps)
 
     def _issue_epoch(self, ps) -> None:
@@ -292,12 +323,11 @@ class OnlineBatchWorkerLogic:
         epoch so the η/√t decay spans the whole retrain)."""
         cfg = self.cfg
         items, V_chunk = answer.ids, answer.values
-        us, ips, vals = self._chunk_data[int(items[0])]
-        perm = self._rng.permutation(len(us))
-        us = us[perm]
+        u_rows, ips, vals = self._chunk_data[int(items[0])]
+        perm = self._rng.permutation(len(u_rows))
+        u_rows = u_rows[perm]
         ips = ips[perm]
         vals = vals[perm]
-        u_rows = self.users.ensure(us)
 
         mb = cfg.minibatch_size
         ur, ir, rv, w = sgd_ops.pad_minibatches(u_rows, ips, vals, mb)
@@ -306,12 +336,12 @@ class OnlineBatchWorkerLogic:
         batch_updater = SGDUpdater(learning_rate=cfg.learning_rate,
                                    schedule=self._batch_sched)
         U_new, V_new = sgd_ops.online_train(
-            self.users.array, V_old,
+            self._batch_U, V_old,
             jnp.asarray(ur), jnp.asarray(ir), jnp.asarray(rv), jnp.asarray(w),
             updater=batch_updater, minibatch=mb, iterations=1,
             t0=self._epoch,
         )
-        self.users.array = U_new
+        self._batch_U = U_new
         ps.push(items, np.asarray(V_new - V_old))
 
         self._answered_in_epoch += 1
@@ -327,6 +357,13 @@ class OnlineBatchWorkerLogic:
     def _finish_batch(self, ps) -> None:
         """≙ the batch-done branch (:216-236): sign every shard, fold the
         parked online ratings into the history, resume Online."""
+        if self._batch_uids is not None:
+            # one download: write the retrained rows back to the host map
+            U_np = np.asarray(self._batch_U)
+            for j, u in enumerate(self._batch_uids.tolist()):
+                self.users[int(u)] = U_np[j]
+            self._batch_uids = None
+            self._batch_U = None
         for p in range(self.cfg.ps_parallelism):
             ps.control(p, "batch_end")  # ≙ push (−psId, Array(−1.0))
         # ≙ rs ++= onlinePullQueue (:230), minus the already-in-history

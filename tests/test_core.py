@@ -304,3 +304,30 @@ class TestRefitCaching:
         misses_before = dsgd_train._cache_size()
         DSGD(cfg).fit(train)
         assert dsgd_train._cache_size() == misses_before
+
+
+class TestDeltaNpTwin:
+    def test_delta_np_matches_batched_delta(self):
+        """The host-side scalar twin must stay in lockstep with the batched
+        device rule (the PS online path depends on it)."""
+        from large_scale_recommendation_tpu.core.updaters import (
+            SGDUpdater,
+            inverse_sqrt_lr,
+        )
+
+        rng = np.random.default_rng(0)
+        for sched in (None, inverse_sqrt_lr):
+            upd = (SGDUpdater(0.07) if sched is None
+                   else SGDUpdater(0.07, schedule=sched))
+            for t in (1, 4):
+                u = rng.normal(size=6).astype(np.float32)
+                v = rng.normal(size=6).astype(np.float32)
+                r = 1.7
+                du_np, dv_np = upd.delta_np(r, u, v, t=t)
+                du, dv = upd.delta(jnp.asarray([r], jnp.float32),
+                                   jnp.asarray(u)[None, :],
+                                   jnp.asarray(v)[None, :], t=t)
+                np.testing.assert_allclose(du_np, np.asarray(du[0]),
+                                           rtol=1e-5, atol=1e-7)
+                np.testing.assert_allclose(dv_np, np.asarray(dv[0]),
+                                           rtol=1e-5, atol=1e-7)

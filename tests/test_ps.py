@@ -276,3 +276,46 @@ class TestPSOfflineMF:
         ru, ri, _, _ = train.to_numpy()
         assert set(np.unique(ru).tolist()) <= set(users)
         assert set(np.unique(ri).tolist()) <= set(items)
+
+
+class TestControlMessageOrdering:
+    def test_control_ordered_after_prior_traffic_same_worker(self):
+        """The in-band property the reference's magic-push encoding exists
+        for (PSOfflineOnlineMF.scala:89-92,361-368): a control event must
+        reach a shard AFTER everything the same worker already sent it."""
+        events: list = []
+
+        class _RecordingShard:
+            def on_pull(self, ids):
+                events.append(("pull", ids.tolist()))
+                return np.zeros((len(ids), 2), np.float32)
+
+            def on_push(self, ids, deltas, outputs, worker_id=-1):
+                events.append(("push", ids.tolist()))
+
+            def on_control(self, worker_id, payload, outputs):
+                events.append(("control", payload))
+
+            def snapshot(self):
+                return {}
+
+        class _Worker:
+            def on_recv(self, data, ps):
+                # one pull + one push, then a control — all to shard 0
+                ps.pull(np.asarray([0], np.int64))
+                ps.push(np.asarray([0], np.int64),
+                        np.ones((1, 2), np.float32))
+                ps.control(0, "marker")
+
+            def on_pull_answer(self, answer, ps):
+                pass
+
+            def close(self, ps):
+                pass
+
+        store = ShardedParameterStore(lambda p: _RecordingShard(), 1)
+        ps_transform([[1]], [_Worker()], store, pull_limit=None,
+                     iteration_wait_time=20.0)
+        kinds = [k for k, _ in events]
+        assert kinds.index("control") > kinds.index("pull")
+        assert kinds.index("control") > kinds.index("push")
