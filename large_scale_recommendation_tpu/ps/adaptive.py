@@ -281,9 +281,18 @@ class OnlineBatchWorkerLogic:
         n_chunks = max(1, -(-len(items) // self.cfg.chunk_size))
         self._chunks = list(np.array_split(items, n_chunks))
         # dense device table over exactly the replayed users, built ONCE
-        # from the host map (and written back once at batch end)
+        # from the host map (and written back once at batch end). Users in
+        # history whose online pulls were never answered before the trigger
+        # are missing from the map — initialize ALL of them with one
+        # batched call, not one dispatch each.
         self._batch_uids = np.unique(hu)
-        U_np = np.stack([self._user_vec(int(u)) for u in self._batch_uids])
+        missing = np.asarray([u for u in self._batch_uids.tolist()
+                              if u not in self.users], np.int64)
+        if len(missing):
+            fresh = np.asarray(self._init(missing), np.float32)
+            for j, u in enumerate(missing.tolist()):
+                self.users[u] = fresh[j]
+        U_np = np.stack([self.users[int(u)] for u in self._batch_uids])
         self._batch_U = jnp.asarray(U_np)
         order = np.argsort(hi, kind="stable")
         hu, hi, hv = hu[order], hi[order], hv[order]

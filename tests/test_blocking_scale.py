@@ -57,3 +57,58 @@ class TestTargetScaleBlocking:
         # budget (BASELINE.md); 25M rows of lexsort-free blocking should be
         # well under 60s on any host
         assert wall < 60, f"blocking took {wall:.1f}s"
+
+
+@pytest.mark.slow
+class TestRealFormatEndToEnd:
+    def test_ml25m_format_csv_parse_block_fit(self, tmp_path):
+        """The real-dataset path executed end-to-end at realistic volume:
+        write 2M rows in the exact ratings.csv format, parse with the
+        native reader, block, and fit a few DSGD sweeps (VERDICT r2 weak
+        #8 — the loaders had only ever seen 3-line files)."""
+        import numpy as np
+
+        from large_scale_recommendation_tpu.core.generators import (
+            SyntheticMFGenerator,
+        )
+        from large_scale_recommendation_tpu.data.movielens import load_ml25m
+        from large_scale_recommendation_tpu.models.dsgd import (
+            DSGD,
+            DSGDConfig,
+        )
+
+        n = 2_000_000
+        gen = SyntheticMFGenerator(num_users=20_000, num_items=5_000,
+                                   rank=8, noise=0.1, seed=0, skew_lam=2.0)
+        r = gen.generate(n)
+        ru, ri, rv, _ = r.to_numpy()
+        # half-star grid + 1-based ids, like the real file
+        stars = np.clip(np.round((rv - rv.min()) * 2) / 2 + 0.5, 0.5, 5.0)
+        path = tmp_path / "ratings.csv"
+        t0 = time.perf_counter()
+        with open(path, "w") as f:
+            f.write("userId,movieId,rating,timestamp\n")
+            np.savetxt(f, np.column_stack([ru + 1, ri + 1, stars,
+                                           np.full(n, 1234567890)]),
+                       fmt="%d,%d,%.1f,%d")
+        write_wall = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        ratings = load_ml25m(str(tmp_path))
+        parse_wall = time.perf_counter() - t0
+        assert ratings.n == n
+        ru2, ri2, rv2, _ = ratings.to_numpy()
+        assert ru2.min() == 1 and rv2.min() >= 0.5 and rv2.max() <= 5.0
+
+        t0 = time.perf_counter()
+        model = DSGD(DSGDConfig(num_factors=16, lambda_=0.1, iterations=2,
+                                learning_rate=0.1, lr_schedule="constant",
+                                seed=0, minibatch_size=8192,
+                                init_scale=0.1)).fit(ratings, num_blocks=4)
+        fit_wall = time.perf_counter() - t0
+        assert np.isfinite(model.rmse(ratings))
+        print(f"\n# csv write={write_wall:.1f}s parse={parse_wall:.1f}s "
+              f"fit(2 sweeps)={fit_wall:.1f}s")
+        # the native parser must be doing the work (numpy text read of 2M
+        # rows takes minutes)
+        assert parse_wall < 30, parse_wall
