@@ -66,7 +66,6 @@ class TestRealFormatEndToEnd:
         write 2M rows in the exact ratings.csv format, parse with the
         native reader, block, and fit a few DSGD sweeps (VERDICT r2 weak
         #8 — the loaders had only ever seen 3-line files)."""
-        import numpy as np
 
         from large_scale_recommendation_tpu.core.generators import (
             SyntheticMFGenerator,
