@@ -342,7 +342,11 @@ def main() -> None:
         return
     errors.append(f"attempt 1: {tail}")
     print(f"# bench attempt 1 failed: {tail[-300:]}", file=sys.stderr)
-    if _looks_transient(tail):
+    # A full-window hang (wedged TPU tunnel — observed to persist for
+    # hours) will not heal in 15 s; burning a second full window just
+    # delays the CPU fallback. Retry only quick transient FAILURES.
+    hang = tail.startswith("timeout after")
+    if _looks_transient(tail) and not hang:
         time.sleep(15)
         result, tail = _attempt({}, per_attempt)
         if result is not None:
