@@ -299,7 +299,11 @@ def _extra_lines(extra: dict, rank: int, jax) -> None:
 # --------------------------------------------------------------------------
 
 def _attempt(env_overrides: dict[str, str], timeout: float):
-    """Run one child attempt; return (json_dict | None, tail_of_output)."""
+    """Run one child attempt.
+
+    Returns ``(json_dict | None, tail_of_output, hung)`` — ``hung`` is the
+    structured signal that the child consumed its whole window (wedged
+    backend), distinct from a quick failure worth retrying."""
     env = dict(os.environ)
     env.update(env_overrides)
     try:
@@ -310,17 +314,17 @@ def _attempt(env_overrides: dict[str, str], timeout: float):
     except subprocess.TimeoutExpired as e:
         tail = ((e.stderr or b"")[-2000:] if isinstance(e.stderr, bytes)
                 else (e.stderr or "")[-2000:])
-        return None, f"timeout after {timeout}s; stderr tail: {tail}"
+        return None, f"timeout after {timeout}s; stderr tail: {tail}", True
     out_lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
     if proc.returncode == 0 and out_lines:
         try:
             parsed = json.loads(out_lines[-1])
             if "value" in parsed:
-                return parsed, proc.stderr[-1000:]
+                return parsed, proc.stderr[-1000:], False
         except json.JSONDecodeError:
             pass
     tail = (proc.stderr or proc.stdout)[-2000:]
-    return None, f"rc={proc.returncode}; tail: {tail}"
+    return None, f"rc={proc.returncode}; tail: {tail}", False
 
 
 def _looks_transient(tail: str) -> bool:
@@ -336,7 +340,7 @@ def main() -> None:
     per_attempt = float(os.environ.get("BENCH_TIMEOUT", 2400))
     errors: list[str] = []
 
-    result, tail = _attempt({}, per_attempt)
+    result, tail, hung = _attempt({}, per_attempt)
     if result is not None:
         print(json.dumps(result))
         return
@@ -345,10 +349,9 @@ def main() -> None:
     # A full-window hang (wedged TPU tunnel — observed to persist for
     # hours) will not heal in 15 s; burning a second full window just
     # delays the CPU fallback. Retry only quick transient FAILURES.
-    hang = tail.startswith("timeout after")
-    if _looks_transient(tail) and not hang:
+    if _looks_transient(tail) and not hung:
         time.sleep(15)
-        result, tail = _attempt({}, per_attempt)
+        result, tail, _ = _attempt({}, per_attempt)
         if result is not None:
             print(json.dumps(result))
             return
@@ -367,7 +370,7 @@ def main() -> None:
         "BENCH_BLOCKS": "4",
         "BENCH_SKIP_EXTRAS": "1",
     }
-    result, tail = _attempt(cpu_env, per_attempt)
+    result, tail, _ = _attempt(cpu_env, per_attempt)
     if result is not None:
         result["error"] = (
             "default-backend attempts failed; value is a reduced "
