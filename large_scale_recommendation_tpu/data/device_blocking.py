@@ -1,0 +1,392 @@
+"""On-device workload generation + DSGD blocking (the XLA data pipeline).
+
+TPU-first counterpart of the host blocking pass (``data.blocking``).
+Blocking is a pure data-layout transform — sort, prefix-sum, scatter — and
+XLA's sort/cumsum/scatter primitives run it at HBM speed on chip. Keeping
+the whole pipeline on device means the host never materializes the
+``k × k × bmax`` stratum expansion at all:
+
+- synthetic benchmarks (``synthetic_like_device``) move only scalars and a
+  256-byte size vector across the host↔device link — the difference between
+  kilobytes and the ~600 MB the host pipeline ships for the ML-25M-shaped
+  north-star config (BASELINE.md), which matters on narrow links
+  (tunneled/remote devices) and at pod scale where per-host PCIe is shared;
+- real datasets ship the raw COO triple (id, id, value) once, ~3× smaller
+  than the padded stratum layout + collision scales, which are built on
+  chip.
+
+Scope: dense, pre-compacted ids in ``[0, num_users) × [0, num_items)`` —
+the contract of production feature pipelines and of the synthetic
+generators. Arbitrary external ids go through the host path
+(``data.blocking``), which also produces the reference-shaped ``IdIndex``.
+
+Reference seams mirrored (same capabilities, device-resident):
+- id → block/row assignment with balanced blocks and omega counts
+  ≙ ``initFactorBlockAndIndices`` (DSGDforMF.scala:513-588, :537-541);
+- stratum-major rating blocks, diagonal-rotation schedule pre-baked
+  ≙ rating-block construction + ``nextRatingBlock``
+  (DSGDforMF.scala:301-333, :562, :611-619);
+- truncated-exponential skewed id draws ≙ ``nextExpDiscrete``
+  (RandomGenerator.scala:36-50) — by exact inverse CDF on the truncated
+  support instead of the reference's rejection recursion (loop-free, so it
+  jits);
+- planted-low-rank synthetic ratings ≙ ``core.generators
+  .SyntheticMFGenerator`` (the oracle workload; no reference analogue —
+  the reference has no tests or benchmarks, SURVEY §4/§6).
+
+The layouts produced here satisfy the same invariants as the host pass
+(disjoint strata, balanced blocks, weight-0 padding, per-minibatch
+collision scales) but are not bit-identical to it — both are seeded and
+deterministic, they just draw their permutations from different RNGs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+
+# --------------------------------------------------------------------------
+# Synthetic generation (device)
+# --------------------------------------------------------------------------
+
+
+def truncated_exp_ids(key: jax.Array, lam: float, n_ids: int,
+                      size: int) -> jax.Array:
+    """Skewed id draw: discretized exponential truncated to [0, n_ids).
+
+    ≙ ``nextExpDiscrete`` (RandomGenerator.scala:36-50). The reference
+    rejection-samples the overshoot tail; here the uniform is mapped through
+    the exact truncated inverse CDF (u' = u·(1−e^{−λ})), which is loop-free
+    and therefore jittable. Low ids are hot.
+    """
+    u = jax.random.uniform(key, (size,), dtype=jnp.float32)
+    u = u * (1.0 - np.exp(-lam))
+    v = jnp.floor(-jnp.log1p(-u) / lam * n_ids).astype(jnp.int32)
+    return jnp.minimum(v, n_ids - 1)
+
+
+@partial(jax.jit, static_argnames=("num_users", "num_items", "rank", "n",
+                                   "noise", "skew_lam"))
+def _planted_batch(key, factor_key, num_users: int, num_items: int,
+                   rank: int, n: int, noise: float,
+                   skew_lam: float | None):
+    """One batch of planted-low-rank ratings, all on device.
+
+    ``factor_key`` seeds the ground-truth factors (shared across batches of
+    one workload); ``key`` seeds this batch's id/noise draws.
+    """
+    ku, kv = jax.random.split(factor_key)
+    scale = 1.0 / np.sqrt(rank)
+    Ut = scale * jax.random.normal(ku, (num_users, rank), jnp.float32)
+    Vt = scale * jax.random.normal(kv, (num_items, rank), jnp.float32)
+    k1, k2, k3 = jax.random.split(key, 3)
+    if skew_lam is not None:
+        u = truncated_exp_ids(k1, skew_lam, num_users, n)
+        i = truncated_exp_ids(k2, skew_lam, num_items, n)
+    else:
+        u = jax.random.randint(k1, (n,), 0, num_users, jnp.int32)
+        i = jax.random.randint(k2, (n,), 0, num_items, jnp.int32)
+    r = jnp.einsum("nk,nk->n", Ut[u], Vt[i])
+    r = r + noise * jax.random.normal(k3, (n,), jnp.float32)
+    return u, i, r
+
+
+from large_scale_recommendation_tpu.data.movielens import _SHAPES  # noqa: E402
+
+
+def synthetic_like_device(
+    name: str,
+    nnz: int | None = None,
+    rank: int = 16,
+    noise: float = 0.3,
+    seed: int = 0,
+    skew_lam: float | None = 2.0,
+):
+    """Device-resident ``synthetic_like``: planted-low-rank train/holdout
+    batches with the named dataset's shape statistics.
+
+    Returns ``((u, i, r), (hu, hi, hr), (num_users, num_items))`` — all six
+    arrays live on device; nothing but the PRNG key crosses the link.
+    Same 95/5 split-by-volume contract as ``data.movielens.synthetic_like``.
+    """
+    if name not in _SHAPES:
+        raise KeyError(f"unknown dataset {name!r}; have {sorted(_SHAPES)}")
+    nu, ni, n_default = _SHAPES[name]
+    n = int(nnz if nnz is not None else n_default)
+    n_train = int(n * 0.95)
+    base = jax.random.PRNGKey(seed)
+    fkey = jax.random.fold_in(base, 0)
+    train = _planted_batch(jax.random.fold_in(base, 1), fkey, nu, ni,
+                           rank, n_train, noise, skew_lam)
+    hold = _planted_batch(jax.random.fold_in(base, 2), fkey, nu, ni,
+                          rank, n - n_train, noise, skew_lam)
+    return train, hold, (nu, ni)
+
+
+# --------------------------------------------------------------------------
+# Blocking (device)
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class DeviceBlockedProblem:
+    """Stratum-major blocked problem, fully device-resident.
+
+    Same layout contract as ``blocking.BlockedProblem`` flattened to the
+    arrays the kernels consume (``ops.sgd.dsgd_train`` signature): entry
+    ``[s, p, :]`` is rating block ``(p, (p+s) mod k)``.
+    """
+
+    su: jax.Array  # int32[k, k, bmax] global user rows
+    si: jax.Array  # int32[k, k, bmax] global item rows
+    sv: jax.Array  # float32[k, k, bmax]
+    sw: jax.Array  # float32[k, k, bmax] 1=real 0=pad
+    icu: jax.Array  # float32[k, k, bmax] 1/minibatch-occurrence (user side)
+    icv: jax.Array  # float32[k, k, bmax] (item side)
+    omega_u: jax.Array  # float32[num_user_rows] occurrence counts
+    omega_v: jax.Array  # float32[num_item_rows]
+    row_of_user: jax.Array  # int32[num_users] dense id → global row
+    row_of_item: jax.Array  # int32[num_items]
+    id_of_user_row: jax.Array  # int32[num_user_rows]; 0 on padding rows
+    id_of_item_row: jax.Array  # int32[num_item_rows]
+    num_blocks: int
+    rows_per_block_u: int
+    rows_per_block_v: int
+    nnz: int
+    max_pad_ratio: float
+
+    def holdout_rows(self, hu: jax.Array, hi: jax.Array):
+        """Map holdout ids to rows with a seen-in-training mask.
+
+        Host-path semantics (``IdIndex.rows_for``): ids absent from training
+        are masked out of evaluation.
+        """
+        ur = self.row_of_user[hu]
+        ir = self.row_of_item[hi]
+        mask = ((self.omega_u[ur] > 0) & (self.omega_v[ir] > 0)).astype(
+            jnp.float32)
+        return ur, ir, mask
+
+
+@partial(jax.jit, static_argnames=("k", "rpb", "num_rows"))
+def _assign_rows(key, counts: jax.Array, k: int, rpb: int, num_rows: int):
+    """Balanced block/row assignment for one side.
+
+    ≙ ``build_id_index``'s serpentine deal (data/blocking.py): seeded random
+    tiebreak, hottest ids dealt first in alternating direction so per-block
+    nnz stays near-equal on power-law data (the load-balancing the
+    reference's ``ExponentialRatingGen`` stresses, RandomGenerator.scala:20-26).
+    """
+    n_ids = counts.shape[0]
+    # random permutation first, then a STABLE sort by descending count —
+    # equal-count ties land in random order without needing 64-bit
+    # composite keys (int64 is emulated on TPU and off by default in jax)
+    perm = jax.random.permutation(key, n_ids)
+    order = perm[jnp.argsort(-counts[perm], stable=True)]
+    ar = jnp.arange(n_ids, dtype=jnp.int32)
+    rnd, pos = ar // k, ar % k
+    block = jnp.where(rnd % 2 == 0, pos, k - 1 - pos)
+    rows_sorted = block * rpb + rnd
+    row_of_id = jnp.zeros(n_ids, jnp.int32).at[order].set(
+        rows_sorted, unique_indices=True)
+    omega = jnp.zeros(num_rows, jnp.float32).at[row_of_id].set(
+        counts.astype(jnp.float32), unique_indices=True)
+    id_of_row = jnp.zeros(num_rows, jnp.int32).at[row_of_id].set(
+        ar, unique_indices=True)
+    return row_of_id, omega, id_of_row
+
+
+@partial(jax.jit, static_argnames=("k", "rpb_u", "rpb_v"))
+def _bucket_entries(key, u, i, r, row_of_u, row_of_i,
+                    k: int, rpb_u: int, rpb_v: int):
+    """Map entries to (stratum, user-block) buckets and sort them bucket-
+    contiguous with random within-bucket order (≙ the host pass's seeded
+    shuffle + stable bucket sort, data/blocking.py ``block_ratings``)."""
+    urow = row_of_u[u]
+    irow = row_of_i[i]
+    ublk = urow // rpb_u
+    iblk = irow // rpb_v
+    strat = (iblk - ublk) % k
+    flat = (strat * k + ublk).astype(jnp.int32)
+    sizes = jnp.zeros(k * k, jnp.int32).at[flat].add(1)
+    # seeded permutation + stable bucket sort: buckets become contiguous
+    # runs with random within-bucket order (≙ the host pass's shuffle +
+    # stable counting sort; avoids 64-bit composite keys, see _assign_rows)
+    perm = jax.random.permutation(key, flat.shape[0])
+    order = perm[jnp.argsort(flat[perm], stable=True)]
+    return (sizes, flat[order], urow[order], irow[order],
+            jnp.asarray(r, jnp.float32)[order])
+
+
+def _inv_counts_2d(rows: jax.Array, w: jax.Array) -> jax.Array:
+    """Per-entry 1/(weight-sum of its row within its minibatch).
+
+    Device form of ``blocking.minibatch_inv_counts`` / the native
+    ``minibatch_inv_counts_flat``: sort each minibatch by row, find each
+    run's weighted size with two cummax passes + a cumsum difference, and
+    un-sort. Padding (weight 0) contributes nothing; its own scale is
+    irrelevant (its delta is zero regardless).
+    """
+    mb = rows.shape[-1]
+    j = jnp.arange(mb, dtype=jnp.int32)[None, :]
+    sidx = jnp.argsort(rows, axis=-1)
+    sr = jnp.take_along_axis(rows, sidx, axis=-1)
+    sw = jnp.take_along_axis(w, sidx, axis=-1)
+    diff = sr[:, 1:] != sr[:, :-1]
+    ones = jnp.ones_like(sr[:, :1], bool)
+    new = jnp.concatenate([ones, diff], axis=-1)  # run starts
+    last = jnp.concatenate([diff, ones], axis=-1)  # run ends
+    start = jax.lax.cummax(jnp.where(new, j, -1), axis=1)
+    end_rev = jax.lax.cummax(
+        jnp.where(last, mb - 1 - j, -1)[:, ::-1], axis=1)[:, ::-1]
+    end = mb - 1 - end_rev
+    cumw = jnp.cumsum(sw, axis=-1)
+    W = (jnp.take_along_axis(cumw, end, axis=-1)
+         - jnp.take_along_axis(cumw, start, axis=-1)
+         + jnp.take_along_axis(sw, start, axis=-1))
+    inv_sorted = 1.0 / jnp.maximum(W, 1.0)
+    inv_back = jnp.argsort(sidx, axis=-1)
+    return jnp.take_along_axis(inv_sorted, inv_back, axis=-1)
+
+
+@partial(jax.jit, static_argnames=("k", "bmax", "mb", "sort_side"))
+def _layout(flat_s, urow_s, irow_s, vals_s, sizes,
+            k: int, bmax: int, mb: int, sort_side: str | None):
+    """Scatter bucket-sorted entries into the padded [k, k, bmax] layout and
+    compute the per-minibatch collision scales (both sides) on device."""
+    n = flat_s.shape[0]
+    starts = jnp.concatenate(
+        [jnp.zeros(1, jnp.int32), jnp.cumsum(sizes)[:-1]])
+    idx_in = jnp.arange(n, dtype=jnp.int32) - starts[flat_s]
+    dest = flat_s * bmax + idx_in
+    total = k * k * bmax
+    su = jnp.zeros(total, jnp.int32).at[dest].set(urow_s,
+                                                  unique_indices=True)
+    si = jnp.zeros(total, jnp.int32).at[dest].set(irow_s,
+                                                  unique_indices=True)
+    sv = jnp.zeros(total, jnp.float32).at[dest].set(vals_s,
+                                                    unique_indices=True)
+    sw = jnp.zeros(total, jnp.float32).at[dest].set(1.0,
+                                                    unique_indices=True)
+
+    def two_d(a):
+        return a.reshape(-1, mb)
+
+    if sort_side is not None:
+        # intra-minibatch locality sort (≙ blocking.block_ratings
+        # minibatch_sort): membership unchanged, math identical up to
+        # float reassociation
+        keyarr = su if sort_side == "user" else si
+        order = jnp.argsort(two_d(keyarr), axis=-1)
+
+        def apply(a):
+            return jnp.take_along_axis(two_d(a), order,
+                                       axis=-1).reshape(total)
+
+        su, si, sv, sw = apply(su), apply(si), apply(sv), apply(sw)
+
+    icu = _inv_counts_2d(two_d(su), two_d(sw)).reshape(total)
+    icv = _inv_counts_2d(two_d(si), two_d(sw)).reshape(total)
+    shape = (k, k, bmax)
+    return (su.reshape(shape), si.reshape(shape), sv.reshape(shape),
+            sw.reshape(shape), icu.reshape(shape), icv.reshape(shape))
+
+
+def device_block_problem(
+    u: jax.Array,
+    i: jax.Array,
+    r: jax.Array,
+    num_users: int,
+    num_items: int,
+    num_blocks: int,
+    minibatch_multiple: int = 1,
+    seed: int = 0,
+    row_multiple: int = 8,
+    minibatch_sort: str | None = None,
+) -> DeviceBlockedProblem:
+    """Full on-device blocking pass over dense-id COO arrays.
+
+    The only host↔device traffic is the 256-byte bucket-size vector (read
+    back to fix the padded block size ``bmax``, which must be a static shape
+    for XLA). Everything else — balanced row assignment, omegas, the
+    stratum-major scatter, per-minibatch collision scales — happens on chip.
+    """
+    if minibatch_sort not in (None, "user", "item"):
+        raise ValueError(
+            f"minibatch_sort must be None|'user'|'item', got {minibatch_sort!r}")
+    k = num_blocks
+    u = jnp.asarray(u, jnp.int32)
+    i = jnp.asarray(i, jnp.int32)
+    # Fail fast on out-of-range ids: the scatters/gathers below would
+    # otherwise silently drop/clamp them into a wrong-but-plausible layout
+    # (e.g. raw 1-based MovieLens ids). One tiny scalar sync, once per fit.
+    lo_u, hi_u = int(u.min()), int(u.max())
+    lo_i, hi_i = int(i.min()), int(i.max())
+    if lo_u < 0 or hi_u >= num_users or lo_i < 0 or hi_i >= num_items:
+        raise ValueError(
+            f"device_block_problem needs dense ids in [0, num_users) × "
+            f"[0, num_items); got user range [{lo_u}, {hi_u}] vs "
+            f"{num_users}, item range [{lo_i}, {hi_i}] vs {num_items}. "
+            "Arbitrary external ids go through data.blocking (host path).")
+    base = jax.random.PRNGKey(seed)
+
+    def rpb_of(n_ids):
+        rpb = max(-(-n_ids // k), 1)
+        return -(-rpb // row_multiple) * row_multiple
+
+    rpb_u, rpb_v = rpb_of(num_users), rpb_of(num_items)
+    counts_u = jnp.zeros(num_users, jnp.int32).at[u].add(1)
+    counts_v = jnp.zeros(num_items, jnp.int32).at[i].add(1)
+    row_of_u, omega_u, id_of_ur = _assign_rows(
+        jax.random.fold_in(base, 10), counts_u, k, rpb_u, k * rpb_u)
+    row_of_i, omega_v, id_of_ir = _assign_rows(
+        jax.random.fold_in(base, 11), counts_v, k, rpb_v, k * rpb_v)
+
+    sizes, flat_s, urow_s, irow_s, vals_s = _bucket_entries(
+        jax.random.fold_in(base, 12), u, i, r, row_of_u, row_of_i,
+        k, rpb_u, rpb_v)
+
+    sizes_host = np.asarray(sizes)  # the one tiny device→host sync
+    bmax = max(int(sizes_host.max()), 1)
+    mbm = max(minibatch_multiple, 1)
+    bmax = -(-bmax // mbm) * mbm
+
+    su, si, sv, sw, icu, icv = _layout(
+        flat_s, urow_s, irow_s, vals_s, sizes, k, bmax, mbm, minibatch_sort)
+
+    nnz = int(sizes_host.sum())
+    return DeviceBlockedProblem(
+        su=su, si=si, sv=sv, sw=sw, icu=icu, icv=icv,
+        omega_u=omega_u, omega_v=omega_v,
+        row_of_user=row_of_u, row_of_item=row_of_i,
+        id_of_user_row=id_of_ur, id_of_item_row=id_of_ir,
+        num_blocks=k, rows_per_block_u=rpb_u, rows_per_block_v=rpb_v,
+        nnz=nnz, max_pad_ratio=(k * k * bmax) / max(nnz, 1),
+    )
+
+
+def init_factors_device(problem: DeviceBlockedProblem, rank: int,
+                        scale: float) -> tuple[jax.Array, jax.Array]:
+    """Per-id deterministic factor init for the device problem.
+
+    Same semantics as ``PseudoRandomFactorInitializer`` (row = scale ·
+    uniform(fold_in(key0, id))) applied through ``id_of_*_row``, so a given
+    id gets the same vector as on the host path's table for that id.
+    Padding rows carry id 0's vector — they are never touched by training
+    (no ratings reference them).
+    """
+    from large_scale_recommendation_tpu.core.initializers import (
+        _keyed_uniform_rows_padded,
+    )
+
+    key = jax.random.PRNGKey(0)
+    s = jnp.float32(scale)
+    U = _keyed_uniform_rows_padded(key, problem.id_of_user_row, rank, s)
+    V = _keyed_uniform_rows_padded(key, problem.id_of_item_row, rank, s)
+    return U, V

@@ -1,0 +1,222 @@
+"""On-device blocking pipeline: layout invariants, parity with the host
+pass's semantics, and end-to-end convergence through the DSGD kernel.
+
+The device path (data/device_blocking.py) must produce a layout satisfying
+the same contract as the host path (data/blocking.py) — disjoint strata,
+balanced blocks, correct omegas and collision scales — without being
+bit-identical (different seeded permutations).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from large_scale_recommendation_tpu.data import blocking, device_blocking
+from large_scale_recommendation_tpu.ops import sgd as sgd_ops
+from large_scale_recommendation_tpu.core.updaters import (
+    RegularizedSGDUpdater,
+    constant_lr,
+)
+
+
+def _toy(n=4000, nu=300, ni=200, seed=0, skew=None):
+    rng = np.random.default_rng(seed)
+    if skew is None:
+        u = rng.integers(0, nu, n)
+        i = rng.integers(0, ni, n)
+    else:
+        u = np.minimum((-np.log1p(-rng.random(n) * (1 - np.exp(-skew)))
+                        / skew * nu).astype(np.int64), nu - 1)
+        i = np.minimum((-np.log1p(-rng.random(n) * (1 - np.exp(-skew)))
+                        / skew * ni).astype(np.int64), ni - 1)
+    r = rng.normal(0, 1, n).astype(np.float32)
+    return u, i, r, nu, ni
+
+
+class TestDeviceBlocking:
+    @pytest.mark.parametrize("skew", [None, 2.0])
+    @pytest.mark.parametrize("k", [2, 4])
+    def test_layout_invariants(self, k, skew):
+        u, i, r, nu, ni = _toy(skew=skew)
+        p = device_blocking.device_block_problem(
+            u, i, r, nu, ni, num_blocks=k, minibatch_multiple=64)
+
+        su = np.asarray(p.su)
+        si = np.asarray(p.si)
+        sv = np.asarray(p.sv)
+        sw = np.asarray(p.sw)
+        # every real entry appears exactly once, with its value
+        assert int(sw.sum()) == len(u)
+        assert p.nnz == len(u)
+        # stratum-major contract: block [s, pb] holds ratings with
+        # user-block pb and item-block (pb+s) mod k
+        for s in range(k):
+            for pb in range(k):
+                m = sw[s, pb] > 0
+                if not m.any():
+                    continue
+                assert (su[s, pb][m] // p.rows_per_block_u == pb).all()
+                assert (si[s, pb][m] // p.rows_per_block_v
+                        == (pb + s) % k).all()
+        # the multiset of (urow, irow, value) matches the input through the
+        # id→row maps
+        row_u = np.asarray(p.row_of_user)
+        row_i = np.asarray(p.row_of_item)
+        exp = sorted(zip(row_u[u].tolist(), row_i[i].tolist(),
+                         np.float32(r).tolist()))
+        got = sorted(zip(su[sw > 0].tolist(), si[sw > 0].tolist(),
+                         sv[sw > 0].tolist()))
+        assert exp == got
+
+    def test_row_maps_and_omegas(self):
+        u, i, r, nu, ni = _toy(skew=2.0)
+        k = 4
+        p = device_blocking.device_block_problem(
+            u, i, r, nu, ni, num_blocks=k, minibatch_multiple=32)
+        row_u = np.asarray(p.row_of_user)
+        # bijective over ids: every id gets a distinct row
+        assert len(set(row_u.tolist())) == nu
+        # id_of_row inverts row_of_id
+        id_of = np.asarray(p.id_of_user_row)
+        assert (id_of[row_u] == np.arange(nu)).all()
+        # omegas are the occurrence counts, indexed by row
+        cnt = np.bincount(u, minlength=nu)
+        assert (np.asarray(p.omega_u)[row_u] == cnt).all()
+        # blocks are balanced: per-block id counts differ by at most 1 row
+        blk = row_u // p.rows_per_block_u
+        sizes = np.bincount(blk, minlength=k)
+        assert sizes.max() - sizes.min() <= 1
+
+    def test_load_balance_on_skewed_data(self):
+        """The serpentine deal keeps per-block nnz near-equal even with
+        power-law ids (same property the host pass guarantees)."""
+        u, i, r, nu, ni = _toy(n=20_000, skew=2.0)
+        k = 4
+        p = device_blocking.device_block_problem(
+            u, i, r, nu, ni, num_blocks=k, minibatch_multiple=1)
+        blk = np.asarray(p.row_of_user)[u] // p.rows_per_block_u
+        per_block = np.bincount(blk, minlength=k)
+        assert per_block.max() / per_block.min() < 1.5
+
+    def test_inv_counts_match_numpy_recomputation(self):
+        u, i, r, nu, ni = _toy(n=3000, nu=40, ni=30, skew=2.0)  # many dups
+        mb = 128
+        p = device_blocking.device_block_problem(
+            u, i, r, nu, ni, num_blocks=2, minibatch_multiple=mb)
+        su = np.asarray(p.su).reshape(-1)
+        sw = np.asarray(p.sw).reshape(-1)
+        icu = np.asarray(p.icu).reshape(-1)
+        # recompute per-minibatch weighted counts in numpy on the SAME layout
+        for m0 in range(0, len(su), mb):
+            rows = su[m0:m0 + mb]
+            w = sw[m0:m0 + mb]
+            inv = icu[m0:m0 + mb]
+            for j in range(mb):
+                cnt = w[rows == rows[j]].sum()
+                if w[j] > 0:
+                    assert inv[j] == pytest.approx(1.0 / max(cnt, 1.0))
+
+    def test_collision_scale_semantics_match_host(self):
+        """Same definition as blocking.minibatch_inv_counts: a real entry's
+        scale is 1/(weight-sum of its row in its minibatch)."""
+        u = np.array([0, 0, 0, 1, 1, 2, 3, 3], np.int64)
+        i = np.array([0, 1, 2, 0, 1, 0, 0, 1], np.int64)
+        r = np.ones(8, np.float32)
+        p = device_blocking.device_block_problem(
+            u, i, r, 4, 3, num_blocks=1, minibatch_multiple=8, seed=3)
+        su = np.asarray(p.su).reshape(-1)[:8]
+        icu = np.asarray(p.icu).reshape(-1)[:8]
+        cnt = {row: (su == row).sum() for row in set(su.tolist())}
+        for j in range(8):
+            assert icu[j] == pytest.approx(1.0 / cnt[su[j]])
+
+    def test_truncated_exp_matches_host_distribution(self):
+        """Device inverse-CDF draw ≈ host rejection draw (same truncated
+        exponential): compare decile masses."""
+        from large_scale_recommendation_tpu.core.generators import (
+            _next_exp_discrete,
+        )
+
+        n_ids, lam, n = 1000, 2.0, 200_000
+        host = _next_exp_discrete(np.random.default_rng(0), lam, n_ids, n)
+        dev = np.asarray(device_blocking.truncated_exp_ids(
+            jax.random.PRNGKey(0), lam, n_ids, n))
+        assert dev.min() >= 0 and dev.max() < n_ids
+        hh = np.bincount(host // 100, minlength=10) / n
+        hd = np.bincount(dev // 100, minlength=10) / n
+        np.testing.assert_allclose(hh, hd, atol=0.01)
+
+    def test_synthetic_like_device_stats(self):
+        (u, i, r), (hu, hi, hr), (nu, ni) = \
+            device_blocking.synthetic_like_device(
+                "ml-100k", nnz=50_000, rank=16, noise=0.1, seed=0)
+        assert nu == 943 and ni == 1682
+        assert u.shape[0] == 47_500 and hu.shape[0] == 2_500
+        r = np.asarray(r)
+        # planted signal std ≈ 1/sqrt(rank)=0.25, noise 0.1 → total ≈ 0.27
+        assert 0.2 < r.std() < 0.35
+        assert abs(r.mean()) < 0.02
+
+    def test_end_to_end_convergence_through_dsgd_kernel(self):
+        """Device pipeline → dsgd_train recovers planted structure (the
+        shape of the bench's DSGD path, miniature)."""
+        (u, i, r), (hu, hi, hr), (nu, ni) = \
+            device_blocking.synthetic_like_device(
+                "ml-100k", nnz=60_000, rank=4, noise=0.05, seed=1)
+        k, mb, rank = 2, 512, 8
+        p = device_blocking.device_block_problem(
+            u, i, r, nu, ni, num_blocks=k, minibatch_multiple=mb, seed=1)
+        U, V = device_blocking.init_factors_device(p, rank, scale=0.1)
+        upd = RegularizedSGDUpdater(learning_rate=0.2, lambda_=0.05,
+                                    schedule=constant_lr)
+        hur, hir, hmask = p.holdout_rows(hu, hi)
+
+        def rmse(U, V):
+            sse = sgd_ops.sse_rows(U, V, hur, hir, hr, hmask)
+            return float(np.sqrt(float(sse) / float(hmask.sum())))
+
+        before = rmse(U, V)
+        for t in range(12):
+            U, V = sgd_ops.dsgd_train(
+                U, V, p.su, p.si, p.sv, p.sw, p.omega_u, p.omega_v,
+                p.icu, p.icv, updater=upd, minibatch=mb, num_blocks=k,
+                iterations=1, collision="mean", t0=t)
+        after = rmse(U, V)
+        # measured (CPU and TPU agree): 0.5 → ~0.076 by sweep 12 (noise
+        # floor 0.05); the bilinear bootstrap spends ~3 sweeps flat first
+        assert after < before * 0.3
+        assert after < 0.12
+
+    def test_minibatch_sort_preserves_membership_and_math(self):
+        u, i, r, nu, ni = _toy(n=2000, seed=5)
+        mb = 64
+        ps = device_blocking.device_block_problem(
+            u, i, r, nu, ni, num_blocks=2, minibatch_multiple=mb, seed=2,
+            minibatch_sort="item")
+        pn = device_blocking.device_block_problem(
+            u, i, r, nu, ni, num_blocks=2, minibatch_multiple=mb, seed=2)
+        # same minibatch membership: each mb-chunk holds the same multiset
+        for a, b in ((ps.su, pn.su), (ps.sv, pn.sv)):
+            a2 = np.asarray(a).reshape(-1, mb)
+            b2 = np.asarray(b).reshape(-1, mb)
+            for row_a, row_b in zip(a2, b2):
+                assert sorted(row_a.tolist()) == sorted(row_b.tolist())
+        # sorted variant is item-ordered within chunks
+        si2 = np.asarray(ps.si).reshape(-1, mb)
+        assert all((np.diff(row) >= 0).all() for row in si2)
+
+    def test_init_factors_device_matches_host_initializer(self):
+        from large_scale_recommendation_tpu.core.initializers import (
+            PseudoRandomFactorInitializer,
+        )
+
+        u, i, r, nu, ni = _toy(n=500, nu=50, ni=40)
+        p = device_blocking.device_block_problem(
+            u, i, r, nu, ni, num_blocks=2, minibatch_multiple=16)
+        U, _ = device_blocking.init_factors_device(p, rank=6, scale=0.08)
+        init = PseudoRandomFactorInitializer(6, scale=0.08)
+        ids = np.asarray(p.id_of_user_row)
+        np.testing.assert_allclose(np.asarray(U), np.asarray(init(ids)),
+                                   rtol=1e-6)
