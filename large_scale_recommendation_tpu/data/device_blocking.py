@@ -160,6 +160,11 @@ class DeviceBlockedProblem:
     rows_per_block_v: int
     nnz: int
     max_pad_ratio: float
+    # the minibatch size icu/icv were baked for: "mean"-collision training
+    # MUST pass this same value as dsgd_train's ``minibatch`` (the scales
+    # are 1/occurrence within THESE chunks; a different kernel minibatch
+    # silently mis-scales colliding rows)
+    minibatch: int
 
     def holdout_rows(self, hu: jax.Array, hi: jax.Array):
         """Map holdout ids to rows with a seen-in-training mask.
@@ -323,6 +328,8 @@ def device_block_problem(
     k = num_blocks
     u = jnp.asarray(u, jnp.int32)
     i = jnp.asarray(i, jnp.int32)
+    if u.shape[0] == 0:
+        raise ValueError("device_block_problem: empty ratings input")
     # Fail fast on out-of-range ids: the scatters/gathers below would
     # otherwise silently drop/clamp them into a wrong-but-plausible layout
     # (e.g. raw 1-based MovieLens ids). One tiny scalar sync, once per fit.
@@ -368,6 +375,7 @@ def device_block_problem(
         id_of_user_row=id_of_ur, id_of_item_row=id_of_ir,
         num_blocks=k, rows_per_block_u=rpb_u, rows_per_block_v=rpb_v,
         nnz=nnz, max_pad_ratio=(k * k * bmax) / max(nnz, 1),
+        minibatch=mbm,
     )
 
 

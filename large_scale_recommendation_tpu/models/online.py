@@ -175,19 +175,27 @@ class OnlineMF:
     # -- training ----------------------------------------------------------
 
     def partial_fit(self, batch: Ratings,
-                    iterations: int | None = None) -> BatchUpdates:
+                    iterations: int | None = None,
+                    emit_updates: bool = True) -> BatchUpdates | None:
         """Apply one micro-batch; return the touched vectors (updates-only).
 
         ≙ one ``transform`` body of ``buildModelWithMap``
         (OnlineSpark.scala:181-231): 1-iteration update on the new ratings,
         merge into the model, emit only what changed.
+
+        ``emit_updates=False`` skips materializing the updates-only output
+        (returns ``None``): pure-ingest mode for callers that poll the model
+        instead (``self.users.array`` / ``self.items.array`` snapshots).
+        The per-batch device→host row pull is the dominant cost of a
+        high-rate stream on narrow host links; polling amortizes it.
         """
         cfg = self.config
         ru, ri, rv, rw = batch.to_numpy()
         real = rw > 0
         ru, ri, rv = ru[real], ri[real], rv[real]
         if len(ru) == 0:
-            return BatchUpdates([], [], rank=cfg.num_factors)
+            return (BatchUpdates([], [], rank=cfg.num_factors)
+                    if emit_updates else None)
 
         u_rows = self.users.ensure(ru)
         i_rows = self.items.ensure(ri)
@@ -210,6 +218,8 @@ class OnlineMF:
         self.users.array = U
         self.items.array = V
         self.step += 1
+        if not emit_updates:
+            return None
 
         # updates-only output: ONE bulk device gather of the touched rows
         # per side; per-row objects materialize lazily (BatchUpdates)
