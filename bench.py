@@ -130,6 +130,7 @@ def run_child() -> None:
             synthetic_like,
         )
 
+        extra["pipeline"] = "host"
         t0 = time.perf_counter()
         train, holdout = synthetic_like("ml-25m", nnz=nnz, rank=16,
                                         noise=0.1, seed=0, skew_lam=2.0)

@@ -166,6 +166,34 @@ class DeviceBlockedProblem:
     # silently mis-scales colliding rows)
     minibatch: int
 
+    def to_id_indices(self):
+        """Reference-shaped ``blocking.IdIndex`` pair for this layout.
+
+        Bridges the device pipeline into the standard ``MFModel`` surface
+        (predict / empirical_risk / factors export). Pulls only the two
+        id→row maps and omegas to host — a few hundred KB, once per fit.
+        """
+        from large_scale_recommendation_tpu.data.blocking import IdIndex
+
+        def side(row_of, omega, rpb):
+            rows = np.asarray(row_of).astype(np.int64)
+            om = np.asarray(omega)
+            # host-path semantics: only ids SEEN in training are known to
+            # the index (unseen ids score 0 in predict, are dropped from
+            # risk) — dense-vocab ids with zero occurrences stay unknown
+            all_ids = np.arange(rows.shape[0], dtype=np.int64)
+            present = om[rows] > 0
+            ids = np.full(om.shape[0], -1, np.int64)
+            ids[rows[present]] = all_ids[present]
+            return IdIndex(
+                ids=ids, num_blocks=self.num_blocks, rows_per_block=rpb,
+                omega=om, sorted_ids=all_ids[present],
+                sorted_rows=rows[present],
+            )
+
+        return (side(self.row_of_user, self.omega_u, self.rows_per_block_u),
+                side(self.row_of_item, self.omega_v, self.rows_per_block_v))
+
     def holdout_rows(self, hu: jax.Array, hi: jax.Array):
         """Map holdout ids to rows with a seen-in-training mask.
 

@@ -118,21 +118,6 @@ class DSGD:
         )
         U, V = self._init_factors(problem)
 
-        done = 0
-        if resume:
-            if checkpoint_manager is None:
-                raise ValueError("resume=True requires a checkpoint_manager")
-            latest = checkpoint_manager.latest_step()
-            if latest is not None:
-                ck = checkpoint_manager.restore(latest)
-                if ck["U"].shape != U.shape or ck["V"].shape != V.shape:
-                    raise ValueError(
-                        "checkpoint shape mismatch — resumed fit must use "
-                        "the same ratings, seed, rank and block count"
-                    )
-                U, V = jnp.asarray(ck["U"]), jnp.asarray(ck["V"])
-                done = latest
-
         if cfg.precompute_collisions and cfg.collision_mode == "mean":
             icu, icv = blocking.minibatch_inv_counts(
                 problem.ratings, cfg.minibatch_size)
@@ -148,6 +133,46 @@ class DSGD:
             jnp.asarray(problem.items.omega),
             *inv,
         )
+        U, V = self._train_segments(
+            U, V, args, k, "dsgd_segment",
+            checkpoint_manager, checkpoint_every, resume,
+        )
+        self.model = MFModel(U=U, V=V, users=problem.users, items=problem.items)
+        return self.model
+
+    def _train_segments(self, U, V, args, k, kind, checkpoint_manager,
+                        checkpoint_every, resume):
+        """Shared segment loop + checkpoint/resume for both blocking paths.
+
+        ``kind`` tags snapshots with the path that wrote them: host (fit)
+        and device (fit_device) blocking assign ids to DIFFERENT rows
+        (independently seeded permutations), so resuming across paths would
+        attach restored factor rows to the wrong ids — same-shape tables,
+        silently wrong model. The kind check turns that into an error.
+        """
+        cfg = self.config
+        done = 0
+        if resume:
+            if checkpoint_manager is None:
+                raise ValueError("resume=True requires a checkpoint_manager")
+            latest = checkpoint_manager.latest_step()
+            if latest is not None:
+                ck = checkpoint_manager.restore(latest)
+                ck_kind = ck.meta.get("kind")
+                if ck_kind != kind:
+                    raise ValueError(
+                        f"checkpoint kind {ck_kind!r} does not match this "
+                        f"fit path ({kind!r}) — host-blocked (fit) and "
+                        "device-blocked (fit_device) row layouts are "
+                        "incompatible"
+                    )
+                if ck["U"].shape != U.shape or ck["V"].shape != V.shape:
+                    raise ValueError(
+                        "checkpoint shape mismatch — resumed fit must use "
+                        "the same ratings, seed, rank and block count"
+                    )
+                U, V = jnp.asarray(ck["U"]), jnp.asarray(ck["V"])
+                done = latest
         segment = checkpoint_every or cfg.iterations
 
         # Module-level jitted train fn: stable function object + hashable
@@ -168,9 +193,62 @@ class DSGD:
             if checkpoint_manager is not None:
                 checkpoint_manager.save(
                     done, {"U": np.asarray(U), "V": np.asarray(V)},
-                    {"kind": "dsgd_segment", "iterations": cfg.iterations},
+                    {"kind": kind, "iterations": cfg.iterations},
                 )
-        self.model = MFModel(U=U, V=V, users=problem.users, items=problem.items)
+        return U, V
+
+    def fit_device(
+        self,
+        u,
+        i,
+        r,
+        num_users: int,
+        num_items: int,
+        num_blocks: int | None = None,
+        checkpoint_manager=None,
+        checkpoint_every: int | None = None,
+        resume: bool = False,
+    ) -> MFModel:
+        """Train via the on-device data pipeline (``data.device_blocking``).
+
+        Takes dense-id COO arrays (host numpy or device arrays, ids in
+        ``[0, num_users) × [0, num_items)`` — the contract of compacted
+        feature pipelines); blocking, collision scales, init and the whole
+        training loop run on chip. Only the id→row maps come back to host
+        (a few hundred KB) to build the standard ``MFModel`` surface.
+
+        Prefer this over ``fit`` when ids are already dense: the host never
+        materializes the k×k stratum expansion, and host→device traffic is
+        the raw COO triple instead of its ~3× padded layout. Arbitrary
+        external ids go through ``fit`` (host blocking). Init is always the
+        deterministic per-id form (``seed=None`` falls back to seed 0).
+
+        Same checkpoint/segmentation contract as ``fit``.
+        """
+        from large_scale_recommendation_tpu.data.device_blocking import (
+            device_block_problem,
+            init_factors_device,
+        )
+
+        cfg = self.config
+        k = num_blocks or cfg.num_blocks or 1
+        p = device_block_problem(
+            u, i, r, num_users, num_items, num_blocks=k,
+            minibatch_multiple=cfg.minibatch_size,
+            seed=cfg.seed if cfg.seed is not None else 0,
+            minibatch_sort=cfg.minibatch_sort,
+        )
+        U, V = init_factors_device(p, cfg.num_factors, scale=cfg.init_scale)
+
+        use_inv = cfg.precompute_collisions and cfg.collision_mode == "mean"
+        inv = (p.icu, p.icv) if use_inv else (None, None)
+        args = (p.su, p.si, p.sv, p.sw, p.omega_u, p.omega_v, *inv)
+        U, V = self._train_segments(
+            U, V, args, k, "dsgd_device_segment",
+            checkpoint_manager, checkpoint_every, resume,
+        )
+        users, items = p.to_id_indices()
+        self.model = MFModel(U=U, V=V, users=users, items=items)
         return self.model
 
     def _init_factors(self, problem: blocking.BlockedProblem):
