@@ -150,29 +150,16 @@ class DSGD:
         attach restored factor rows to the wrong ids — same-shape tables,
         silently wrong model. The kind check turns that into an error.
         """
+        from large_scale_recommendation_tpu.utils.checkpoint import (
+            restore_segment_state,
+        )
+
         cfg = self.config
         done = 0
         if resume:
             if checkpoint_manager is None:
                 raise ValueError("resume=True requires a checkpoint_manager")
-            latest = checkpoint_manager.latest_step()
-            if latest is not None:
-                ck = checkpoint_manager.restore(latest)
-                ck_kind = ck.meta.get("kind")
-                if ck_kind != kind:
-                    raise ValueError(
-                        f"checkpoint kind {ck_kind!r} does not match this "
-                        f"fit path ({kind!r}) — host-blocked (fit) and "
-                        "device-blocked (fit_device) row layouts are "
-                        "incompatible"
-                    )
-                if ck["U"].shape != U.shape or ck["V"].shape != V.shape:
-                    raise ValueError(
-                        "checkpoint shape mismatch — resumed fit must use "
-                        "the same ratings, seed, rank and block count"
-                    )
-                U, V = jnp.asarray(ck["U"]), jnp.asarray(ck["V"])
-                done = latest
+            U, V, done = restore_segment_state(checkpoint_manager, kind, U, V)
         segment = checkpoint_every or cfg.iterations
 
         # Module-level jitted train fn: stable function object + hashable

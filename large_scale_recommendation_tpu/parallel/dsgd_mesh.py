@@ -220,36 +220,108 @@ class MeshDSGD:
                        init_scale=cfg.init_scale)
         )._init_factors(problem)
 
+        if cfg.precompute_collisions and cfg.collision_mode == "mean":
+            icu, icv = blocking.minibatch_inv_counts(
+                problem.ratings, cfg.minibatch_size)
+            # same device-major [p, s, b] re-layout as the strata
+            inv_args = (icu.transpose(1, 0, 2), icv.transpose(1, 0, 2))
+        else:
+            inv_args = ()
+        U, V = self._train_segments(
+            U, V, (ru, ri, rv, rw), problem.users.omega,
+            problem.items.omega, inv_args, "mesh_dsgd_segment",
+            checkpoint_manager, checkpoint_every, resume,
+        )
+        self.model = MFModel(U=U, V=V, users=problem.users,
+                             items=problem.items)
+        return self.model
+
+    def fit_device(
+        self,
+        u,
+        i,
+        r,
+        num_users: int,
+        num_items: int,
+        checkpoint_manager=None,
+        checkpoint_every: int | None = None,
+        resume: bool = False,
+    ) -> MFModel:
+        """Train on the mesh via the on-device data pipeline.
+
+        Dense-id COO in (host or device arrays); blocking, the device-major
+        local re-layout, collision scales and factor init all run on chip
+        (``data.device_blocking`` + two transposes and a mod — blocks are
+        contiguous row ranges, so global→local is a subtraction). The host
+        never materializes the strata; the sharded arrays are produced by
+        ``device_put``-resharding the on-chip layout across the mesh.
+
+        Single-process meshes (one host's devices, or the virtual CPU
+        mesh). Multi-host runs use ``fit`` with ``parallel.distributed``
+        today (examples/distributed_demo.py); extending the on-device
+        pipeline across processes needs per-host blocking of shard-local
+        ratings + a global re-layout, which is future work.
+        """
+        from large_scale_recommendation_tpu.data.device_blocking import (
+            device_block_problem,
+            init_factors_device,
+        )
+
+        cfg = self.config
+        k = self.num_blocks
+        p = device_block_problem(
+            u, i, r, num_users, num_items, num_blocks=k,
+            minibatch_multiple=cfg.minibatch_size,
+            seed=cfg.seed if cfg.seed is not None else 0,
+            minibatch_sort=cfg.minibatch_sort,
+        )
+        # stratum-major [s, p, b] global rows → device-major [p, s, b]
+        # local rows (≙ device_major_local_strata, on device)
+        ru = (jnp.transpose(p.su, (1, 0, 2)) % p.rows_per_block_u)
+        ri = (jnp.transpose(p.si, (1, 0, 2)) % p.rows_per_block_v)
+        rv = jnp.transpose(p.sv, (1, 0, 2))
+        rw = jnp.transpose(p.sw, (1, 0, 2))
+        U, V = init_factors_device(p, cfg.num_factors, scale=cfg.init_scale)
+        if cfg.precompute_collisions and cfg.collision_mode == "mean":
+            inv_args = (jnp.transpose(p.icu, (1, 0, 2)),
+                        jnp.transpose(p.icv, (1, 0, 2)))
+        else:
+            inv_args = ()
+        U, V = self._train_segments(
+            U, V, (ru, ri, rv, rw), p.omega_u, p.omega_v, inv_args,
+            "mesh_dsgd_device_segment",
+            checkpoint_manager, checkpoint_every, resume,
+        )
+        users, items = p.to_id_indices()
+        self.model = MFModel(U=U, V=V, users=users, items=items)
+        return self.model
+
+    def _train_segments(self, U, V, strata, omega_u, omega_v, inv_args,
+                        kind, checkpoint_manager, checkpoint_every, resume):
+        """Shared mesh segment loop + checkpoint/resume for both blocking
+        paths. Same kind-tagging contract as the single-device driver
+        (models/dsgd.py ``_train_segments``): host-blocked and
+        device-blocked row layouts are permutation-incompatible, so
+        cross-path resume is refused."""
+        from large_scale_recommendation_tpu.utils.checkpoint import (
+            restore_segment_state,
+        )
+
+        cfg = self.config
+        k = self.num_blocks
         done = 0
         if resume:
             if checkpoint_manager is None:
                 raise ValueError("resume=True requires a checkpoint_manager")
-            latest = checkpoint_manager.latest_step()
-            if latest is not None:
-                ck = checkpoint_manager.restore(latest)
-                if (ck["U"].shape != U.shape or ck["V"].shape != V.shape):
-                    raise ValueError(
-                        "checkpoint shape mismatch — resumed fit must use "
-                        "the same ratings, seed, rank and mesh size"
-                    )
-                U, V = jnp.asarray(ck["U"]), jnp.asarray(ck["V"])
-                done = latest
+            U, V, done = restore_segment_state(checkpoint_manager, kind, U, V)
 
         shard = block_sharding(self.mesh)
         put = lambda x: jax.device_put(jnp.asarray(x), shard)
         U, V = put(U), put(V)
-        args = tuple(put(x) for x in (ru, ri, rv, rw))
-        ou = put(problem.users.omega)
-        ov = put(problem.items.omega)
-        with_inv = (cfg.precompute_collisions
-                    and cfg.collision_mode == "mean")
-        inv_args = ()
-        if with_inv:
-            icu, icv = blocking.minibatch_inv_counts(
-                problem.ratings, cfg.minibatch_size)
-            # same device-major [p, s, b] re-layout as the strata
-            inv_args = (put(icu.transpose(1, 0, 2)),
-                        put(icv.transpose(1, 0, 2)))
+        args = tuple(put(x) for x in strata)
+        ou, ov = put(omega_u), put(omega_v)
+        with_inv = bool(inv_args)
+        inv_args = tuple(put(x) for x in inv_args)
 
         segment = checkpoint_every or cfg.iterations
         while done < cfg.iterations:
@@ -273,9 +345,6 @@ class MeshDSGD:
                 if jax.process_index() == 0:
                     checkpoint_manager.save(
                         done, {"U": np.asarray(Uh), "V": np.asarray(Vh)},
-                        {"kind": "mesh_dsgd_segment",
-                         "iterations": cfg.iterations},
+                        {"kind": kind, "iterations": cfg.iterations},
                     )
-        self.model = MFModel(U=U, V=V, users=problem.users,
-                             items=problem.items)
-        return self.model
+        return U, V

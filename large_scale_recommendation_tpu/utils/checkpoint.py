@@ -115,6 +115,38 @@ class CheckpointManager:
         return Checkpoint(step=step, arrays=arrays, meta=meta)
 
 
+def restore_segment_state(manager: CheckpointManager, kind: str, U, V):
+    """Resume helper shared by the DSGD drivers (single-device and mesh):
+    restore the latest snapshot into ``(U, V, done)``.
+
+    Refuses snapshots written by a different fit path (``kind`` tag):
+    host-blocked (fit) and device-blocked (fit_device) row layouts are
+    permutation-incompatible despite equal table shapes, so a cross-path
+    resume would attach every restored row to the wrong id — an error here,
+    a silently wrong model otherwise. Also refuses shape mismatches.
+    Returns the inputs unchanged with ``done=0`` when no snapshot exists.
+    """
+    import jax.numpy as jnp
+
+    latest = manager.latest_step()
+    if latest is None:
+        return U, V, 0
+    ck = manager.restore(latest)
+    ck_kind = ck.meta.get("kind")
+    if ck_kind != kind:
+        raise ValueError(
+            f"checkpoint kind {ck_kind!r} does not match this fit path "
+            f"({kind!r}) — host-blocked (fit) and device-blocked "
+            "(fit_device) row layouts are incompatible"
+        )
+    if ck["U"].shape != tuple(U.shape) or ck["V"].shape != tuple(V.shape):
+        raise ValueError(
+            "checkpoint shape mismatch — resumed fit must use the same "
+            "ratings, seed, rank and block count"
+        )
+    return jnp.asarray(ck["U"]), jnp.asarray(ck["V"]), latest
+
+
 # -- model-level helpers ------------------------------------------------------
 
 
