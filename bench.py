@@ -181,9 +181,15 @@ def run_child() -> None:
         jax.block_until_ready(dr)
         extra["gen_wall_s"] = round(time.perf_counter() - t0, 1)
 
+        # BENCH_SORT=user|item: intra-minibatch locality ordering (pure
+        # gather/scatter-locality lever, math unchanged — docs/PERF.md)
+        sort = os.environ.get("BENCH_SORT") or None
+        if sort:
+            extra["minibatch_sort"] = sort
         t0 = time.perf_counter()
         p = device_block_problem(du, di, dr, nu, ni, num_blocks=blocks,
-                                 minibatch_multiple=mb, seed=0)
+                                 minibatch_multiple=mb, seed=0,
+                                 minibatch_sort=sort)
         jax.block_until_ready(p.su)
         extra["blocking_wall_s"] = round(time.perf_counter() - t0, 1)
         extra["max_pad_ratio"] = round(p.max_pad_ratio, 3)
@@ -295,6 +301,8 @@ def _extra_lines(extra: dict, rank: int, jax, h2d_mbps: float) -> None:
     Transfer budget: every input below is sized so its host↔device traffic
     clears the measured link bandwidth comfortably inside the attempt
     window (the ALS volume additionally steps down on narrow links)."""
+    import jax.numpy as jnp
+
     from large_scale_recommendation_tpu.core.generators import (
         SyntheticMFGenerator,
     )
@@ -347,6 +355,42 @@ def _extra_lines(extra: dict, rank: int, jax, h2d_mbps: float) -> None:
         rows = (anu + ani) * iters
         extra[f"als_rank{als_rank}_rows_per_s"] = round(rows / wall, 1)
         extra[f"als_rank{als_rank}_wall_s"] = round(wall, 2)
+
+        if als_rank == rank:
+            # iALS (≙ ALS.trainImplicit; the BASELINE Criteo-implicit
+            # config): reuse the SAME device-resident buckets — the
+            # implicit gram/b weights are jitted transforms of the explicit
+            # ones (wi' = α·v, va' = w + α·v), zero extra link traffic —
+            # plus one full-table VᵀV matmul per half-step.
+            alpha = jnp.float32(1.0)
+
+            @jax.jit
+            def to_implicit(rows3, oidx3, vals3, w3, sc3):
+                return (rows3, oidx3, w3 + alpha * vals3,
+                        alpha * vals3, sc3)
+
+            iprep_u = tuple(to_implicit(*b) for b in prep_u)
+            iprep_v = tuple(to_implicit(*b) for b in prep_v)
+
+            @jax.jit
+            def full_gram(F):
+                return jnp.einsum("nk,nl->kl", F, F,
+                                  preferred_element_type=jnp.float32)
+
+            def irounds(V, n):
+                for _ in range(n):
+                    U = als_ops.solve_side(V, iprep_u, anu, 0.01,
+                                           G=full_gram(V))
+                    V = als_ops.solve_side(U, iprep_v, ani, 0.01,
+                                           G=full_gram(U))
+                return U, V
+
+            jax.block_until_ready(irounds(V, 1))
+            t0 = time.perf_counter()
+            jax.block_until_ready(irounds(V, iters))
+            wall = time.perf_counter() - t0
+            extra[f"als_rank{als_rank}_implicit_rows_per_s"] = round(
+                (anu + ani) * iters / wall, 1)
         del prep_u, prep_v, U, V
     extra["als_nnz"] = als_nnz
 
