@@ -31,7 +31,8 @@ link is too slow to carry their inputs inside the attempt window.
 Env knobs: BENCH_NNZ, BENCH_RANK, BENCH_ITERS (max sweeps), BENCH_MB,
 BENCH_BLOCKS, BENCH_RMSE_TARGET, BENCH_TIMEOUT (per-attempt seconds),
 BENCH_SKIP_EXTRAS (=1 → DSGD line only), BENCH_MIN_MBPS (extras gate),
-BENCH_HOST_PIPELINE (=1 → round-2 host-side gen+blocking path).
+BENCH_HOST_PIPELINE (=1 → round-2 host-side gen+blocking path),
+BENCH_SORT (=user|item → intra-minibatch locality ordering).
 """
 
 from __future__ import annotations
@@ -362,15 +363,8 @@ def _extra_lines(extra: dict, rank: int, jax, h2d_mbps: float) -> None:
             # implicit gram/b weights are jitted transforms of the explicit
             # ones (wi' = α·v, va' = w + α·v), zero extra link traffic —
             # plus one full-table VᵀV matmul per half-step.
-            alpha = jnp.float32(1.0)
-
-            @jax.jit
-            def to_implicit(rows3, oidx3, vals3, w3, sc3):
-                return (rows3, oidx3, w3 + alpha * vals3,
-                        alpha * vals3, sc3)
-
-            iprep_u = tuple(to_implicit(*b) for b in prep_u)
-            iprep_v = tuple(to_implicit(*b) for b in prep_v)
+            iprep_u = als_ops.implicit_prepared(prep_u, 1.0)
+            iprep_v = als_ops.implicit_prepared(prep_v, 1.0)
 
             @jax.jit
             def full_gram(F):
@@ -391,6 +385,7 @@ def _extra_lines(extra: dict, rank: int, jax, h2d_mbps: float) -> None:
             wall = time.perf_counter() - t0
             extra[f"als_rank{als_rank}_implicit_rows_per_s"] = round(
                 (anu + ani) * iters / wall, 1)
+            del iprep_u, iprep_v  # free before the HBM-hungry rank-256 pass
         del prep_u, prep_v, U, V
     extra["als_nnz"] = als_nnz
 

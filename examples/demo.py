@@ -94,6 +94,24 @@ def run_ps_combo():
     return solver
 
 
+def run_batch_device():
+    print("== batch DSGD via the on-device pipeline (fit_device) ==")
+    from large_scale_recommendation_tpu.models.dsgd import DSGD, DSGDConfig
+
+    gen = SyntheticMFGenerator(num_users=50, num_items=40, rank=2,
+                               noise=0.05, seed=11)
+    train, test = gen.generate(4000), gen.generate(400)
+    ru, ri, rv, _ = train.to_numpy()
+    solver = DSGD(DSGDConfig(num_factors=RANK, lambda_=0.05, iterations=12,
+                             learning_rate=0.2, lr_schedule="constant",
+                             minibatch_size=64, seed=0, init_scale=0.1))
+    # dense-id COO straight in; blocking/init/training all on device
+    model = solver.fit_device(ru, ri, rv, 50, 40, num_blocks=2)
+    print(f"fit_device: holdout RMSE {model.rmse(test):.3f} "
+          f"(noise floor 0.05)")
+    return model
+
+
 def main():
     which = sys.argv[1] if len(sys.argv) > 1 else "both"
     if which in ("online", "both"):
@@ -106,6 +124,9 @@ def main():
               f"{m.online.items.num_rows} items\n")
     if which in ("ps", "both"):
         run_ps_combo()
+        print()
+    if which in ("batch", "both"):
+        run_batch_device()
 
 
 if __name__ == "__main__":

@@ -222,6 +222,27 @@ def prepare_side(plan: SolvePlan, omega: np.ndarray | None, k: int,
     )
 
 
+@jax.jit
+def _implicit_bucket(rows3, oidx3, vals3, w3, sc3, alpha):
+    # explicit slots: vals3 = masked rating (the b-weight), w3 = mask (the
+    # gram weight) → implicit: b-weight = masked confidence c = w + α·v,
+    # gram weight = c − 1 = α·v (vals3 is pre-masked, so α·v is masked too)
+    return rows3, oidx3, w3 + alpha * vals3, alpha * vals3, sc3
+
+
+def implicit_prepared(prepared, alpha: float):
+    """Device-side iALS re-weighting of an EXPLICIT ``prepare_side`` result.
+
+    Same math as ``prepare_side(..., implicit_alpha=α)`` but as jitted
+    transforms of buckets already on device — no host rebuild, no new
+    host→device transfer. The caller supplies the shared VᵀV gram via
+    ``solve_side(..., G=...)`` as usual. The tuple-slot knowledge lives
+    here, next to ``_chunked_bucket``, on purpose.
+    """
+    a = jnp.float32(alpha)
+    return tuple(_implicit_bucket(*b, a) for b in prepared)
+
+
 def solve_side(
     factors_other: jax.Array,
     prepared,

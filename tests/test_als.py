@@ -255,6 +255,26 @@ class TestImplicitALS:
             want[r] = np.linalg.solve(A, b)
         np.testing.assert_allclose(got, want, rtol=3e-3, atol=3e-4)
 
+    def test_implicit_prepared_matches_host_rebuild(self):
+        """The device-side re-weighting of explicit buckets
+        (``implicit_prepared``) must equal ``prepare_side(implicit_alpha)``
+        bucket-for-bucket — the bench's iALS line depends on it."""
+        rng = np.random.default_rng(3)
+        k, n_rows, n_other, e = 4, 30, 25, 400
+        out_rows = rng.integers(0, n_rows, e)
+        other = rng.integers(0, n_other, e)
+        strength = rng.exponential(1.0, e).astype(np.float32)
+        alpha = 7.0
+        plan = als_ops.build_solve_plan(out_rows, other, strength, n_rows)
+        explicit = als_ops.prepare_side(plan, None, k)
+        via_device = als_ops.implicit_prepared(explicit, alpha)
+        via_host = als_ops.prepare_side(plan, None, k, implicit_alpha=alpha)
+        assert len(via_device) == len(via_host)
+        for bd, bh in zip(via_device, via_host):
+            for ad, ah in zip(bd, bh):
+                np.testing.assert_allclose(np.asarray(ad), np.asarray(ah),
+                                           rtol=1e-6)
+
     def test_implicit_ranks_positives_above_random(self):
         """Planted propensity model: held-out POSITIVE pairs must score far
         above random pairs after an implicit fit."""

@@ -60,6 +60,55 @@ class TestTargetScaleBlocking:
 
 
 @pytest.mark.slow
+class TestTargetScaleDevicePipeline:
+    def test_device_blocking_ml25m_shape(self):
+        """The on-device pipeline at the full north-star scale (the bench's
+        exact DSGD setup): bounded padding, full stratum arrays, and the
+        one-readback contract."""
+        from large_scale_recommendation_tpu.data import device_blocking
+
+        t0 = time.perf_counter()
+        (u, i, r), _, (nu, ni) = device_blocking.synthetic_like_device(
+            "ml-25m", rank=16, noise=0.1, seed=0, skew_lam=2.0)
+        p = device_blocking.device_block_problem(
+            u, i, r, nu, ni, num_blocks=8, minibatch_multiple=32768)
+        np.asarray(p.sw)  # force execution
+        wall = time.perf_counter() - t0
+        assert p.nnz > 23_000_000
+        assert p.su.shape[:2] == (8, 8)
+        assert p.max_pad_ratio < 1.25
+        print(f"\n# device pipeline gen+block wall: {wall:.1f}s "
+              f"pad_ratio={p.max_pad_ratio:.3f}")
+
+
+@pytest.mark.slow
+class TestTargetScaleALSPlans:
+    def test_bucketed_plans_at_10m_nnz(self):
+        """ALS solve-plan build at 10M nnz (toward the Criteo-implicit
+        BASELINE config): bounded pad overhead, bounded bucket count
+        (power-law data → O(log max_count) pad classes)."""
+        from large_scale_recommendation_tpu.core.generators import (
+            SyntheticMFGenerator,
+        )
+        from large_scale_recommendation_tpu.ops import als as als_ops
+
+        gen = SyntheticMFGenerator(num_users=162_541, num_items=59_047,
+                                   rank=16, noise=0.1, seed=4, skew_lam=2.0)
+        ratings = gen.generate(10_000_000)
+        ru, ri, rv, _ = ratings.to_numpy()
+        t0 = time.perf_counter()
+        up = als_ops.build_solve_plan(ru, ri, rv, 162_541)
+        ip = als_ops.build_solve_plan(ri, ru, rv, 59_047)
+        wall = time.perf_counter() - t0
+        for plan, nnz in ((up, 10_000_000), (ip, 10_000_000)):
+            assert len(plan.buckets) < 24  # O(log max_count) pad classes
+            assert plan.padded_nnz < nnz * 2.2  # pow2 padding bound
+        print(f"\n# ALS plans at 10M nnz: {wall:.1f}s, "
+              f"user pad {up.padded_nnz / 1e7:.2f}x, "
+              f"item pad {ip.padded_nnz / 1e7:.2f}x")
+
+
+@pytest.mark.slow
 class TestRealFormatEndToEnd:
     def test_ml25m_format_csv_parse_block_fit(self, tmp_path):
         """The real-dataset path executed end-to-end at realistic volume:
