@@ -483,9 +483,41 @@ def _looks_transient(tail: str) -> bool:
     return any(n in tail for n in needles)
 
 
+def _device_preprobe(timeout: float) -> tuple[bool, str]:
+    """Cheap child that only lists devices on the default backend.
+
+    A dead/wedged TPU tunnel makes ``jax.devices()`` hang FOREVER (observed:
+    the relay process dies and never recovers within a session). Without
+    this probe the first real attempt burns its whole BENCH_TIMEOUT window
+    discovering that; with it, a dead backend costs ~3 minutes before the
+    CPU fallback.
+    """
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; print(jax.devices()[0])"],
+            capture_output=True, text=True, timeout=timeout,
+            env=dict(os.environ),
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"device probe hung for {timeout}s (dead tunnel?)"
+    if proc.returncode != 0:
+        return False, f"device probe rc={proc.returncode}: {proc.stderr[-500:]}"
+    return True, proc.stdout.strip()
+
+
 def main() -> None:
     per_attempt = float(os.environ.get("BENCH_TIMEOUT", 2400))
     errors: list[str] = []
+
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 180))
+    ok, probe_msg = _device_preprobe(probe_timeout)
+    if not ok:
+        print(f"# device pre-probe failed: {probe_msg}", file=sys.stderr)
+        errors.append(f"pre-probe: {probe_msg}")
+        _cpu_fallback(per_attempt, errors)
+        return
+    print(f"# device pre-probe OK: {probe_msg}", file=sys.stderr)
 
     result, tail, hung = _attempt({}, per_attempt)
     if result is not None:
@@ -505,8 +537,12 @@ def main() -> None:
         errors.append(f"attempt 2: {tail}")
         print(f"# bench attempt 2 failed: {tail[-300:]}", file=sys.stderr)
 
-    # CPU fallback on a reduced workload — a real (if slower) number beats
-    # no number; the error field records the per-attempt failures.
+    _cpu_fallback(per_attempt, errors)
+
+
+def _cpu_fallback(per_attempt: float, errors: list[str]) -> None:
+    """CPU fallback on a reduced workload — a real (if slower) number beats
+    no number; the error field records the per-attempt failures."""
     cpu_env = {
         "JAX_PLATFORMS": "cpu",
         "BENCH_FORCE_CPU": "1",
