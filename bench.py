@@ -273,15 +273,8 @@ def run_child() -> None:
     baseline = _numpy_sequential_baseline(*base_sample, rank)
     extra["numpy_seq_baseline_ratings_per_s"] = round(baseline, 1)
 
-    min_mbps = float(os.environ.get("BENCH_MIN_MBPS", "2"))
     if not skip_extras:
-        if h2d_mbps >= min_mbps:
-            _extra_lines(extra, rank, jax, h2d_mbps)
-        else:
-            extra["extras_skipped"] = (
-                f"h2d {h2d_mbps:.1f} MB/s < {min_mbps} MB/s — the ALS/"
-                "online/PS inputs would not fit through the link in the "
-                "attempt window")
+        _extra_lines(extra, rank, jax, h2d_mbps)
 
     result = {
         "metric": (f"ratings/sec/chip (DSGD, ML-25M-shaped skewed, "
@@ -297,11 +290,12 @@ def run_child() -> None:
 
 
 def _extra_lines(extra: dict, rank: int, jax, h2d_mbps: float) -> None:
-    """ALS (rank 128 + 256), online-stream, and PS-mode lines.
+    """ALS (rank 128 + 256 + implicit), online-stream, and PS-mode lines.
 
-    Transfer budget: every input below is sized so its host↔device traffic
-    clears the measured link bandwidth comfortably inside the attempt
-    window (the ALS volume additionally steps down on narrow links)."""
+    The ALS inputs are generated AND plan-built on device
+    (``device_prepare_side``) — no link traffic at all; the online and
+    PS lines stream real host data by design, so they gate on the
+    measured link bandwidth."""
     import jax.numpy as jnp
 
     from large_scale_recommendation_tpu.core.generators import (
@@ -319,28 +313,25 @@ def _extra_lines(extra: dict, rank: int, jax, h2d_mbps: float) -> None:
     )
     from large_scale_recommendation_tpu.ops import als as als_ops
 
-    # ---- ALS: bucketed-matmul normal equations ---------------------------
-    # Ratings are generated on device; the COO triple comes back once for
-    # the host plan build (d2h ~12 B/rating), and the padded plans go down
-    # once per rank (h2d ~2×13 B/rating·pad) — the dominant extras traffic.
-    als_nnz = int(os.environ.get(
-        "BENCH_ALS_NNZ", 2_000_000 if h2d_mbps >= 8 else 1_000_000))
+    # ---- ALS: bucketed-matmul normal equations, all on device ------------
+    als_nnz = int(os.environ.get("BENCH_ALS_NNZ", 2_000_000))
     (au, ai, ar), _, (anu, ani) = synthetic_like_device(
         "ml-25m", nnz=int(als_nnz / 0.95) + 1, rank=16, noise=0.1, seed=1,
         skew_lam=2.0)
-    u_rows = np.asarray(au).astype(np.int64)
-    i_rows = np.asarray(ai).astype(np.int64)
-    vals = np.asarray(ar)
-    user_plan = als_ops.build_solve_plan(u_rows, i_rows, vals, anu)
-    item_plan = als_ops.build_solve_plan(i_rows, u_rows, vals, ani)
+    t0 = time.perf_counter()
+    # one prepared set per orientation serves both ranks (chunk geometry
+    # sized for the larger) — built on chip, ≤33-int readback each
+    prep_u = als_ops.device_prepare_side(au, ai, ar, anu,
+                                         rank_for_chunking=256)
+    prep_v = als_ops.device_prepare_side(ai, au, ar, ani,
+                                         rank_for_chunking=256)
+    jax.block_until_ready((prep_u, prep_v))
+    extra["als_plan_wall_s"] = round(time.perf_counter() - t0, 2)
     for als_rank, iters in ((rank, 2), (256, 1)):
         # λ scaled to the stand-in's signal magnitude (see run_child note);
         # "direct" mode ≙ MLlib ALS.train's regParam semantics
         init = PseudoRandomFactorInitializer(als_rank, scale=0.1)
         V = init(np.arange(ani, dtype=np.int32))
-        prep_u = als_ops.prepare_side(user_plan, None, als_rank)
-        prep_v = als_ops.prepare_side(item_plan, None, als_rank)
-        jax.block_until_ready([b[0] for b in prep_u])
 
         def rounds(V, n):
             for _ in range(n):
@@ -386,8 +377,18 @@ def _extra_lines(extra: dict, rank: int, jax, h2d_mbps: float) -> None:
             extra[f"als_rank{als_rank}_implicit_rows_per_s"] = round(
                 (anu + ani) * iters / wall, 1)
             del iprep_u, iprep_v  # free before the HBM-hungry rank-256 pass
-        del prep_u, prep_v, U, V
+        del U, V
+    del prep_u, prep_v
     extra["als_nnz"] = als_nnz
+
+    # ---- link-bound lines: online stream + PS mode -----------------------
+    min_mbps = float(os.environ.get("BENCH_MIN_MBPS", "2"))
+    if h2d_mbps < min_mbps:
+        extra["extras_skipped"] = (
+            f"online/PS lines skipped: h2d {h2d_mbps:.1f} MB/s < "
+            f"{min_mbps} MB/s — their host-streamed inputs would not fit "
+            "through the link in the attempt window")
+        return
 
     # ---- online stream: Netflix-shaped micro-batches ---------------------
     # Ingest mode (emit_updates=False): the sustained-throughput number.
@@ -512,12 +513,16 @@ def main() -> None:
 
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 180))
     ok, probe_msg = _device_preprobe(probe_timeout)
-    if not ok:
+    if not ok and "hung" in probe_msg:
+        # Only a HANG forfeits the TPU attempts (a dead tunnel never heals
+        # within a session — observed). A fast non-zero probe exit may be a
+        # transient init failure: fall through to the normal attempt+retry
+        # path, which handles exactly that.
         print(f"# device pre-probe failed: {probe_msg}", file=sys.stderr)
         errors.append(f"pre-probe: {probe_msg}")
         _cpu_fallback(per_attempt, errors)
         return
-    print(f"# device pre-probe OK: {probe_msg}", file=sys.stderr)
+    print(f"# device pre-probe: {probe_msg}", file=sys.stderr)
 
     result, tail, hung = _attempt({}, per_attempt)
     if result is not None:

@@ -47,6 +47,7 @@ exactly zero without masking.
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 
 import numpy as np
 
@@ -177,27 +178,35 @@ def _chunk_geometry(nb: int, pad: int, k: int,
 
 
 def _chunked_bucket(bucket, omega, num_rows, k, target_bytes=256 << 20):
-    """Host-side: reshape one bucket into [n_chunks, rc, pad] with pow2 rc
-    (bounded compile variants); chunk-padding rows point at the dummy row
-    ``num_rows`` with weight 0."""
+    """Reshape one bucket into device-resident [n_chunks, rc, pad] arrays
+    with pow2 rc (bounded compile variants); chunk-padding rows point at the
+    dummy row ``num_rows`` with weight 0. The ONE copy of the chunk-layout
+    contract — both the host plan path (``prepare_side``) and the device
+    plan path (``device_prepare_side``) go through it; inputs may be numpy
+    or device arrays. ``omega`` must already be a float32 jnp array (or
+    None)."""
     rows, oidx, vals, w = bucket
     nb, pad = oidx.shape
     rc, n_chunks, padded_nb = _chunk_geometry(nb, pad, k, target_bytes)
+    rows = jnp.asarray(rows, jnp.int32)
+    oidx = jnp.asarray(oidx, jnp.int32)
+    vals = jnp.asarray(vals, jnp.float32)
+    w = jnp.asarray(w, jnp.float32)
     if padded_nb != nb:
         extra = padded_nb - nb
-        rows = np.concatenate([rows,
-                               np.full(extra, num_rows, np.int32)])
-        oidx = np.concatenate([oidx, np.zeros((extra, pad), np.int32)])
-        vals = np.concatenate([vals, np.zeros((extra, pad), np.float32)])
-        w = np.concatenate([w, np.zeros((extra, pad), np.float32)])
-    scale = (omega[np.minimum(rows, num_rows - 1)]
-             if omega is not None else np.ones(padded_nb, np.float32))
+        rows = jnp.concatenate([rows,
+                                jnp.full((extra,), num_rows, jnp.int32)])
+        oidx = jnp.concatenate([oidx, jnp.zeros((extra, pad), jnp.int32)])
+        vals = jnp.concatenate([vals, jnp.zeros((extra, pad), jnp.float32)])
+        w = jnp.concatenate([w, jnp.zeros((extra, pad), jnp.float32)])
+    scale = (omega[jnp.minimum(rows, num_rows - 1)]
+             if omega is not None else jnp.ones(padded_nb, jnp.float32))
     return (
-        jnp.asarray(rows.reshape(n_chunks, rc)),
-        jnp.asarray(oidx.reshape(n_chunks, rc, pad)),
-        jnp.asarray(vals.reshape(n_chunks, rc, pad)),
-        jnp.asarray(w.reshape(n_chunks, rc, pad)),
-        jnp.asarray(scale.reshape(n_chunks, rc).astype(np.float32)),
+        rows.reshape(n_chunks, rc),
+        oidx.reshape(n_chunks, rc, pad),
+        vals.reshape(n_chunks, rc, pad),
+        w.reshape(n_chunks, rc, pad),
+        scale.reshape(n_chunks, rc),
     )
 
 
@@ -217,9 +226,99 @@ def prepare_side(plan: SolvePlan, omega: np.ndarray | None, k: int,
              (w * a * vals).astype(np.float32))
             for (rows, oidx, vals, w) in buckets
         )
+    om = None if omega is None else jnp.asarray(omega, jnp.float32)
     return tuple(
-        _chunked_bucket(b, omega, plan.num_rows, k) for b in buckets
+        _chunked_bucket(b, om, plan.num_rows, k) for b in buckets
     )
+
+
+@partial(jax.jit, static_argnames=("num_out_rows", "n_pow2"))
+def _device_plan_keys(out_rows, num_out_rows: int, n_pow2: int):
+    """Per-row counts, pad classes, and the two sort orders the device plan
+    build needs. Returns device arrays + the tiny per-class row-count vector
+    that gets read back to fix static shapes."""
+    counts = jnp.zeros(num_out_rows, jnp.int32).at[out_rows].add(1)
+    pow2s = jnp.int32(2) ** jnp.arange(n_pow2, dtype=jnp.int32)
+    # smallest pow2 ≥ count, exact integer logic (no float log2 edge cases);
+    # empty rows get a trailing pseudo-class that is sliced off
+    pclass = jnp.searchsorted(pow2s, counts, side="left").astype(jnp.int32)
+    pclass = jnp.where(counts == 0, n_pow2, pclass)
+    row_order = jnp.argsort(pclass, stable=True)  # rows grouped by class
+    rows_per_class = jnp.zeros(n_pow2 + 1, jnp.int32).at[pclass].add(1)
+    entry_order = jnp.argsort(out_rows, stable=True)  # row-contiguous runs
+    starts = jnp.cumsum(counts) - counts
+    return counts, row_order, rows_per_class, entry_order, starts
+
+
+@partial(jax.jit, static_argnames=("pad", "offset", "nb"))
+def _device_bucket(row_order, counts, starts, o_sorted, v_sorted,
+                   pad: int, offset: int, nb: int):
+    """Materialize one pad-class bucket [nb, pad] on device (≙ the
+    where/clip gather in build_solve_plan, host path)."""
+    rows = jax.lax.dynamic_slice(row_order, (offset,), (nb,))
+    pos = starts[rows][:, None] + jnp.arange(pad, dtype=jnp.int32)[None, :]
+    valid = jnp.arange(pad, dtype=jnp.int32)[None, :] < counts[rows][:, None]
+    e = o_sorted.shape[0]
+    pos = jnp.clip(pos, 0, max(e - 1, 0))
+    oidx = jnp.where(valid, o_sorted[pos], 0).astype(jnp.int32)
+    vals = jnp.where(valid, v_sorted[pos], 0.0).astype(jnp.float32)
+    w = valid.astype(jnp.float32)
+    return rows.astype(jnp.int32), oidx, vals, w
+
+
+def device_prepare_side(
+    out_rows,
+    other_rows,
+    values,
+    num_out_rows: int,
+    omega=None,
+    min_pad: int = 8,
+    target_bytes: int = 256 << 20,
+    rank_for_chunking: int | None = None,
+):
+    """Build one orientation's chunked solve buckets ENTIRELY on device.
+
+    Device-resident equivalent of ``build_solve_plan`` + ``prepare_side``:
+    sort, bucket, pad and chunk as XLA ops; the only host↔device traffic is
+    a ≤33-int per-class row-count readback (static shapes for the jitted
+    bucket builds). Input arrays may be device or host; dense rows in
+    ``[0, num_out_rows)``. Returns prepared chunked buckets consumable by
+    ``solve_side`` (and by ``implicit_prepared``).
+
+    ``rank_for_chunking`` sets the chunk-geometry rank (defaults to a
+    conservative 256 so one prepared layout serves any rank ≤ that without
+    exceeding ``target_bytes``).
+    """
+    out_rows = jnp.asarray(out_rows, jnp.int32)
+    other_rows = jnp.asarray(other_rows, jnp.int32)
+    values = jnp.asarray(values, jnp.float32)
+    k = rank_for_chunking or 256
+    n_pow2 = 31
+    counts, row_order, rows_per_class, entry_order, starts = \
+        _device_plan_keys(out_rows, num_out_rows, n_pow2)
+    o_sorted = other_rows[entry_order]
+    v_sorted = values[entry_order]
+
+    rpc = np.asarray(rows_per_class)  # the tiny readback
+    offsets = np.concatenate([[0], np.cumsum(rpc)])
+    # classes whose pow2 ≤ min_pad share one min_pad bucket (they are
+    # adjacent in row_order, so it's a single contiguous slice) — same
+    # grouping as the host path's unique-pad buckets
+    assert min_pad & (min_pad - 1) == 0, "min_pad must be a power of 2"
+    m = min_pad.bit_length() - 1
+    groups = [(min_pad, 0, int(rpc[: m + 1].sum()))]
+    groups += [(1 << cls, int(offsets[cls]), int(rpc[cls]))
+               for cls in range(m + 1, n_pow2)]
+    om = None if omega is None else jnp.asarray(omega, jnp.float32)
+    prepared = []
+    for pad, offset, nb in groups:  # trailing class (empty rows) excluded
+        if nb == 0:
+            continue
+        bucket = _device_bucket(row_order, counts, starts, o_sorted,
+                                v_sorted, pad, offset, nb)
+        prepared.append(_chunked_bucket(bucket, om, num_out_rows, k,
+                                        target_bytes))
+    return tuple(prepared)
 
 
 @jax.jit

@@ -226,6 +226,79 @@ class TestSolvePlan:
         np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
 
 
+class TestDevicePreparedPlans:
+    """On-device plan build (``device_prepare_side``) must solve to the
+    same per-row answers as the host build — bucket organization is
+    allowed to differ, the [num_rows, k] solve output is not."""
+
+    def _problem(self, seed=0, e=2000, n_rows=60, n_other=45):
+        rng = np.random.default_rng(seed)
+        out_rows = rng.integers(0, n_rows, e)
+        # skewed: some rows get many ratings → multiple pad classes
+        hot = rng.integers(0, 5, e // 2)
+        out_rows[: e // 2] = hot
+        other = rng.integers(0, n_other, e)
+        vals = rng.normal(0, 1, e).astype(np.float32)
+        F = rng.normal(size=(n_other, 6)).astype(np.float32)
+        return out_rows, other, vals, F, n_rows
+
+    def test_matches_host_plan_solve(self):
+        out_rows, other, vals, F, n_rows = self._problem()
+        k = F.shape[1]
+        host_plan = als_ops.build_solve_plan(out_rows, other, vals, n_rows)
+        host_prep = als_ops.prepare_side(host_plan, None, k)
+        want = np.asarray(als_ops.solve_side(jnp.asarray(F), host_prep,
+                                             n_rows, 0.1))
+        dev_prep = als_ops.device_prepare_side(out_rows, other, vals, n_rows)
+        got = np.asarray(als_ops.solve_side(jnp.asarray(F), dev_prep,
+                                            n_rows, 0.1))
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+    def test_matches_host_with_omega_scaling(self):
+        out_rows, other, vals, F, n_rows = self._problem(seed=1)
+        k = F.shape[1]
+        omega = np.bincount(out_rows, minlength=n_rows).astype(np.float32)
+        host_plan = als_ops.build_solve_plan(out_rows, other, vals, n_rows)
+        host_prep = als_ops.prepare_side(host_plan, omega, k)
+        want = np.asarray(als_ops.solve_side(jnp.asarray(F), host_prep,
+                                             n_rows, 0.1))
+        dev_prep = als_ops.device_prepare_side(out_rows, other, vals,
+                                               n_rows, omega=omega)
+        got = np.asarray(als_ops.solve_side(jnp.asarray(F), dev_prep,
+                                            n_rows, 0.1))
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+    def test_composes_with_implicit_reweighting(self):
+        out_rows, other, vals, F, n_rows = self._problem(seed=2)
+        k = F.shape[1]
+        vals = np.abs(vals)  # interaction strengths
+        alpha = 4.0
+        host_plan = als_ops.build_solve_plan(out_rows, other, vals, n_rows)
+        host_prep = als_ops.prepare_side(host_plan, None, k,
+                                         implicit_alpha=alpha)
+        G = jnp.asarray(F.T @ F)
+        want = np.asarray(als_ops.solve_side(jnp.asarray(F), host_prep,
+                                             n_rows, 0.1, G))
+        dev_prep = als_ops.implicit_prepared(
+            als_ops.device_prepare_side(out_rows, other, vals, n_rows),
+            alpha)
+        got = np.asarray(als_ops.solve_side(jnp.asarray(F), dev_prep,
+                                            n_rows, 0.1, G))
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+    def test_empty_rows_solve_to_zero(self):
+        # rows with no ratings must come out exactly zero (λI u = 0)
+        out_rows = np.array([0, 0, 2], np.int64)
+        other = np.array([0, 1, 1], np.int64)
+        vals = np.ones(3, np.float32)
+        F = np.random.default_rng(0).normal(size=(3, 4)).astype(np.float32)
+        prep = als_ops.device_prepare_side(out_rows, other, vals, 5)
+        out = np.asarray(als_ops.solve_side(jnp.asarray(F), prep, 5, 0.1))
+        assert (out[1] == 0).all() and (out[3] == 0).all() \
+            and (out[4] == 0).all()
+        assert np.abs(out[0]).sum() > 0 and np.abs(out[2]).sum() > 0
+
+
 class TestImplicitALS:
     """iALS (Hu/Koren/Volinsky; ≙ MLlib ALS.trainImplicit — the BASELINE
     Criteo-implicit configuration)."""
