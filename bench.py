@@ -32,7 +32,9 @@ Env knobs: BENCH_NNZ, BENCH_RANK, BENCH_ITERS (max sweeps), BENCH_MB,
 BENCH_BLOCKS, BENCH_RMSE_TARGET, BENCH_TIMEOUT (per-attempt seconds),
 BENCH_SKIP_EXTRAS (=1 → DSGD line only), BENCH_MIN_MBPS (extras gate),
 BENCH_HOST_PIPELINE (=1 → round-2 host-side gen+blocking path),
-BENCH_SORT (=user|item → intra-minibatch locality ordering).
+BENCH_SORT (=user|item → intra-minibatch locality ordering),
+BENCH_AUTOTUNE (default 1 → A/B the kernel minibatch vs its 2× on one
+timed sweep each, same blocked layout, before the timed run).
 """
 
 from __future__ import annotations
@@ -187,16 +189,46 @@ def run_child() -> None:
         sort = os.environ.get("BENCH_SORT") or None
         if sort:
             extra["minibatch_sort"] = sort
+        # BENCH_AUTOTUNE=1 (default): A/B the kernel minibatch against one
+        # 2× candidate on a single timed sweep from the SAME blocked layout
+        # (pad to the larger candidate; both divide it)
+        autotune = os.environ.get("BENCH_AUTOTUNE", "1") == "1"
+        mb_cands = sorted({mb, mb * 2}) if autotune else [mb]
         t0 = time.perf_counter()
         p = device_block_problem(du, di, dr, nu, ni, num_blocks=blocks,
-                                 minibatch_multiple=mb, seed=0,
+                                 minibatch_multiple=max(mb_cands), seed=0,
                                  minibatch_sort=sort)
         jax.block_until_ready(p.su)
         extra["blocking_wall_s"] = round(time.perf_counter() - t0, 1)
         extra["max_pad_ratio"] = round(p.max_pad_ratio, 3)
 
         U, V = init_factors_device(p, rank, scale=cfg.init_scale)
-        args = (p.su, p.si, p.sv, p.sw, p.omega_u, p.omega_v, p.icu, p.icv)
+        inv_by_mb = {max(mb_cands): (p.icu, p.icv)}
+        for c in mb_cands:
+            if c not in inv_by_mb:
+                from large_scale_recommendation_tpu.data.device_blocking \
+                    import recompute_inv_counts
+
+                inv_by_mb[c] = recompute_inv_counts(p, c)
+        base_args = (p.su, p.si, p.sv, p.sw, p.omega_u, p.omega_v)
+        if len(mb_cands) > 1:
+            tune: dict = {}
+            for c in mb_cands:
+                cargs = base_args + inv_by_mb[c]
+                ck = dict(updater=solver.updater, minibatch=c,
+                          num_blocks=blocks, iterations=1,
+                          collision="mean")
+                Uw, Vw = sgd_ops.dsgd_train(U, V, *cargs, **ck, t0=0)
+                jax.block_until_ready((Uw, Vw))  # compile warm-up
+                t0 = time.perf_counter()
+                Uw, Vw = sgd_ops.dsgd_train(U, V, *cargs, **ck, t0=0)
+                jax.block_until_ready((Uw, Vw))
+                tune[str(c)] = round(time.perf_counter() - t0, 3)
+            del Uw, Vw
+            mb = int(min(tune, key=tune.get))
+            extra["autotune_sweep_s"] = tune
+            extra["minibatch"] = mb
+        args = base_args + inv_by_mb[mb]
         hur_d, hir_d, hmask = p.holdout_rows(dhu, dhi)
         hv_d = dhv
         # small device→host sample for the sequential-NumPy baseline

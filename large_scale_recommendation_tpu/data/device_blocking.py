@@ -288,6 +288,11 @@ def _inv_counts_2d(rows: jax.Array, w: jax.Array) -> jax.Array:
     return jnp.take_along_axis(inv_sorted, inv_back, axis=-1)
 
 
+@jax.jit
+def _inv_counts_pair(su2, si2, sw2):
+    return _inv_counts_2d(su2, sw2), _inv_counts_2d(si2, sw2)
+
+
 @partial(jax.jit, static_argnames=("k", "bmax", "mb", "sort_side"))
 def _layout(flat_s, urow_s, irow_s, vals_s, sizes,
             k: int, bmax: int, mb: int, sort_side: str | None):
@@ -405,6 +410,28 @@ def device_block_problem(
         nnz=nnz, max_pad_ratio=(k * k * bmax) / max(nnz, 1),
         minibatch=mbm,
     )
+
+
+def recompute_inv_counts(problem: DeviceBlockedProblem, minibatch: int):
+    """Collision scales for a DIFFERENT kernel minibatch on the same layout.
+
+    Valid for any ``minibatch`` dividing the padded block size — lets a
+    caller A/B kernel minibatch sizes (bench autotune) from ONE blocking
+    pass instead of rebuilding the layout per candidate. Returns
+    ``(icu, icv)`` shaped like the problem's.
+    """
+    k, bmax = problem.num_blocks, problem.su.shape[-1]
+    if bmax % minibatch != 0:
+        raise ValueError(
+            f"minibatch {minibatch} does not divide padded block size "
+            f"{bmax}; rebuild the problem with this minibatch_multiple")
+    shape = (k, k, bmax)
+    icu, icv = _inv_counts_pair(
+        problem.su.reshape(-1, minibatch),
+        problem.si.reshape(-1, minibatch),
+        problem.sw.reshape(-1, minibatch),
+    )
+    return icu.reshape(shape), icv.reshape(shape)
 
 
 def init_factors_device(problem: DeviceBlockedProblem, rank: int,

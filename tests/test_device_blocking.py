@@ -118,6 +118,30 @@ class TestDeviceBlocking:
                 if w[j] > 0:
                     assert inv[j] == pytest.approx(1.0 / max(cnt, 1.0))
 
+    def test_recompute_inv_counts_other_minibatch(self):
+        """recompute_inv_counts(p, mb') on the same layout must equal the
+        per-minibatch weighted-count definition at mb' (the bench autotune
+        contract: one blocking pass, several kernel minibatches)."""
+        u, i, r, nu, ni = _toy(n=3000, nu=40, ni=30, skew=2.0)
+        p = device_blocking.device_block_problem(
+            u, i, r, nu, ni, num_blocks=2, minibatch_multiple=256)
+        for mb in (64, 128):
+            icu, _ = device_blocking.recompute_inv_counts(p, mb)
+            su = np.asarray(p.su).reshape(-1)
+            sw = np.asarray(p.sw).reshape(-1)
+            icu = np.asarray(icu).reshape(-1)
+            rng = np.random.default_rng(0)
+            for m0 in rng.choice(len(su) // mb, 8, replace=False) * mb:
+                rows = su[m0:m0 + mb]
+                w = sw[m0:m0 + mb]
+                for j in range(0, mb, 17):
+                    if w[j] > 0:
+                        cnt = w[rows == rows[j]].sum()
+                        assert icu[m0 + j] == pytest.approx(
+                            1.0 / max(cnt, 1.0))
+        with pytest.raises(ValueError, match="divide"):
+            device_blocking.recompute_inv_counts(p, p.su.shape[-1] * 2)
+
     def test_collision_scale_semantics_match_host(self):
         """Same definition as blocking.minibatch_inv_counts: a real entry's
         scale is 1/(weight-sum of its row in its minibatch)."""
