@@ -209,6 +209,8 @@ class DeviceBlockedProblem:
 
 @partial(jax.jit, static_argnames=("k", "rpb", "num_rows"))
 def _assign_rows(key, counts: jax.Array, k: int, rpb: int, num_rows: int):
+    # counts may be float (weighted occurrences) — the serpentine deal only
+    # needs their ORDER; omegas inherit the weighted values.
     """Balanced block/row assignment for one side.
 
     ≙ ``build_id_index``'s serpentine deal (data/blocking.py): seeded random
