@@ -207,10 +207,29 @@ class DeviceBlockedProblem:
         return ur, ir, mask
 
 
+def rows_per_block(n_ids: int, num_blocks: int, row_multiple: int = 8) -> int:
+    """The per-block row count for a dense vocab dealt over ``num_blocks``
+    (padded up for TPU-friendly shard shapes) — shared by the single-device
+    and the multi-host (``parallel.distributed``) blocking paths."""
+    rpb = max(-(-n_ids // num_blocks), 1)
+    return -(-rpb // row_multiple) * row_multiple
+
+
+@partial(jax.jit, static_argnames=("num_users", "num_items"))
+def _weighted_counts(u, i, w, num_users: int, num_items: int):
+    """Exact per-id occurrence counts; a weight-0 entry is padding and
+    counts as 0. int32 accumulation — float32 scatter-add would silently
+    stall at 2^24 occurrences on hot ids."""
+    real = (w > 0).astype(jnp.int32)
+    cu = jnp.zeros(num_users, jnp.int32).at[u].add(real)
+    cv = jnp.zeros(num_items, jnp.int32).at[i].add(real)
+    return cu, cv
+
+
 @partial(jax.jit, static_argnames=("k", "rpb", "num_rows"))
 def _assign_rows(key, counts: jax.Array, k: int, rpb: int, num_rows: int):
-    # counts may be float (weighted occurrences) — the serpentine deal only
-    # needs their ORDER; omegas inherit the weighted values.
+    # counts: exact int occurrences — the serpentine deal needs their
+    # ORDER; omegas inherit the values (cast to float).
     """Balanced block/row assignment for one side.
 
     ≙ ``build_id_index``'s serpentine deal (data/blocking.py): seeded random
@@ -238,25 +257,34 @@ def _assign_rows(key, counts: jax.Array, k: int, rpb: int, num_rows: int):
 
 
 @partial(jax.jit, static_argnames=("k", "rpb_u", "rpb_v"))
-def _bucket_entries(key, u, i, r, row_of_u, row_of_i,
+def _bucket_entries(key, u, i, r, w, row_of_u, row_of_i,
                     k: int, rpb_u: int, rpb_v: int):
     """Map entries to (stratum, user-block) buckets and sort them bucket-
     contiguous with random within-bucket order (≙ the host pass's seeded
-    shuffle + stable bucket sort, data/blocking.py ``block_ratings``)."""
+    shuffle + stable bucket sort, data/blocking.py ``block_ratings``).
+    Weight-0 padding entries keep their slots (static shapes) but carry
+    w=0 through to the layout — no-ops everywhere downstream."""
     urow = row_of_u[u]
     irow = row_of_i[i]
     ublk = urow // rpb_u
     iblk = irow // rpb_v
     strat = (iblk - ublk) % k
     flat = (strat * k + ublk).astype(jnp.int32)
+    # padding entries spread round-robin over ALL buckets: their ids are 0
+    # so they would otherwise pile into one bucket and inflate bmax (and
+    # the whole k²·bmax layout) by the total pad count
+    n = flat.shape[0]
+    flat = jnp.where(w > 0, flat,
+                     jnp.arange(n, dtype=jnp.int32) % (k * k))
     sizes = jnp.zeros(k * k, jnp.int32).at[flat].add(1)
     # seeded permutation + stable bucket sort: buckets become contiguous
     # runs with random within-bucket order (≙ the host pass's shuffle +
     # stable counting sort; avoids 64-bit composite keys, see _assign_rows)
-    perm = jax.random.permutation(key, flat.shape[0])
+    perm = jax.random.permutation(key, n)
     order = perm[jnp.argsort(flat[perm], stable=True)]
     return (sizes, flat[order], urow[order], irow[order],
-            jnp.asarray(r, jnp.float32)[order])
+            jnp.asarray(r, jnp.float32)[order],
+            jnp.asarray(w, jnp.float32)[order])
 
 
 def _inv_counts_2d(rows: jax.Array, w: jax.Array) -> jax.Array:
@@ -296,7 +324,7 @@ def _inv_counts_pair(su2, si2, sw2):
 
 
 @partial(jax.jit, static_argnames=("k", "bmax", "mb", "sort_side"))
-def _layout(flat_s, urow_s, irow_s, vals_s, sizes,
+def _layout(flat_s, urow_s, irow_s, vals_s, w_s, sizes,
             k: int, bmax: int, mb: int, sort_side: str | None):
     """Scatter bucket-sorted entries into the padded [k, k, bmax] layout and
     compute the per-minibatch collision scales (both sides) on device."""
@@ -312,7 +340,7 @@ def _layout(flat_s, urow_s, irow_s, vals_s, sizes,
                                                   unique_indices=True)
     sv = jnp.zeros(total, jnp.float32).at[dest].set(vals_s,
                                                     unique_indices=True)
-    sw = jnp.zeros(total, jnp.float32).at[dest].set(1.0,
+    sw = jnp.zeros(total, jnp.float32).at[dest].set(w_s,
                                                     unique_indices=True)
 
     def two_d(a):
@@ -349,6 +377,7 @@ def device_block_problem(
     seed: int = 0,
     row_multiple: int = 8,
     minibatch_sort: str | None = None,
+    weights: jax.Array | None = None,
 ) -> DeviceBlockedProblem:
     """Full on-device blocking pass over dense-id COO arrays.
 
@@ -356,6 +385,12 @@ def device_block_problem(
     back to fix the padded block size ``bmax``, which must be a static shape
     for XLA). Everything else — balanced row assignment, omegas, the
     stratum-major scatter, per-minibatch collision scales — happens on chip.
+
+    ``weights`` (float32, optional) marks weight-0 entries as padding: they
+    keep layout slots (static shapes) but contribute nothing to counts,
+    omegas, collision scales or training — the same weight-0 contract as
+    the host path's ``Ratings``. Callers that pad per-host shards to equal
+    sizes (multi-host ingest) use exactly this.
     """
     if minibatch_sort not in (None, "user", "item"):
         raise ValueError(
@@ -365,6 +400,8 @@ def device_block_problem(
     i = jnp.asarray(i, jnp.int32)
     if u.shape[0] == 0:
         raise ValueError("device_block_problem: empty ratings input")
+    w = (jnp.ones(u.shape[0], jnp.float32) if weights is None
+         else jnp.asarray(weights, jnp.float32))
     # Fail fast on out-of-range ids: the scatters/gathers below would
     # otherwise silently drop/clamp them into a wrong-but-plausible layout
     # (e.g. raw 1-based MovieLens ids). One tiny scalar sync, once per fit.
@@ -378,20 +415,16 @@ def device_block_problem(
             "Arbitrary external ids go through data.blocking (host path).")
     base = jax.random.PRNGKey(seed)
 
-    def rpb_of(n_ids):
-        rpb = max(-(-n_ids // k), 1)
-        return -(-rpb // row_multiple) * row_multiple
-
-    rpb_u, rpb_v = rpb_of(num_users), rpb_of(num_items)
-    counts_u = jnp.zeros(num_users, jnp.int32).at[u].add(1)
-    counts_v = jnp.zeros(num_items, jnp.int32).at[i].add(1)
+    rpb_u, rpb_v = rows_per_block(num_users, k, row_multiple), \
+        rows_per_block(num_items, k, row_multiple)
+    counts_u, counts_v = _weighted_counts(u, i, w, num_users, num_items)
     row_of_u, omega_u, id_of_ur = _assign_rows(
         jax.random.fold_in(base, 10), counts_u, k, rpb_u, k * rpb_u)
     row_of_i, omega_v, id_of_ir = _assign_rows(
         jax.random.fold_in(base, 11), counts_v, k, rpb_v, k * rpb_v)
 
-    sizes, flat_s, urow_s, irow_s, vals_s = _bucket_entries(
-        jax.random.fold_in(base, 12), u, i, r, row_of_u, row_of_i,
+    sizes, flat_s, urow_s, irow_s, vals_s, w_s = _bucket_entries(
+        jax.random.fold_in(base, 12), u, i, r, w, row_of_u, row_of_i,
         k, rpb_u, rpb_v)
 
     sizes_host = np.asarray(sizes)  # the one tiny device→host sync
@@ -400,9 +433,11 @@ def device_block_problem(
     bmax = -(-bmax // mbm) * mbm
 
     su, si, sv, sw, icu, icv = _layout(
-        flat_s, urow_s, irow_s, vals_s, sizes, k, bmax, mbm, minibatch_sort)
+        flat_s, urow_s, irow_s, vals_s, w_s, sizes, k, bmax, mbm,
+        minibatch_sort)
 
-    nnz = int(sizes_host.sum())
+    nnz = (int(sizes_host.sum()) if weights is None
+           else int(jnp.sum(w > 0)))
     return DeviceBlockedProblem(
         su=su, si=si, sv=sv, sw=sw, icu=icu, icv=icv,
         omega_u=omega_u, omega_v=omega_v,

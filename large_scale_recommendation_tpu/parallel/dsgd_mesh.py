@@ -257,10 +257,10 @@ class MeshDSGD:
         ``device_put``-resharding the on-chip layout across the mesh.
 
         Single-process meshes (one host's devices, or the virtual CPU
-        mesh). Multi-host runs use ``fit`` with ``parallel.distributed``
-        today (examples/distributed_demo.py); extending the on-device
-        pipeline across processes needs per-host blocking of shard-local
-        ratings + a global re-layout, which is future work.
+        mesh). For multi-host runs use
+        ``parallel.distributed.global_device_blocked`` — the same pipeline
+        computed globally on the process-spanning mesh, each host
+        contributing only its shard (examples/distributed_demo.py).
         """
         from large_scale_recommendation_tpu.data.device_blocking import (
             device_block_problem,

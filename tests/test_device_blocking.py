@@ -118,6 +118,48 @@ class TestDeviceBlocking:
                 if w[j] > 0:
                     assert inv[j] == pytest.approx(1.0 / max(cnt, 1.0))
 
+    def test_weight_zero_padding_entries_are_noops(self):
+        """The weights channel: padded entries (w=0, id 0) occupy layout
+        slots but contribute nothing — counts, omegas, real-entry multiset
+        and training all match the unpadded problem (the per-host
+        equal-shard padding contract for multi-host ingest)."""
+        u, i, r, nu, ni = _toy(n=2000, seed=6, skew=2.0)
+        n_pad = 137
+        up = np.concatenate([u, np.zeros(n_pad, np.int64)])
+        ip = np.concatenate([i, np.zeros(n_pad, np.int64)])
+        rp = np.concatenate([r, np.zeros(n_pad, np.float32)])
+        wp = np.concatenate([np.ones(len(u), np.float32),
+                             np.zeros(n_pad, np.float32)])
+        plain = device_blocking.device_block_problem(
+            u, i, r, nu, ni, num_blocks=2, minibatch_multiple=64, seed=4)
+        padded = device_blocking.device_block_problem(
+            up, ip, rp, nu, ni, num_blocks=2, minibatch_multiple=64,
+            seed=4, weights=wp)
+        assert padded.nnz == plain.nnz == len(u)
+        # identical weighted counts → identical row maps and omegas
+        np.testing.assert_array_equal(np.asarray(plain.row_of_user),
+                                      np.asarray(padded.row_of_user))
+        np.testing.assert_array_equal(np.asarray(plain.omega_u),
+                                      np.asarray(padded.omega_u))
+        # same real-entry multiset through the layout
+        def real(p):
+            sw = np.asarray(p.sw) > 0
+            return sorted(zip(np.asarray(p.su)[sw].tolist(),
+                              np.asarray(p.si)[sw].tolist(),
+                              np.asarray(p.sv)[sw].tolist()))
+        assert real(plain) == real(padded)
+        # collision scales ignore the w=0 slots: every real row-0 entry's
+        # scale reflects only real occurrences (recomputed in numpy)
+        su = np.asarray(padded.su).reshape(-1)
+        sw = np.asarray(padded.sw).reshape(-1)
+        icu = np.asarray(padded.icu).reshape(-1)
+        for m0 in range(0, len(su), 64):
+            rows, ws, inv = su[m0:m0 + 64], sw[m0:m0 + 64], icu[m0:m0 + 64]
+            for j in range(0, 64, 13):
+                if ws[j] > 0:
+                    cnt = ws[rows == rows[j]].sum()
+                    assert inv[j] == pytest.approx(1.0 / max(cnt, 1.0))
+
     def test_recompute_inv_counts_other_minibatch(self):
         """recompute_inv_counts(p, mb') on the same layout must equal the
         per-minibatch weighted-count definition at mb' (the bench autotune
