@@ -1,0 +1,47 @@
+"""The benchmark deliverable's contract: one JSON line with the required
+fields, produced end-to-end by the real child on a reduced config.
+
+The driver runs ``python bench.py`` at round end and parses the last
+stdout line — a regression here silently costs the round its perf
+evidence, so the contract is pinned in the suite (slow-marked).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_bench_child_emits_contract_json():
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "BENCH_FORCE_CPU": "1",
+        "BENCH_NNZ": "200000",
+        "BENCH_RANK": "16",
+        "BENCH_ITERS": "1",
+        "BENCH_MB": "4096",
+        "BENCH_BLOCKS": "2",
+        "BENCH_SKIP_EXTRAS": "1",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--child"],
+        env=env, capture_output=True, text=True, timeout=600, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    d = json.loads(lines[-1])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in d, f"missing {key}"
+    assert d["value"] > 0
+    assert d["unit"] == "ratings/s"
+    e = d["extra"]
+    for key in ("h2d_mbps", "pipeline", "rmse_curve", "dsgd_train_wall_s",
+                "effective_hbm_gbs", "numpy_seq_baseline_ratings_per_s"):
+        assert key in e, f"missing extra.{key}"
+    assert e["pipeline"] == "device"
