@@ -342,8 +342,6 @@ def _extra_lines(extra: dict, rank: int, jax, h2d_mbps: float) -> None:
     (``device_prepare_side``) — no link traffic at all; the online and
     PS lines stream real host data by design, so they gate on the
     measured link bandwidth."""
-    import jax.numpy as jnp
-
     from large_scale_recommendation_tpu.core.generators import (
         SyntheticMFGenerator,
     )
@@ -374,18 +372,20 @@ def _extra_lines(extra: dict, rank: int, jax, h2d_mbps: float) -> None:
     jax.block_until_ready((prep_u, prep_v))
     extra["als_plan_wall_s"] = round(time.perf_counter() - t0, 2)
     # rank 64 first: the apples-to-apples line against round 2's
-    # 60.8K rows/s (same rank, scatter-formulation) — then the target ranks
-    for als_rank, iters in ((64, 2), (rank, 2), (256, 1)):
+    # 60.8K rows/s (same rank, scatter-formulation) — then the target
+    # ranks, first-entry-wins on duplicates (BENCH_RANK may be 64 or 256)
+    rank_iters: list = []
+    for rr, it in ((64, 2), (rank, 2), (256, 1)):
+        if all(rr != seen for seen, _ in rank_iters):
+            rank_iters.append((rr, it))
+    for als_rank, iters in rank_iters:
         # λ scaled to the stand-in's signal magnitude (see run_child note);
         # "direct" mode ≙ MLlib ALS.train's regParam semantics
         init = PseudoRandomFactorInitializer(als_rank, scale=0.1)
         V = init(np.arange(ani, dtype=np.int32))
 
         def rounds(V, n):
-            for _ in range(n):
-                U = als_ops.solve_side(V, prep_u, anu, 0.01)
-                V = als_ops.solve_side(U, prep_v, ani, 0.01)
-            return U, V
+            return als_ops.als_rounds(V, prep_u, prep_v, anu, ani, 0.01, n)
 
         jax.block_until_ready(rounds(V, 1))  # compile warm-up, BOTH sides
         t0 = time.perf_counter()
@@ -405,18 +405,9 @@ def _extra_lines(extra: dict, rank: int, jax, h2d_mbps: float) -> None:
             iprep_u = als_ops.implicit_prepared(prep_u, 1.0)
             iprep_v = als_ops.implicit_prepared(prep_v, 1.0)
 
-            @jax.jit
-            def full_gram(F):
-                return jnp.einsum("nk,nl->kl", F, F,
-                                  preferred_element_type=jnp.float32)
-
             def irounds(V, n):
-                for _ in range(n):
-                    U = als_ops.solve_side(V, iprep_u, anu, 0.01,
-                                           G=full_gram(V))
-                    V = als_ops.solve_side(U, iprep_v, ani, 0.01,
-                                           G=full_gram(U))
-                return U, V
+                return als_ops.als_rounds(V, iprep_u, iprep_v, anu, ani,
+                                          0.01, n, implicit=True)
 
             jax.block_until_ready(irounds(V, 1))
             t0 = time.perf_counter()

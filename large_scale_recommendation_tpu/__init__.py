@@ -29,9 +29,12 @@ Packages:
               equations)
     models    user-facing solvers/drivers (DSGD, ALS, online MF, combined,
               PS-mode)
-    parallel  device-mesh utilities, shard_map DSGD, collectives
-    data      host-side blocking/ingest (COO strata, micro-batch streams,
-              dataset loaders)
+    parallel  device-mesh utilities, shard_map DSGD, collectives,
+              multi-host bring-up + on-mesh global blocking
+    data      blocking/ingest — host path (arbitrary ids, native kernels)
+              AND the on-device pipeline (data.device_blocking: blocking
+              as XLA sort/scan/scatter; DSGD.fit_device / MeshDSGD
+              .fit_device consume it)
     utils     config, checkpointing, metrics, logging
 """
 

@@ -207,6 +207,28 @@ class DeviceBlockedProblem:
         return ur, ir, mask
 
 
+def validate_dense_ids(u, i, num_users: int, num_items: int,
+                       ctx: str) -> None:
+    """Fail fast on out-of-range ids, BEFORE any int32 cast — an int64 host
+    array with a wild id would otherwise wrap around the cast and pass a
+    post-cast range check as a plausible small id. Shared by every dense-id
+    device entry point (device blocking, DSGD/ALS fit_device)."""
+    def rng(a):
+        if isinstance(a, jax.Array):
+            return int(a.min()), int(a.max())
+        a = np.asarray(a)
+        return int(a.min()), int(a.max())
+
+    lo_u, hi_u = rng(u)
+    lo_i, hi_i = rng(i)
+    if lo_u < 0 or hi_u >= num_users or lo_i < 0 or hi_i >= num_items:
+        raise ValueError(
+            f"{ctx} needs dense ids in [0, num_users) × [0, num_items); "
+            f"got user range [{lo_u}, {hi_u}] vs {num_users}, item range "
+            f"[{lo_i}, {hi_i}] vs {num_items}. Arbitrary external ids go "
+            "through the host path (data.blocking).")
+
+
 def rows_per_block(n_ids: int, num_blocks: int, row_multiple: int = 8) -> int:
     """The per-block row count for a dense vocab dealt over ``num_blocks``
     (padded up for TPU-friendly shard shapes) — shared by the single-device
@@ -396,23 +418,16 @@ def device_block_problem(
         raise ValueError(
             f"minibatch_sort must be None|'user'|'item', got {minibatch_sort!r}")
     k = num_blocks
+    if np.shape(u)[0] == 0:  # no-copy for device arrays (shape attr)
+        raise ValueError("device_block_problem: empty ratings input")
+    # pre-cast range check: an OOB int64 id would wrap through the int32
+    # cast into a wrong-but-plausible layout (e.g. raw 1-based MovieLens
+    # ids). One tiny scalar sync, once per fit.
+    validate_dense_ids(u, i, num_users, num_items, "device_block_problem")
     u = jnp.asarray(u, jnp.int32)
     i = jnp.asarray(i, jnp.int32)
-    if u.shape[0] == 0:
-        raise ValueError("device_block_problem: empty ratings input")
     w = (jnp.ones(u.shape[0], jnp.float32) if weights is None
          else jnp.asarray(weights, jnp.float32))
-    # Fail fast on out-of-range ids: the scatters/gathers below would
-    # otherwise silently drop/clamp them into a wrong-but-plausible layout
-    # (e.g. raw 1-based MovieLens ids). One tiny scalar sync, once per fit.
-    lo_u, hi_u = int(u.min()), int(u.max())
-    lo_i, hi_i = int(i.min()), int(i.max())
-    if lo_u < 0 or hi_u >= num_users or lo_i < 0 or hi_i >= num_items:
-        raise ValueError(
-            f"device_block_problem needs dense ids in [0, num_users) × "
-            f"[0, num_items); got user range [{lo_u}, {hi_u}] vs "
-            f"{num_users}, item range [{lo_i}, {hi_i}] vs {num_items}. "
-            "Arbitrary external ids go through data.blocking (host path).")
     base = jax.random.PRNGKey(seed)
 
     rpb_u, rpb_v = rows_per_block(num_users, k, row_multiple), \

@@ -89,6 +89,82 @@ class ALS:
         self.model = MFModel(U=U, V=V, users=users, items=items)
         return self.model
 
+    def fit_device(
+        self,
+        u,
+        i,
+        r,
+        num_users: int,
+        num_items: int,
+    ) -> MFModel:
+        """Fit via device-built solve plans (``ops.als.device_prepare_side``).
+
+        Dense-id COO in (host or device arrays, ids in ``[0, num_users) ×
+        [0, num_items)``), standard ``MFModel`` out — the ALS counterpart of
+        ``DSGD.fit_device``: the sort/bucket/pad plan construction runs on
+        chip, so the host never materializes the padded bucket expansion
+        and only two ≤33-int size vectors cross the host↔device link.
+        Arbitrary external ids go through ``fit`` (host planning).
+        """
+        import jax.numpy as jnp
+
+        from large_scale_recommendation_tpu.data.device_blocking import (
+            validate_dense_ids,
+        )
+
+        cfg = self.config
+        if np.shape(u)[0] == 0:
+            raise ValueError("cannot fit on an empty ratings set")
+        validate_dense_ids(u, i, num_users, num_items, "ALS.fit_device")
+        u = jnp.asarray(u, jnp.int32)
+        i = jnp.asarray(i, jnp.int32)
+        r = jnp.asarray(r, jnp.float32)
+
+        omega_u = jnp.zeros(num_users, jnp.int32).at[u].add(1)
+        omega_v = jnp.zeros(num_items, jnp.int32).at[i].add(1)
+        omu = (omega_u.astype(jnp.float32)
+               if cfg.reg_mode == "als_wr" else None)
+        omv = (omega_v.astype(jnp.float32)
+               if cfg.reg_mode == "als_wr" else None)
+        k = cfg.num_factors
+        prep_u = als_ops.device_prepare_side(
+            u, i, r, num_users, omega=omu, min_pad=cfg.min_pad,
+            rank_for_chunking=k)
+        prep_v = als_ops.device_prepare_side(
+            i, u, r, num_items, omega=omv, min_pad=cfg.min_pad,
+            rank_for_chunking=k)
+        if cfg.implicit_alpha is not None:
+            prep_u = als_ops.implicit_prepared(prep_u, cfg.implicit_alpha)
+            prep_v = als_ops.implicit_prepared(prep_v, cfg.implicit_alpha)
+
+        init = PseudoRandomFactorInitializer(k, scale=cfg.init_scale)
+        # zero the unseen-id rows, matching the host path's zeroed padding
+        # rows: the implicit VᵀV term sums the WHOLE table, and the first
+        # half-step reads V's init directly (see _init_factors). Only V's
+        # init matters mathematically — the first half-step solves U.
+        V = init(np.arange(num_items, dtype=np.int32)) \
+            * (omega_v > 0)[:, None]
+
+        U, V = als_ops.als_rounds(
+            V, prep_u, prep_v, num_users, num_items, cfg.lambda_,
+            cfg.iterations, implicit=cfg.implicit_alpha is not None)
+
+        # dense-vocab IdIndex pair with host-path semantics (ids unseen in
+        # training stay unknown → predict 0, dropped from risk)
+        def index(omega, n_ids):
+            om = np.asarray(omega).astype(np.float32)
+            all_ids = np.arange(n_ids, dtype=np.int64)
+            present = om > 0
+            ids = np.where(present, all_ids, -1)
+            return blocking.IdIndex(
+                ids=ids, num_blocks=1, rows_per_block=n_ids, omega=om,
+                sorted_ids=all_ids[present], sorted_rows=all_ids[present],
+            )
+
+        self.model = MFModel(U=U, V=V, users=index(omega_u, num_users),
+                             items=index(omega_v, num_items))
+        return self.model
+
     def _init_factors(self, users: blocking.IdIndex, items: blocking.IdIndex):
         cfg = self.config
         # Only V's init matters mathematically (the first half-step solves U

@@ -469,6 +469,27 @@ def solve_side_local(
     return out[:rows_per_shard]
 
 
+@jax.jit
+def _full_gram(F):
+    return jnp.einsum("nk,nl->kl", F, F,
+                      preferred_element_type=jnp.float32)
+
+
+def als_rounds(V, prep_u, prep_v, num_u: int, num_v: int, lambda_: float,
+               iterations: int, implicit: bool = False):
+    """``iterations`` × (user half-step; item half-step) over PREPARED
+    buckets — the ONE training-loop body shared by ``als_train_planned``
+    (host plans) and the model-level ``ALS.fit_device`` (device plans).
+    With ``implicit`` each half-step adds the fixed side's whole VᵀV gram
+    (one [k, k] matmul)."""
+    for _ in range(iterations):
+        Gv = _full_gram(V) if implicit else None
+        U = solve_side(V, prep_u, num_u, lambda_, Gv)
+        Gu = _full_gram(U) if implicit else None
+        V = solve_side(U, prep_v, num_v, lambda_, Gu)
+    return U, V
+
+
 def als_train_planned(
     U: jax.Array,
     V: jax.Array,
@@ -496,18 +517,9 @@ def als_train_planned(
     omv = omega_v if reg_mode == "als_wr" else None
     prep_u = prepare_side(user_plan, omu, k, implicit_alpha)
     prep_v = prepare_side(item_plan, omv, k, implicit_alpha)
-
-    @jax.jit
-    def full_gram(F):
-        return jnp.einsum("nk,nl->kl", F, F,
-                          preferred_element_type=jnp.float32)
-
-    for _ in range(iterations):
-        Gv = full_gram(V) if implicit_alpha is not None else None
-        U = solve_side(V, prep_u, user_plan.num_rows, lambda_, Gv)
-        Gu = full_gram(U) if implicit_alpha is not None else None
-        V = solve_side(U, prep_v, item_plan.num_rows, lambda_, Gu)
-    return U, V
+    return als_rounds(V, prep_u, prep_v, user_plan.num_rows,
+                      item_plan.num_rows, lambda_, iterations,
+                      implicit=implicit_alpha is not None)
 
 
 def gram_stats(

@@ -299,6 +299,59 @@ class TestDevicePreparedPlans:
         assert np.abs(out[0]).sum() > 0 and np.abs(out[2]).sum() > 0
 
 
+class TestALSFitDevice:
+    """ALS.fit_device: device-built plans behind the standard model
+    surface — must converge like fit on dense-id data."""
+
+    def test_matches_fit_quality_and_surface(self):
+        from large_scale_recommendation_tpu.core.generators import (
+            SyntheticMFGenerator,
+        )
+        from large_scale_recommendation_tpu.models.als import ALS, ALSConfig
+
+        gen = SyntheticMFGenerator(num_users=120, num_items=90, rank=4,
+                                   noise=0.05, seed=3)
+        train, test = gen.generate(12_000), gen.generate(1_200)
+        ru, ri, rv, _ = train.to_numpy()
+        cfg = ALSConfig(num_factors=8, lambda_=0.05, iterations=4, seed=0)
+        md = ALS(cfg).fit_device(ru, ri, rv, 120, 90)
+        mh = ALS(cfg).fit(train)
+        assert md.rmse(test) < 0.12
+        assert abs(md.rmse(test) - mh.rmse(test)) < 0.02
+        # unseen-id semantics: hold one user out, it must score exactly 0
+        held = int(ru[0])
+        keep = ru != held
+        m2 = ALS(cfg).fit_device(ru[keep], ri[keep], rv[keep], 120, 90)
+        assert float(m2.predict(np.array([held]), np.array([0]))[0]) == 0.0
+        # bad ids fail fast
+        with pytest.raises(ValueError, match="dense ids"):
+            ALS(cfg).fit_device(np.array([0, 120]), np.array([0, 0]),
+                                np.ones(2, np.float32), 120, 90)
+
+    def test_implicit_mode_matches_host_fit_ranking(self):
+        """Same planted-propensity setup as the host iALS ranking test:
+        held-out positives outrank random pairs through fit_device."""
+        from large_scale_recommendation_tpu.models.als import ALS, ALSConfig
+
+        rng = np.random.default_rng(1)
+        nu, ni, k_true = 300, 200, 6
+        logits = rng.normal(0, 1, (nu, k_true)) @ \
+            rng.normal(0, 1, (ni, k_true)).T
+        pos = np.argwhere(logits > np.quantile(logits, 0.97))
+        rng.shuffle(pos)
+        train_pos, test_pos = pos[:-500], pos[-500:]
+        cfg = ALSConfig(num_factors=8, lambda_=0.1, iterations=6,
+                        implicit_alpha=20.0, seed=0)
+        md = ALS(cfg).fit_device(train_pos[:, 0], train_pos[:, 1],
+                                 np.ones(len(train_pos), np.float32),
+                                 nu, ni)
+        pos_scores = np.asarray(md.predict(test_pos[:, 0], test_pos[:, 1]))
+        rand_scores = np.asarray(md.predict(rng.integers(0, nu, 2000),
+                                            rng.integers(0, ni, 2000)))
+        auc = (pos_scores[:, None] > rand_scores[None, :]).mean()
+        assert auc > 0.9, auc
+
+
 class TestImplicitALS:
     """iALS (Hu/Koren/Volinsky; ≙ MLlib ALS.trainImplicit — the BASELINE
     Criteo-implicit configuration)."""
