@@ -348,6 +348,53 @@ class TestDeviceBlocking:
         np.testing.assert_allclose(np.asarray(straight.U),
                                    np.asarray(resumed.U), rtol=1e-5)
 
+    @pytest.mark.slow
+    def test_fuzz_layout_invariants(self):
+        """Randomized shapes/skews/weights: the layout contract must hold
+        for every draw (multiset preservation, stratum property, weighted
+        collision scales)."""
+        rng = np.random.default_rng(2026)
+        for trial in range(20):
+            nu = int(rng.integers(3, 400))
+            ni = int(rng.integers(3, 300))
+            n = int(rng.integers(10, 5000))
+            k = int(rng.choice([1, 2, 3, 4, 8]))
+            mb = int(rng.choice([1, 16, 64, 256]))
+            skew = rng.choice([None, 1.0, 3.0])
+            u = (rng.integers(0, nu, n) if skew is None else np.minimum(
+                (-np.log1p(-rng.random(n) * (1 - np.exp(-skew))) / skew
+                 * nu).astype(np.int64), nu - 1))
+            i = rng.integers(0, ni, n)
+            r = rng.normal(0, 1, n).astype(np.float32)
+            w = (rng.random(n) > 0.2).astype(np.float32) \
+                if trial % 3 == 0 else None
+            p = device_blocking.device_block_problem(
+                u, i, r, nu, ni, num_blocks=k, minibatch_multiple=mb,
+                seed=trial, weights=w)
+            wreal = np.ones(n) if w is None else w
+            assert p.nnz == int((wreal > 0).sum()), (trial, p.nnz)
+            su = np.asarray(p.su)
+            si = np.asarray(p.si)
+            sw = np.asarray(p.sw)
+            m = sw > 0
+            assert int(m.sum()) == p.nnz
+            # stratum property on every real entry
+            ub = su[m] // p.rows_per_block_u
+            ib = si[m] // p.rows_per_block_v
+            s_idx, p_idx, _ = np.nonzero(m)
+            assert (ub == p_idx).all(), trial
+            assert (ib == (p_idx + s_idx) % k).all(), trial
+            # real multiset through the row maps
+            keep = wreal > 0
+            row_u = np.asarray(p.row_of_user)
+            row_i = np.asarray(p.row_of_item)
+            exp = sorted(zip(row_u[u[keep]].tolist(),
+                             row_i[i[keep]].tolist(),
+                             np.float32(r[keep]).tolist()))
+            got = sorted(zip(su[m].tolist(), si[m].tolist(),
+                             np.asarray(p.sv)[m].tolist()))
+            assert exp == got, trial
+
     def test_init_factors_device_matches_host_initializer(self):
         from large_scale_recommendation_tpu.core.initializers import (
             PseudoRandomFactorInitializer,
