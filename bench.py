@@ -77,6 +77,7 @@ def _numpy_sequential_baseline(ru, ri, rv, rank, sample=150_000, lr=0.01,
 
 
 def run_child() -> None:
+    child_t0 = time.perf_counter()
     nnz = int(os.environ.get("BENCH_NNZ", 25_000_095))
     rank = int(os.environ.get("BENCH_RANK", 128))
     max_iters = int(os.environ.get("BENCH_ITERS", 12))
@@ -319,8 +320,20 @@ def run_child() -> None:
     baseline = _numpy_sequential_baseline(*base_sample, rank)
     extra["numpy_seq_baseline_ratings_per_s"] = round(baseline, 1)
 
+    # extras only if the headline left enough window (the driver's overall
+    # timeout must never cost the round its DSGD number): default budget is
+    # half of BENCH_TIMEOUT, spent means skip
+    elapsed = time.perf_counter() - child_t0
+    extras_deadline = float(os.environ.get(
+        "BENCH_EXTRAS_DEADLINE",
+        float(os.environ.get("BENCH_TIMEOUT", 2400)) / 2))
     if not skip_extras:
-        _extra_lines(extra, rank, jax, h2d_mbps)
+        if elapsed < extras_deadline:
+            _extra_lines(extra, rank, jax, h2d_mbps)
+        else:
+            extra["extras_skipped"] = (
+                f"headline took {elapsed:.0f}s ≥ extras deadline "
+                f"{extras_deadline:.0f}s (BENCH_EXTRAS_DEADLINE)")
 
     result = {
         "metric": (f"ratings/sec/chip (DSGD, ML-25M-shaped skewed, "
