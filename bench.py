@@ -37,7 +37,10 @@ BENCH_AUTOTUNE (=1 → A/B the kernel minibatch vs its 2× on one timed
 sweep each, same blocked layout, before the timed run; OFF by default
 because sweep time is only half the story — at full scale mb 65536
 measured faster per sweep but MISSED the RMSE target in 10 sweeps, see
-docs/PERF.md).
+docs/PERF.md), BENCH_EXTRAS_DEADLINE (seconds of child elapsed after
+which extras are skipped; defaults to BENCH_TIMEOUT/2 under the parent,
+unlimited for a standalone child — and the headline JSON prints BEFORE
+extras either way, so an extras overrun can never cost the measurement).
 """
 
 from __future__ import annotations
@@ -320,13 +323,35 @@ def run_child() -> None:
     baseline = _numpy_sequential_baseline(*base_sample, rank)
     extra["numpy_seq_baseline_ratings_per_s"] = round(baseline, 1)
 
-    # extras only if the headline left enough window (the driver's overall
-    # timeout must never cost the round its DSGD number): default budget is
-    # half of BENCH_TIMEOUT, spent means skip
+    def result_line() -> dict:
+        return {
+            "metric": (f"ratings/sec/chip (DSGD, ML-25M-shaped skewed, "
+                       f"rank={rank}, {nnz/1e6:.1f}M ratings, "
+                       f"{blocks}x{blocks} strata)"),
+            "value": round(throughput, 1),
+            "unit": "ratings/s",
+            "vs_baseline": round(throughput / baseline, 2),
+            "extra": extra,
+        }
+
+    # The headline line prints BEFORE extras: if the extras overrun the
+    # parent's window and the child is killed, the parent salvages the last
+    # complete line — an extras overrun can never forfeit the computed
+    # DSGD measurement. A second, final line (with extras merged) replaces
+    # it when everything completes (the parent parses the LAST line).
+    print(json.dumps(result_line()), flush=True)
+
+    # extras only if the headline left enough window; the deadline applies
+    # when a parent window exists (parent sets BENCH_PARENT=1) or when
+    # explicitly configured — a standalone child run has no clock to beat
     elapsed = time.perf_counter() - child_t0
-    extras_deadline = float(os.environ.get(
+    explicit = ("BENCH_EXTRAS_DEADLINE" in os.environ
+                or "BENCH_TIMEOUT" in os.environ
+                or os.environ.get("BENCH_PARENT") == "1")
+    extras_deadline = (float(os.environ.get(
         "BENCH_EXTRAS_DEADLINE",
         float(os.environ.get("BENCH_TIMEOUT", 2400)) / 2))
+        if explicit else float("inf"))
     if not skip_extras:
         if elapsed < extras_deadline:
             _extra_lines(extra, rank, jax, h2d_mbps)
@@ -335,16 +360,7 @@ def run_child() -> None:
                 f"headline took {elapsed:.0f}s ≥ extras deadline "
                 f"{extras_deadline:.0f}s (BENCH_EXTRAS_DEADLINE)")
 
-    result = {
-        "metric": (f"ratings/sec/chip (DSGD, ML-25M-shaped skewed, "
-                   f"rank={rank}, {nnz/1e6:.1f}M ratings, "
-                   f"{blocks}x{blocks} strata)"),
-        "value": round(throughput, 1),
-        "unit": "ratings/s",
-        "vs_baseline": round(throughput / baseline, 2),
-        "extra": extra,
-    }
-    print(json.dumps(result))
+    print(json.dumps(result_line()), flush=True)  # final line wins
     print(f"# {json.dumps(extra)}", file=sys.stderr)
 
 
@@ -505,6 +521,7 @@ def _attempt(env_overrides: dict[str, str], timeout: float):
     structured signal that the child consumed its whole window (wedged
     backend), distinct from a quick failure worth retrying."""
     env = dict(os.environ)
+    env["BENCH_PARENT"] = "1"  # the child's extras deadline keys off this
     env.update(env_overrides)
     try:
         proc = subprocess.run(
@@ -512,6 +529,20 @@ def _attempt(env_overrides: dict[str, str], timeout: float):
             env=env, capture_output=True, text=True, timeout=timeout,
         )
     except subprocess.TimeoutExpired as e:
+        # The child prints its headline line BEFORE the extras run, so a
+        # kill mid-extras still leaves a complete measurement to salvage.
+        out = (e.stdout.decode(errors="replace")
+               if isinstance(e.stdout, bytes) else (e.stdout or ""))
+        for ln in reversed([x for x in out.splitlines() if x.strip()]):
+            try:
+                parsed = json.loads(ln)
+            except json.JSONDecodeError:
+                continue
+            if "value" in parsed:
+                parsed.setdefault("extra", {})["extras_truncated"] = (
+                    f"child killed at {timeout}s during extras; headline "
+                    "measurement completed")
+                return parsed, "salvaged headline from timed-out child", False
         tail = ((e.stderr or b"")[-2000:] if isinstance(e.stderr, bytes)
                 else (e.stderr or "")[-2000:])
         return None, f"timeout after {timeout}s; stderr tail: {tail}", True
@@ -562,6 +593,19 @@ def _device_preprobe(timeout: float) -> tuple[bool, str]:
 def main() -> None:
     per_attempt = float(os.environ.get("BENCH_TIMEOUT", 2400))
     errors: list[str] = []
+
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        # operator explicitly wants CPU: the child will force_cpu() and
+        # never touch the default backend — probing it would only hang on
+        # a dead tunnel and then clobber the configured workload with the
+        # reduced fallback
+        result, tail, _ = _attempt({}, per_attempt)
+        if result is not None:
+            print(json.dumps(result))
+            return
+        errors.append(f"forced-cpu attempt: {tail}")
+        _cpu_fallback(per_attempt, errors)
+        return
 
     probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 180))
     ok, probe_msg = _device_preprobe(probe_timeout)
