@@ -136,6 +136,29 @@ def _xu_lr(lambda_: float, decay: float) -> LearningRateSchedule:
     return schedule
 
 
+def warm_boost_lr(boost_factor: float = 5.0 / 3.0,
+                  boost_steps: int = 2) -> LearningRateSchedule:
+    """η_t = boost_factor·η for the first ``boost_steps`` sweeps, then η.
+
+    No FlinkML analogue — this one is measured, not inherited: bilinear MF
+    spends its first sweeps bootstrapping factor correlations from small
+    init, and a brief boosted rate cuts that plateau. At the north-star
+    bench config (docs/PERF.md) boost 0.5/0.3 for 2 sweeps reached the
+    RMSE target at sweep 5 instead of 8 and settled at a LOWER floor
+    (0.1464 vs 0.1511) — a 37% cut in wall-clock-to-RMSE.
+    """
+    return _warm_boost_lr(float(boost_factor), int(boost_steps))
+
+
+@functools.lru_cache(maxsize=None)
+def _warm_boost_lr(boost_factor: float, boost_steps: int) -> LearningRateSchedule:
+    def schedule(base_lr: jax.Array, t: jax.Array) -> jax.Array:
+        return jnp.where(jnp.asarray(t, jnp.int32) <= boost_steps,
+                         jnp.float32(boost_factor) * base_lr, base_lr)
+
+    return schedule
+
+
 def schedule_from_name(name: str, lambda_: float = 1.0,
                        **kwargs) -> LearningRateSchedule:
     """Config-layer registry: schedule name → callable.
@@ -158,9 +181,11 @@ def schedule_from_name(name: str, lambda_: float = 1.0,
         return bottou_lr(lambda_, **kwargs)
     if name == "xu":
         return xu_lr(lambda_, **kwargs)
+    if name == "warm_boost":
+        return warm_boost_lr(**kwargs)
     raise ValueError(
         f"unknown learning-rate schedule {name!r}; expected one of "
-        "inverse_sqrt|default|constant|inv_scaling|bottou|xu"
+        "inverse_sqrt|default|constant|inv_scaling|bottou|xu|warm_boost"
     )
 
 

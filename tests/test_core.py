@@ -171,6 +171,8 @@ class TestUpdaters:
             # default t₀ = 1/(λη₀): starts at η₀, decays η₀/(1+η₀λ(t−1))
             "bottou": 0.1 / (1 + 0.1 * lam * 3.0),
             "xu": 0.1 * (1 + lam * 0.1 * 4.0) ** -0.75,
+            # boost window is over by t=4 → base rate
+            "warm_boost": 0.1,
         }
         for name, want in cases.items():
             got = float(schedule_from_name(name, lam)(lr, t))
@@ -178,6 +180,12 @@ class TestUpdaters:
         # explicit optimal_init → verbatim FlinkML Bottou: 1/(λ(t₀+t−1))
         got = float(schedule_from_name("bottou", lam, optimal_init=2.0)(lr, t))
         np.testing.assert_allclose(got, 1.0 / (lam * 5.0), rtol=1e-6)
+        # warm_boost inside the boost window: boost_factor × base
+        wb = schedule_from_name("warm_boost", lam)
+        np.testing.assert_allclose(float(wb(lr, jnp.float32(2.0))),
+                                   0.1 * 5.0 / 3.0, rtol=1e-6)
+        np.testing.assert_allclose(float(wb(lr, jnp.float32(3.0))), 0.1,
+                                   rtol=1e-6)
 
     def test_schedule_registry_returns_singletons(self):
         """Two configs with the same schedule must produce the SAME callable
@@ -186,7 +194,8 @@ class TestUpdaters:
             schedule_from_name,
         )
 
-        for name in ("constant", "inverse_sqrt", "inv_scaling", "bottou", "xu"):
+        for name in ("constant", "inverse_sqrt", "inv_scaling", "bottou",
+                     "xu", "warm_boost"):
             assert schedule_from_name(name, 0.5) is schedule_from_name(name, 0.5)
         # ...including across calling conventions (positional vs kwarg vs
         # default) — lru_cache alone would key these separately
