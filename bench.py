@@ -126,8 +126,8 @@ def run_child() -> None:
     # λ=0.1 with the λ/ω rule ≈ an lr·λ total shrink per sweep — scaled to
     # the stand-in's signal magnitude (λ=1 over-regularizes it to the
     # predict-zero plateau; grid-searched on CPU before pinning). The
-    # warm_boost schedule (lr 0.5 for 2 sweeps, then 0.3) cuts the
-    # bilinear-bootstrap plateau: target at sweep 5 vs 8, lower floor —
+    # warm_boost schedule (lr 0.75 for 2 sweeps, then 0.3) cuts the
+    # bilinear-bootstrap plateau: target at sweep 3 vs 8, lower floor —
     # measured at full scale, docs/PERF.md.
     cfg = DSGDConfig(num_factors=rank, lambda_=0.1, iterations=1,
                      learning_rate=0.3, lr_schedule="warm_boost", seed=0,

@@ -183,7 +183,7 @@ class TestUpdaters:
         # warm_boost inside the boost window: boost_factor × base
         wb = schedule_from_name("warm_boost", lam)
         np.testing.assert_allclose(float(wb(lr, jnp.float32(2.0))),
-                                   0.1 * 5.0 / 3.0, rtol=1e-6)
+                                   0.1 * 2.5, rtol=1e-6)
         np.testing.assert_allclose(float(wb(lr, jnp.float32(3.0))), 0.1,
                                    rtol=1e-6)
 
