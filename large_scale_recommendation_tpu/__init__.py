@@ -38,7 +38,7 @@ Packages:
     utils     config, checkpointing, metrics, logging
 """
 
-__version__ = "0.1.0"
+__version__ = "0.3.0"
 
 from large_scale_recommendation_tpu.core.types import Ratings, FactorVector
 from large_scale_recommendation_tpu.core.initializers import (
