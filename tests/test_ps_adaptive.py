@@ -184,6 +184,56 @@ class TestPSOnlineBatch:
         with pytest.raises(RuntimeError, match="not finished"):
             logic.on_recv(BATCH_TRIGGER, ps)
 
+    @pytest.mark.slow
+    def test_fuzz_random_trigger_interleavings(self):
+        """Randomized stress of the Online/BatchInit/Batch state machines:
+        random worker/shard counts, random trigger placements (including
+        back-to-back near-boundary positions), random stream lengths —
+        every run must terminate cleanly with the right number of retrains
+        and finite factors. Deadlocks/hangs fail via the suite timeout."""
+        rng = np.random.default_rng(77)
+        gen = SyntheticMFGenerator(num_users=30, num_items=20, rank=2,
+                                   noise=0.1, seed=5)
+        for trial in range(8):
+            n = int(rng.integers(60, 400))
+            ratings = gen.generate(n)
+            ru, ri, rv, _ = ratings.to_numpy()
+            events: list = list(zip(ru.tolist(), ri.tolist(), rv.tolist()))
+            n_triggers = int(rng.integers(0, 3))
+            for pos in sorted(rng.integers(1, len(events), n_triggers),
+                              reverse=True):
+                events.insert(int(pos), BATCH_TRIGGER)
+            cfg = PSOnlineBatchConfig(
+                num_factors=4,
+                iterations=int(rng.integers(1, 4)),
+                learning_rate=0.1,
+                lr_schedule="constant",
+                worker_parallelism=int(rng.integers(1, 5)),
+                ps_parallelism=int(rng.integers(1, 4)),
+                pull_limit=int(rng.integers(1, 5)),
+                pull_limit_online=int(rng.integers(1, 9)),
+                chunk_size=int(rng.choice([4, 16, 64])),
+                minibatch_size=int(rng.choice([8, 32])),
+                seed=trial,
+            )
+            solver = PSOnlineBatchMF(cfg)
+            try:
+                users, items = solver.run(events)
+            except RuntimeError as e:
+                # triggers landed too close → the documented fail-fast
+                # (≙ the reference's IllegalStateException,
+                # PSOfflineOnlineMF.scala:81-83) — a clean prompt rejection
+                # is a valid fuzz outcome; a hang is not
+                assert "batch training has not finished" in str(e), trial
+                continue
+            assert len(users) > 0 and len(items) > 0, trial
+            for vecs in (users, items):
+                arr = np.stack([v for v in vecs.values()])
+                assert np.isfinite(arr).all(), trial
+            total_batches = sum(w.batches_run for w in solver.workers)
+            assert total_batches == n_triggers * cfg.worker_parallelism, (
+                trial, total_batches, n_triggers)
+
     def test_worker_death_in_online_state_fails_run_promptly(self):
         """A worker crash mid-online-stream must unwind the topology with
         the root cause, not hang (A4 fail-fast; VERDICT r2 task 2)."""
