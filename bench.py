@@ -3,7 +3,8 @@
 Headline metric: ratings/sec/chip for DSGD on the ML-25M-shaped skewed
 workload (162K users x 59K items, ~23.7M train ratings) at rank 128, with
 wall-clock to a pre-registered RMSE target and achieved-bandwidth/MFU
-accounting. Extra lines: bucketed ALS rows-solved/s at rank 128 and 256,
+accounting. Extra lines: bucketed ALS rows-solved/s at rank 64 (the
+round-2 comparison), 128 (+implicit) and 256,
 sustained online-stream ratings/s at rank 128, and PS-mode throughput.
 
 The baseline for ``vs_baseline`` is the reference's own inner-loop style —
