@@ -203,6 +203,49 @@ class TestOnlineMF:
         assert s[1] == 0.0
         assert s[0] != 0.0
 
+    @pytest.mark.slow
+    def test_fuzz_pathological_streams(self):
+        """Adversarial micro-batch patterns: single-rating batches, all-one-
+        user batches, duplicate-heavy batches, and id ranges that force
+        repeated capacity growth mid-stream — every batch must apply
+        cleanly, tables stay finite, mappings stay consistent."""
+        import numpy as np
+
+        rng = np.random.default_rng(123)
+        m = OnlineMF(OnlineMFConfig(num_factors=4, learning_rate=0.05,
+                                    minibatch_size=32, init_capacity=16))
+        seen_users: set = set()
+        for trial in range(30):
+            kind = trial % 4
+            if kind == 0:  # tiny batch
+                n = int(rng.integers(1, 4))
+                u = rng.integers(0, 50, n)
+                i = rng.integers(0, 40, n)
+            elif kind == 1:  # all one user, duplicate items
+                n = 64
+                u = np.full(n, int(rng.integers(0, 1000)))
+                i = rng.integers(0, 3, n)
+            elif kind == 2:  # fresh id block far beyond capacity
+                n = 100
+                base = 1000 * (trial + 1)
+                u = np.arange(base, base + n)
+                i = np.arange(base, base + n)
+            else:  # heavy duplicates both sides
+                n = 128
+                u = rng.integers(0, 5, n)
+                i = rng.integers(0, 5, n)
+            r = rng.normal(0, 0.5, n).astype(np.float32)
+            ups = m.partial_fit(Ratings.from_arrays(u, i, r))
+            ids, vecs = ups.user_arrays
+            assert set(ids.tolist()) == set(np.unique(u).tolist()), trial
+            assert np.isfinite(vecs).all(), trial
+            seen_users.update(u.tolist())
+        # table capacity grew past every id; every seen id maps to a
+        # distinct live row
+        rows = m.users.rows_for(np.asarray(sorted(seen_users)))[0]
+        assert len(set(rows.tolist())) == len(seen_users)
+        assert np.isfinite(np.asarray(m.users.array)).all()
+
     def test_pluggable_updater(self):
         """The updater seam accepts any FactorUpdater impl
         (≙ FlinkOnlineMF.scala:19-23 injectable factorUpdate)."""
