@@ -11,9 +11,12 @@ The baseline for ``vs_baseline`` is the reference's own inner-loop style —
 a sequential per-rating NumPy SGD loop, the direct analogue of
 DSGDforMF.scala:398-417 (netlib ddot per rating) — measured on this host.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
-Context rides in "extra" and on stderr; a hard failure still prints the
-JSON line with an "error" field.
+Contract: the LAST stdout line is the result JSON
+{"metric", "value", "unit", "vs_baseline", ...}. (The child also prints
+the headline line EARLY — before the extra benchmark lines run — so a
+timeout mid-extras can be salvaged by the parent; consumers must parse
+the last line, as the driver does.) Context rides in "extra" and on
+stderr; a hard failure still prints the JSON line with an "error" field.
 
 Structure (round-1 lesson: one backend failure must not cost the round its
 perf evidence): the parent process never imports jax. It runs the real
