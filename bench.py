@@ -99,6 +99,16 @@ def run_child() -> None:
     # predict-zero — measured, not assumed (ALS plateaus at the data std).
     rmse_target = float(os.environ.get("BENCH_RMSE_TARGET", 0.155))
     skip_extras = os.environ.get("BENCH_SKIP_EXTRAS") == "1"
+    # Vocab overrides: reduced runs MUST shrink the user/item space with
+    # nnz — below ~100 obs/row the planted structure is unrecoverable by
+    # any solver (docs/PERF.md) and the RMSE curve carries no information.
+    num_users = (int(os.environ["BENCH_USERS"])
+                 if os.environ.get("BENCH_USERS") else None)
+    num_items = (int(os.environ["BENCH_ITEMS"])
+                 if os.environ.get("BENCH_ITEMS") else None)
+    # effective vocab for labels: ml-25m shape with any overrides applied
+    eff_users = num_users if num_users is not None else 162_541
+    eff_items = num_items if num_items is not None else 59_047
 
     if os.environ.get("BENCH_FORCE_CPU") == "1":
         from large_scale_recommendation_tpu.utils.platform import force_cpu
@@ -150,10 +160,13 @@ def run_child() -> None:
         extra["pipeline"] = "host"
         t0 = time.perf_counter()
         train, holdout = synthetic_like("ml-25m", nnz=nnz, rank=16,
-                                        noise=0.1, seed=0, skew_lam=2.0)
+                                        noise=0.1, seed=0, skew_lam=2.0,
+                                        num_users=num_users,
+                                        num_items=num_items)
         extra["gen_wall_s"] = round(time.perf_counter() - t0, 1)
         ru, ri, rv, _ = train.to_numpy()
         base_sample = (ru, ri, rv)
+        train_nnz = len(ru)
 
         t0 = time.perf_counter()
         problem = blocking.block_problem(train, num_blocks=blocks, seed=0,
@@ -194,9 +207,11 @@ def run_child() -> None:
         extra["pipeline"] = "device"
         t0 = time.perf_counter()
         (du, di, dr), (dhu, dhi, dhv), (nu, ni) = synthetic_like_device(
-            "ml-25m", nnz=nnz, rank=16, noise=0.1, seed=0, skew_lam=2.0)
+            "ml-25m", nnz=nnz, rank=16, noise=0.1, seed=0, skew_lam=2.0,
+            num_users=num_users, num_items=num_items)
         jax.block_until_ready(dr)
         extra["gen_wall_s"] = round(time.perf_counter() - t0, 1)
+        train_nnz = int(du.shape[0])
 
         # BENCH_SORT=user|item: intra-minibatch locality ordering (pure
         # gather/scatter-locality lever, math unchanged — docs/PERF.md)
@@ -297,7 +312,10 @@ def run_child() -> None:
             sweeps_to_target = it + 1
             break
     sweeps = sweeps_to_target or max_iters
-    throughput = nnz * sweeps / train_wall
+    # normalize to the ratings actually visited per sweep (the 95% train
+    # split), not the total generated nnz — ADVICE r3
+    throughput = train_nnz * sweeps / train_wall
+    extra["train_nnz"] = train_nnz
 
     # roofline accounting: per rating ~4 row transactions (read+write of a
     # u row and a v row) of rank*4 bytes + 16B of COO stream; FLOPs ~6*rank
@@ -312,7 +330,7 @@ def run_child() -> None:
              + extra.get("device_put_wall_s", 0)
              + extra.get("compile_wall_s", 0))
     extra["e2e_ratings_per_s_incl_setup"] = round(
-        nnz * sweeps / (train_wall + setup), 1)
+        train_nnz * sweeps / (train_wall + setup), 1)
     extra.update({
         "dsgd_train_wall_s": round(train_wall, 2),
         "dsgd_sweeps": sweeps,
@@ -330,9 +348,13 @@ def run_child() -> None:
     baseline = _numpy_sequential_baseline(*base_sample, rank)
     extra["numpy_seq_baseline_ratings_per_s"] = round(baseline, 1)
 
+    shape_lbl = ("ML-25M-shaped skewed" if num_users is None
+                 and num_items is None else
+                 f"{eff_users}x{eff_items} skewed (reduced vocab)")
+
     def result_line() -> dict:
         return {
-            "metric": (f"ratings/sec/chip (DSGD, ML-25M-shaped skewed, "
+            "metric": (f"ratings/sec/chip (DSGD, {shape_lbl}, "
                        f"rank={rank}, {nnz/1e6:.1f}M ratings, "
                        f"{blocks}x{blocks} strata)"),
             "value": round(throughput, 1),
@@ -648,19 +670,45 @@ def main() -> None:
     _cpu_fallback(per_attempt, errors)
 
 
+# Reduced fallback config in the RECOVERABLE regime: the vocab shrinks
+# WITH nnz so obs/row stays ≥ ~100 (docs/PERF.md) — 950K train ratings
+# over 8192 users (~116/user) × 3072 items (~309/item). The r3 fallback
+# ran 1M nnz over the full 162K×59K vocab (~6 obs/user): below the bound,
+# its RMSE curve ROSE and time-to-target was null — throughput with zero
+# convergence information. The 0.135 target is pre-registered from a
+# measured CPU run of exactly this config (descending curve 0.272 → 0.134,
+# target hit at sweep 12 of 20). Module-level so
+# tests/test_bench_contract.py pins the regime against config drift.
+CPU_FALLBACK_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "BENCH_FORCE_CPU": "1",
+    "BENCH_NNZ": "1000000",
+    "BENCH_USERS": "8192",
+    "BENCH_ITEMS": "3072",
+    "BENCH_RANK": "32",
+    "BENCH_ITERS": "20",
+    "BENCH_MB": "8192",
+    "BENCH_BLOCKS": "4",
+    "BENCH_RMSE_TARGET": "0.135",
+    "BENCH_SKIP_EXTRAS": "1",
+}
+
+
 def _cpu_fallback(per_attempt: float, errors: list[str]) -> None:
     """CPU fallback on a reduced workload — a real (if slower) number beats
     no number; the error field records the per-attempt failures."""
-    cpu_env = {
-        "JAX_PLATFORMS": "cpu",
-        "BENCH_FORCE_CPU": "1",
-        "BENCH_NNZ": os.environ.get("BENCH_NNZ_CPU", "1000000"),
-        "BENCH_RANK": "32",
-        "BENCH_ITERS": "3",
-        "BENCH_MB": "8192",
-        "BENCH_BLOCKS": "4",
-        "BENCH_SKIP_EXTRAS": "1",
-    }
+    cpu_env = dict(CPU_FALLBACK_ENV)
+    nnz_cpu = os.environ.get("BENCH_NNZ_CPU")
+    if nnz_cpu:
+        # scale the vocab WITH the nnz override so obs/row (and thus the
+        # pre-registered target's reachability) is preserved — otherwise
+        # the override silently re-enters the unrecoverable regime
+        scale = int(nnz_cpu) / int(cpu_env["BENCH_NNZ"])
+        cpu_env["BENCH_NNZ"] = nnz_cpu
+        cpu_env["BENCH_USERS"] = str(
+            max(256, int(int(cpu_env["BENCH_USERS"]) * scale)))
+        cpu_env["BENCH_ITEMS"] = str(
+            max(128, int(int(cpu_env["BENCH_ITEMS"]) * scale)))
     result, tail, _ = _attempt(cpu_env, per_attempt)
     if result is not None:
         result["error"] = (

@@ -107,6 +107,8 @@ def synthetic_like_device(
     noise: float = 0.3,
     seed: int = 0,
     skew_lam: float | None = 2.0,
+    num_users: int | None = None,
+    num_items: int | None = None,
 ):
     """Device-resident ``synthetic_like``: planted-low-rank train/holdout
     batches with the named dataset's shape statistics.
@@ -114,10 +116,17 @@ def synthetic_like_device(
     Returns ``((u, i, r), (hu, hi, hr), (num_users, num_items))`` — all six
     arrays live on device; nothing but the PRNG key crosses the link.
     Same 95/5 split-by-volume contract as ``data.movielens.synthetic_like``.
+
+    ``num_users``/``num_items`` override the named shape — for reduced runs
+    that must shrink the VOCAB along with nnz so obs/row stays in the
+    recoverable regime (≥ ~100 per docs/PERF.md; below it the planted
+    structure is unlearnable by any solver and RMSE curves are noise).
     """
     if name not in _SHAPES:
         raise KeyError(f"unknown dataset {name!r}; have {sorted(_SHAPES)}")
     nu, ni, n_default = _SHAPES[name]
+    nu = int(num_users) if num_users is not None else nu
+    ni = int(num_items) if num_items is not None else ni
     n = int(nnz if nnz is not None else n_default)
     n_train = int(n * 0.95)
     base = jax.random.PRNGKey(seed)
@@ -212,21 +221,43 @@ def validate_dense_ids(u, i, num_users: int, num_items: int,
     """Fail fast on out-of-range ids, BEFORE any int32 cast — an int64 host
     array with a wild id would otherwise wrap around the cast and pass a
     post-cast range check as a plausible small id. Shared by every dense-id
-    device entry point (device blocking, DSGD/ALS fit_device)."""
-    def rng(a):
-        if isinstance(a, jax.Array):
-            return int(a.min()), int(a.max())
-        a = np.asarray(a)
-        return int(a.min()), int(a.max())
+    device entry point (device blocking, DSGD/ALS fit_device).
 
-    lo_u, hi_u = rng(u)
-    lo_i, hi_i = rng(i)
+    Host arrays reduce on host in their NATIVE dtype (free, and immune to
+    the int64→int32 wrap this check exists to catch); when BOTH sides are
+    already device arrays, their four min/max reductions fuse into one
+    jitted call so exactly ONE device→host sync crosses a narrow tunneled
+    link (ADVICE r3). A host array is never shipped to device here."""
+    if isinstance(u, jax.Array) and isinstance(i, jax.Array):
+        ranges = np.asarray(_id_ranges(u, i))
+        lo_u, hi_u, lo_i, hi_i = (int(x) for x in ranges)
+    else:
+        def rng(a):
+            if isinstance(a, jax.Array):
+                mm = np.asarray(_minmax(a))  # one sync for this side
+                return int(mm[0]), int(mm[1])
+            a = np.asarray(a)
+            return int(a.min()), int(a.max())
+
+        lo_u, hi_u = rng(u)
+        lo_i, hi_i = rng(i)
     if lo_u < 0 or hi_u >= num_users or lo_i < 0 or hi_i >= num_items:
         raise ValueError(
             f"{ctx} needs dense ids in [0, num_users) × [0, num_items); "
             f"got user range [{lo_u}, {hi_u}] vs {num_users}, item range "
             f"[{lo_i}, {hi_i}] vs {num_items}. Arbitrary external ids go "
             "through the host path (data.blocking).")
+
+
+@jax.jit
+def _id_ranges(u, i):
+    """min/max of both id vectors in one device array → one host readback."""
+    return jnp.stack([u.min(), u.max(), i.min(), i.max()])
+
+
+@jax.jit
+def _minmax(a):
+    return jnp.stack([a.min(), a.max()])
 
 
 def rows_per_block(n_ids: int, num_blocks: int, row_multiple: int = 8) -> int:

@@ -67,16 +67,22 @@ _SHAPES = {
 
 def synthetic_like(name: str, nnz: int | None = None, rank: int = 16,
                    noise: float = 0.3, seed: int = 0,
-                   skew_lam: float = 2.0) -> tuple[Ratings, Ratings]:
+                   skew_lam: float = 2.0,
+                   num_users: int | None = None,
+                   num_items: int | None = None) -> tuple[Ratings, Ratings]:
     """A planted-low-rank workload with the named dataset's shape statistics
     (skewed id draws — real rating matrices are power-law).
 
     Returns (train, test) with a 95/5 split by volume. The stand-in for
     benchmark runs where the real files aren't present (zero-egress hosts).
+    ``num_users``/``num_items`` override the named shape (reduced runs must
+    shrink the vocab with nnz to stay ≥ ~100 obs/row — docs/PERF.md).
     """
     if name not in _SHAPES:
         raise KeyError(f"unknown dataset {name!r}; have {sorted(_SHAPES)}")
     nu, ni, n = _SHAPES[name]
+    nu = int(num_users) if num_users is not None else nu
+    ni = int(num_items) if num_items is not None else ni
     n = nnz if nnz is not None else n
     gen = SyntheticMFGenerator(num_users=nu, num_items=ni, rank=rank,
                                noise=noise, seed=seed, skew_lam=skew_lam)

@@ -306,8 +306,9 @@ class AdaptiveMF:
 
     # -- scoring ------------------------------------------------------------
 
-    def predict(self, user_ids, item_ids) -> np.ndarray:
-        return self.online.predict(user_ids, item_ids)
+    def predict(self, user_ids, item_ids, return_mask: bool = False):
+        return self.online.predict(user_ids, item_ids,
+                                   return_mask=return_mask)
 
     def rmse(self, data: Ratings) -> float:
         return self.online.rmse(data)

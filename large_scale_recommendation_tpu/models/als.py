@@ -193,9 +193,9 @@ class ALS:
 
     # -- scoring passthroughs (same surface as DSGD) -----------------------
 
-    def predict(self, user_ids, item_ids):
+    def predict(self, user_ids, item_ids, return_mask: bool = False):
         self._require_fitted()
-        return self.model.predict(user_ids, item_ids)
+        return self.model.predict(user_ids, item_ids, return_mask=return_mask)
 
     def empirical_risk(self, data: Ratings) -> float:
         self._require_fitted()

@@ -259,9 +259,9 @@ class DSGD:
     # -- scoring passthroughs (Predictor-style surface,
     #    MatrixFactorization.scala:239-274,133-192) ------------------------
 
-    def predict(self, user_ids, item_ids):
+    def predict(self, user_ids, item_ids, return_mask: bool = False):
         self._require_fitted()
-        return self.model.predict(user_ids, item_ids)
+        return self.model.predict(user_ids, item_ids, return_mask=return_mask)
 
     def empirical_risk(self, data: Ratings) -> float:
         self._require_fitted()

@@ -22,6 +22,16 @@ from large_scale_recommendation_tpu.data.blocking import IdIndex
 from large_scale_recommendation_tpu.ops import sgd as sgd_ops
 
 
+def masked_scores(scores, u_mask, i_mask, return_mask: bool):
+    """The reference's join-drop contract, defined ONCE for every predict
+    surface (MatrixFactorization.scala:250-265): pairs whose user or item
+    was never seen score 0.0, and ``return_mask=True`` additionally returns
+    the bool ``seen`` mask (True = the reference's inner join keeps it)."""
+    seen = (np.asarray(u_mask) * np.asarray(i_mask)) > 0
+    out = np.asarray(scores) * seen
+    return (out, seen) if return_mask else out
+
+
 @dataclasses.dataclass
 class MFModel:
     """A trained (or in-training) factorization: U, V on device + id maps.
@@ -41,18 +51,24 @@ class MFModel:
 
     # -- scoring ------------------------------------------------------------
 
-    def predict(self, user_ids: np.ndarray, item_ids: np.ndarray) -> np.ndarray:
+    def predict(self, user_ids: np.ndarray, item_ids: np.ndarray,
+                return_mask: bool = False):
         """Score (user, item) pairs. Pairs whose user OR item was never seen
         score 0.0 — the reference's join simply drops them
         (MatrixFactorization.scala:250-265); a dense API needs a value, and 0
         is the "no information" score.
+
+        With ``return_mask=True`` the return is ``(scores, seen)`` where
+        ``seen`` is a bool array, True exactly for the pairs the reference's
+        inner join would have kept — so callers can distinguish "model says
+        0" from "never seen" without reaching into ``IdIndex`` themselves.
         """
         u_rows, u_mask = self.users.rows_for(np.asarray(user_ids))
         i_rows, i_mask = self.items.rows_for(np.asarray(item_ids))
         scores = sgd_ops.predict_rows(
             self.U, self.V, jnp.asarray(u_rows), jnp.asarray(i_rows)
         )
-        return np.asarray(scores) * u_mask * i_mask
+        return masked_scores(scores, u_mask, i_mask, return_mask)
 
     def empirical_risk(self, data: Ratings, lambda_: float = 1.0) -> float:
         """Σ residual² + λ(‖u‖²+‖v‖²) over labeled points

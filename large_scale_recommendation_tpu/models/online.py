@@ -249,16 +249,19 @@ class OnlineMF:
 
     # -- scoring -----------------------------------------------------------
 
-    def predict(self, user_ids, item_ids) -> np.ndarray:
+    def predict(self, user_ids, item_ids, return_mask: bool = False):
         """Score pairs against the live model; unseen ids score 0
-        (MFModel.predict semantics)."""
+        (MFModel.predict semantics). ``return_mask=True`` → ``(scores,
+        seen)`` with the reference's join-drop set exposed."""
         u_rows, u_mask = self.users.rows_for(np.asarray(user_ids))
         i_rows, i_mask = self.items.rows_for(np.asarray(item_ids))
         scores = sgd_ops.predict_rows(
             self.users.array, self.items.array,
             jnp.asarray(u_rows), jnp.asarray(i_rows),
         )
-        return np.asarray(scores) * u_mask * i_mask
+        from large_scale_recommendation_tpu.models.mf import masked_scores
+
+        return masked_scores(scores, u_mask, i_mask, return_mask)
 
     def rmse(self, data: Ratings) -> float:
         ru, ri, rv, rw = data.to_numpy()

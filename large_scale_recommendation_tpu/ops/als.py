@@ -304,7 +304,10 @@ def device_prepare_side(
     # classes whose pow2 ≤ min_pad share one min_pad bucket (they are
     # adjacent in row_order, so it's a single contiguous slice) — same
     # grouping as the host path's unique-pad buckets
-    assert min_pad & (min_pad - 1) == 0, "min_pad must be a power of 2"
+    if min_pad <= 0 or min_pad & (min_pad - 1) != 0:
+        # not an assert: under python -O a non-pow2 min_pad would silently
+        # mis-group the small pad classes (rows dropped/duplicated)
+        raise ValueError(f"min_pad must be a power of 2, got {min_pad}")
     m = min_pad.bit_length() - 1
     groups = [(min_pad, 0, int(rpc[: m + 1].sum()))]
     groups += [(1 << cls, int(offsets[cls]), int(rpc[cls]))

@@ -549,7 +549,7 @@ class PSOnlineBatchMF:
 
     # -- scoring (same contract as ps.mf) ------------------------------------
 
-    def predict(self, user_ids, item_ids) -> np.ndarray:
+    def predict(self, user_ids, item_ids, return_mask: bool = False):
         from large_scale_recommendation_tpu.ps.mf import PSOfflineMF
 
         user_ids = np.asarray(user_ids, dtype=np.int64)
@@ -557,7 +557,10 @@ class PSOnlineBatchMF:
         rank = self.config.num_factors
         uu, u_ok = PSOfflineMF._lookup(self.user_factors, user_ids, rank)
         vv, i_ok = PSOfflineMF._lookup(self.item_factors, item_ids, rank)
-        return np.einsum("nk,nk->n", uu, vv) * u_ok * i_ok
+        from large_scale_recommendation_tpu.models.mf import masked_scores
+
+        return masked_scores(np.einsum("nk,nk->n", uu, vv), u_ok, i_ok,
+                             return_mask)
 
     def rmse(self, data: Ratings) -> float:
         """RMSE over pairs whose user AND item are known (predict masks

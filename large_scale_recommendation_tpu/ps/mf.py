@@ -259,15 +259,18 @@ class PSOfflineMF:
         vecs = mat[pos] if len(keys) else np.zeros((len(ids), rank), np.float32)
         return vecs, found
 
-    def predict(self, user_ids, item_ids) -> np.ndarray:
+    def predict(self, user_ids, item_ids, return_mask: bool = False):
         """Pairs with an unseen user OR item score 0 (MFModel.predict
-        semantics)."""
+        semantics). ``return_mask=True`` → ``(scores, seen)``."""
         user_ids = np.asarray(user_ids, dtype=np.int64)
         item_ids = np.asarray(item_ids, dtype=np.int64)
         rank = self.config.num_factors
         uu, u_ok = self._lookup(self.user_factors, user_ids, rank)
         vv, i_ok = self._lookup(self.item_factors, item_ids, rank)
-        return np.einsum("nk,nk->n", uu, vv) * u_ok * i_ok
+        from large_scale_recommendation_tpu.models.mf import masked_scores
+
+        return masked_scores(np.einsum("nk,nk->n", uu, vv), u_ok, i_ok,
+                             return_mask)
 
     def rmse(self, data: Ratings) -> float:
         ru, ri, rv, rw = data.to_numpy()

@@ -56,6 +56,7 @@ def main() -> None:
           f"sweeps={sweeps}", flush=True)
     (u, i, r), (hu, hi, hr), (nu, ni) = synthetic_like_device(
         "ml-25m", nnz=nnz, rank=16, noise=0.1, seed=0, skew_lam=2.0)
+    train_nnz = int(u.shape[0])  # 95% split — ratings visited per sweep
     upd = RegularizedSGDUpdater(0.3, 0.1, warm_boost_lr())
 
     for mb in mbs:
@@ -82,7 +83,7 @@ def main() -> None:
                 walls.append(time.perf_counter() - t0)
             sse = sgd_ops.sse_rows(U, V, hur, hir, hr, hmask)
             rmse = float(np.sqrt(float(sse) / n_eval))
-            rate = nnz / (sum(walls) / len(walls))
+            rate = train_nnz / (sum(walls) / len(walls))
             print(f"mb={mb:6d} sort={sort:5s} "
                   f"sweep_s={sum(walls)/len(walls):7.3f} "
                   f"ratings_per_s={rate:12.0f} "

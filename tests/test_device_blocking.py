@@ -349,6 +349,27 @@ class TestDeviceBlocking:
                                    np.asarray(resumed.U), rtol=1e-5)
 
     @pytest.mark.slow
+    def test_validate_dense_ids_mixed_host_device_no_int32_wrap(self):
+        """A wild int64 id in a HOST array must fail validation even when
+        the other side is a device array — the mixed path must not route
+        the host array through a device cast (int64→int32 wrap would turn
+        2^32+5 into a plausible small id that passes the range check)."""
+        import jax.numpy as jnp
+        wild = np.array([0, 2**32 + 5], np.int64)  # wraps to 5 in int32
+        dev_ok = jnp.array([0, 1], jnp.int32)
+        with pytest.raises(ValueError, match="dense ids"):
+            device_blocking.validate_dense_ids(dev_ok, wild, 100, 100, "t")
+        with pytest.raises(ValueError, match="dense ids"):
+            device_blocking.validate_dense_ids(wild, dev_ok, 100, 100, "t")
+        # all-device path: fused single-readback check still rejects
+        with pytest.raises(ValueError, match="dense ids"):
+            device_blocking.validate_dense_ids(
+                dev_ok, jnp.array([0, 100], jnp.int32), 100, 100, "t")
+        # and accepts in-range input in every combination
+        device_blocking.validate_dense_ids(dev_ok, dev_ok, 100, 100, "t")
+        device_blocking.validate_dense_ids(
+            np.array([0, 1]), dev_ok, 100, 100, "t")
+
     def test_fuzz_layout_invariants(self):
         """Randomized shapes/skews/weights: the layout contract must hold
         for every draw (multiset preservation, stratum property, weighted

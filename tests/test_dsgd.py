@@ -160,6 +160,22 @@ class TestDSGDConvergence:
         scores = model.predict(np.array([0, 99999]), np.array([0, 0]))
         assert scores[1] == 0.0
 
+    def test_predict_return_mask_exposes_join_drop(self):
+        """The reference's predict silently drops unseen pairs
+        (MatrixFactorization.scala:250-265); return_mask=True surfaces that
+        join-drop set so 'model says 0' ≠ 'never seen'."""
+        gen = SyntheticMFGenerator(num_users=30, num_items=30, rank=4, seed=4)
+        model = DSGD(DSGDConfig(num_factors=4, iterations=2,
+                                minibatch_size=64)).fit(gen.generate(500))
+        u = np.array([0, 99999, 0])
+        i = np.array([0, 0, 99999])
+        scores, seen = model.predict(u, i, return_mask=True)
+        assert seen.dtype == bool
+        np.testing.assert_array_equal(seen, [True, False, False])
+        assert scores[1] == 0.0 and scores[2] == 0.0
+        # default call unchanged
+        np.testing.assert_array_equal(model.predict(u, i), scores)
+
     def test_unfitted_predict_raises(self):
         with pytest.raises(RuntimeError):
             DSGD().predict(np.array([1]), np.array([1]))
