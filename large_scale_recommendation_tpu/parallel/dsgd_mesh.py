@@ -35,7 +35,7 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
 
 from large_scale_recommendation_tpu.core.types import Ratings
@@ -302,22 +302,40 @@ class MeshDSGD:
         paths. Same kind-tagging contract as the single-device driver
         (models/dsgd.py ``_train_segments``): host-blocked and
         device-blocked row layouts are permutation-incompatible, so
-        cross-path resume is refused."""
+        cross-path resume is refused.
+
+        Checkpoints are PER-SHARD (``ShardedCheckpointManager``): each
+        process writes only the rows its devices hold, and restore
+        re-shards — no full-model gather anywhere, so the save path works
+        at scales where the factors cannot fit one host. A plain
+        ``CheckpointManager`` is accepted for API compatibility and is
+        re-targeted at the same directory in the sharded format."""
         from large_scale_recommendation_tpu.utils.checkpoint import (
-            restore_segment_state,
+            CheckpointManager,
+            ShardedCheckpointManager,
+            restore_segment_state_sharded,
         )
+
+        if isinstance(checkpoint_manager, CheckpointManager):
+            checkpoint_manager = ShardedCheckpointManager(
+                checkpoint_manager.directory, keep=checkpoint_manager.keep)
 
         cfg = self.config
         k = self.num_blocks
         done = 0
-        if resume:
-            if checkpoint_manager is None:
-                raise ValueError("resume=True requires a checkpoint_manager")
-            U, V, done = restore_segment_state(checkpoint_manager, kind, U, V)
 
         shard = block_sharding(self.mesh)
         put = lambda x: jax.device_put(jnp.asarray(x), shard)
-        U, V = put(U), put(V)
+        if resume:
+            if checkpoint_manager is None:
+                raise ValueError("resume=True requires a checkpoint_manager")
+            # host U/V go in directly: on a successful restore only their
+            # shape/dtype are read, so the fresh init tables are never
+            # shipped to device just to be discarded
+            U, V, done = restore_segment_state_sharded(
+                checkpoint_manager, kind, U, V, sharding=shard)
+        else:
+            U, V = put(U), put(V)
         args = tuple(put(x) for x in strata)
         ou, ov = put(omega_u), put(omega_v)
         with_inv = bool(inv_args)
@@ -334,17 +352,11 @@ class MeshDSGD:
                            jnp.asarray(done, jnp.int32))
             done += seg
             if checkpoint_manager is not None:
-                # On a multi-process mesh the shards of U/V are not all
-                # addressable — gather to a fully-replicated layout first
-                # (np.asarray on a replicated global array is legal on every
-                # process), and let only process 0 write so hosts don't race
-                # on the checkpoint path.
-                rep = NamedSharding(self.mesh, P())
-                Uh, Vh = jax.jit(lambda u, v: (u, v),
-                                 out_shardings=(rep, rep))(U, V)
-                if jax.process_index() == 0:
-                    checkpoint_manager.save(
-                        done, {"U": np.asarray(Uh), "V": np.asarray(Vh)},
-                        {"kind": kind, "iterations": cfg.iterations},
-                    )
+                # every process writes its OWN device shards; no gather,
+                # no replicated copy of the model anywhere
+                jax.block_until_ready((U, V))
+                checkpoint_manager.save(
+                    done, {"U": U, "V": V},
+                    {"kind": kind, "iterations": cfg.iterations},
+                )
         return U, V

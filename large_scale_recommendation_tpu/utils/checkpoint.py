@@ -147,6 +147,251 @@ def restore_segment_state(manager: CheckpointManager, kind: str, U, V):
     return jnp.asarray(ck["U"]), jnp.asarray(ck["V"]), latest
 
 
+# -- sharded (mesh / multi-host) checkpoints ---------------------------------
+
+
+class ShardedCheckpointManager:
+    """Per-shard snapshots for mesh-sharded factor tables — NO full-model
+    gather anywhere in the save path.
+
+    The replicate-then-save scheme this replaces re-sharded U/V to
+    fully-replicated at every segment boundary; at the blueprint's pod
+    scale (10M×1M rank 512 ≈ 44 GB of factors) that gather cannot fit one
+    host. Here every process writes only the rows its OWN devices hold
+    (``ckpt_<step>.shard<pid>of<nproc>.npz``: row-start offsets + data per
+    array, replicated shards deduped), and process 0 writes a manifest
+    naming the expected shard files — the durable analogue of the
+    reference's per-partition TemporaryPath barrier
+    (DSGDforMF.scala:291-296), which likewise persisted partition files,
+    never a collected model. A checkpoint is complete iff manifest + all
+    shard files exist; restore re-shards via ``make_array_from_callback``
+    so a process only ever materializes the rows its devices need.
+
+    Requires a directory visible to all processes (shared fs — the same
+    assumption the reference's TemporaryPath makes). Layout portability
+    matches the plain manager's contract: same mesh shape + same sharding
+    on save and restore.
+    """
+
+    _MANIFEST = re.compile(r"^ckpt_(\d+)\.manifest\.json$")
+
+    def __init__(self, directory: str, keep: int = 3):
+        self.directory = directory
+        self.keep = keep
+        os.makedirs(directory, exist_ok=True)
+
+    # -- write ---------------------------------------------------------------
+
+    def save(self, step: int, arrays: dict, meta: dict | None = None) -> str:
+        """Write this process's shards (+ manifest on process 0), then
+        sweep retention. ``arrays`` values are jax global arrays sharded
+        over dim 0 (rows); replicated duplicates are deduped by offset."""
+        import jax
+
+        pid, nproc = jax.process_index(), jax.process_count()
+        payload: dict[str, np.ndarray] = {}
+        for key, arr in arrays.items():
+            pieces: dict[int, np.ndarray] = {}
+            for sh in arr.addressable_shards:
+                # the dedupe-by-row-offset below is only sound for pure
+                # dim-0 (row) sharding — a dim-1 shard would alias offset 0
+                # and silently drop columns, so refuse loudly instead
+                for sl, dim in zip(sh.index[1:], arr.shape[1:]):
+                    if (sl.start not in (None, 0)
+                            or sl.stop not in (None, dim)):
+                        raise ValueError(
+                            f"{key} is sharded over a non-row dimension "
+                            f"({sh.index}); ShardedCheckpointManager "
+                            "requires dim-0 (row) sharding only")
+                r = sh.index[0] if sh.index else slice(None)
+                start = int(r.start or 0)
+                if start not in pieces:
+                    pieces[start] = np.asarray(sh.data)
+            starts = sorted(pieces)
+            payload[f"{key}__starts"] = np.asarray(starts, np.int64)
+            payload[f"{key}__lens"] = np.asarray(
+                [len(pieces[s]) for s in starts], np.int64)
+            for j, s in enumerate(starts):
+                payload[f"{key}__p{j}"] = pieces[s]
+        shard_name = f"ckpt_{step}.shard{pid}of{nproc}.npz"
+        fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez(f, **payload)
+            os.replace(tmp, os.path.join(self.directory, shard_name))
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
+        if pid == 0:
+            manifest = {
+                "step": step,
+                "nproc": nproc,
+                "shards": [f"ckpt_{step}.shard{p}of{nproc}.npz"
+                           for p in range(nproc)],
+                "arrays": {k: {"shape": list(v.shape),
+                               "dtype": str(v.dtype)}
+                           for k, v in arrays.items()},
+                "meta": meta or {},
+            }
+            mpath = os.path.join(self.directory,
+                                 f"ckpt_{step}.manifest.json")
+            fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "w") as f:
+                    json.dump(manifest, f)
+                os.replace(tmp, mpath)
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+            self._retain()
+        return shard_name
+
+    def _retain(self) -> None:
+        steps = self.steps()  # complete checkpoints only
+        for s in steps[: max(0, len(steps) - self.keep)]:
+            for name in os.listdir(self.directory):
+                if name.startswith(f"ckpt_{s}."):
+                    os.unlink(os.path.join(self.directory, name))
+
+    # -- read ----------------------------------------------------------------
+
+    def _manifest(self, step: int) -> dict:
+        path = os.path.join(self.directory, f"ckpt_{step}.manifest.json")
+        with open(path) as f:
+            return json.load(f)
+
+    def _is_complete(self, step: int) -> bool:
+        try:
+            m = self._manifest(step)
+        except (OSError, json.JSONDecodeError):
+            return False
+        return all(os.path.exists(os.path.join(self.directory, s))
+                   for s in m["shards"])
+
+    def steps(self) -> list[int]:
+        out = []
+        for name in os.listdir(self.directory):
+            m = self._MANIFEST.match(name)
+            if m and self._is_complete(int(m.group(1))):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_step(self) -> int | None:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def meta(self, step: int) -> dict:
+        return self._manifest(step).get("meta", {})
+
+    def restore_array(self, step: int, key: str, sharding, shape, dtype):
+        """Rebuild one global array: serve each addressable device's
+        row-range from the saved pieces. Only pieces OVERLAPPING this
+        process's addressable rows are materialized (piece offsets+lengths
+        are read first, the data entries lazily) — no process ever holds
+        more rows than its devices address, which is the whole point at
+        scales where the full table cannot fit one host."""
+        import jax
+
+        m = self._manifest(step)
+        want = m["arrays"].get(key)
+        if want is None:
+            raise KeyError(f"checkpoint step {step} has no array {key!r}")
+        if tuple(want["shape"]) != tuple(shape):
+            raise ValueError(
+                f"checkpoint {key} shape {want['shape']} != {list(shape)} — "
+                "resumed fit must use the same ratings, seed, rank and "
+                "block count")
+        # union of row-ranges this process's devices address
+        mine: list[tuple[int, int]] = []
+        addressable = set(sharding.addressable_devices)
+        for d, idx in sharding.devices_indices_map(tuple(shape)).items():
+            if d not in addressable:
+                continue
+            r = idx[0] if idx else slice(None)
+            mine.append((int(r.start or 0),
+                         int(r.stop) if r.stop is not None
+                         else int(shape[0])))
+
+        def overlaps(lo: int, hi: int) -> bool:
+            return any(lo < b and a < hi for a, b in mine)
+
+        pieces: list[tuple[int, np.ndarray]] = []
+        for name in m["shards"]:
+            with np.load(os.path.join(self.directory, name)) as z:
+                if f"{key}__starts" not in z.files:
+                    continue
+                starts = z[f"{key}__starts"]
+                lens = z[f"{key}__lens"]
+                for j, (s, ln) in enumerate(zip(starts, lens)):
+                    if overlaps(int(s), int(s) + int(ln)):
+                        pieces.append((int(s), z[f"{key}__p{j}"]))
+        pieces.sort(key=lambda p: p[0])
+
+        def cb(index):
+            r = index[0] if index else slice(None)
+            start = int(r.start or 0)
+            stop = int(r.stop) if r.stop is not None else int(shape[0])
+            out = np.empty((stop - start,) + tuple(shape[1:]), dtype)
+            filled = 0
+            for s, data in pieces:
+                lo, hi = max(s, start), min(s + len(data), stop)
+                if lo < hi:
+                    out[lo - start: hi - start] = data[lo - s: hi - s]
+                    filled += hi - lo
+            if filled < stop - start:
+                raise ValueError(
+                    f"checkpoint step {step} is missing rows "
+                    f"[{start},{stop}) of {key} — shard layout mismatch")
+            return out[(slice(None),) + tuple(index[1:])] if index else out
+
+        return jax.make_array_from_callback(tuple(shape), sharding, cb)
+
+
+def restore_segment_state_sharded(manager: ShardedCheckpointManager,
+                                  kind: str, U, V, sharding=None):
+    """Mesh twin of ``restore_segment_state``. ``U``/``V`` may be HOST
+    arrays (only shape/dtype are read on the restore path — no wasted
+    full-model transfer before the restored tables replace them) with the
+    target ``sharding`` given explicitly, or already-sharded global arrays
+    (``sharding`` defaults to theirs). When no checkpoint exists the
+    inputs are placed with the target sharding and ``done=0`` returned.
+    Same kind-tag refusal contract (cross-path resume is silently-wrong
+    row permutation, so it errors)."""
+    import jax
+    import jax.numpy as jnp
+
+    latest = manager.latest_step()
+    if latest is None:
+        legacy = [n for n in os.listdir(manager.directory)
+                  if CheckpointManager._FILE.match(n)]
+        if legacy:
+            # silently returning done=0 here would restart training from
+            # scratch over a directory of real (old-format, monolithic)
+            # snapshots — and retention would later delete them
+            raise ValueError(
+                f"{manager.directory} holds legacy monolithic checkpoints "
+                f"({legacy[:3]}...) but no sharded manifest; restore them "
+                "with CheckpointManager.restore() and re-save, or point "
+                "the sharded manager at a fresh directory")
+        if sharding is not None:
+            U = jax.device_put(jnp.asarray(U), sharding)
+            V = jax.device_put(jnp.asarray(V), sharding)
+        return U, V, 0
+    meta = manager.meta(latest)
+    ck_kind = meta.get("kind")
+    if ck_kind != kind:
+        raise ValueError(
+            f"checkpoint kind {ck_kind!r} does not match this fit path "
+            f"({kind!r}) — host-blocked (fit) and device-blocked "
+            "(fit_device) row layouts are incompatible")
+    shard_u = sharding if sharding is not None else U.sharding
+    shard_v = sharding if sharding is not None else V.sharding
+    U2 = manager.restore_array(latest, "U", shard_u, np.shape(U), U.dtype)
+    V2 = manager.restore_array(latest, "V", shard_v, np.shape(V), V.dtype)
+    return U2, V2, latest
+
+
 # -- model-level helpers ------------------------------------------------------
 
 

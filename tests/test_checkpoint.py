@@ -205,28 +205,42 @@ class TestSegmentedMeshDSGD:
 
     def test_segmented_equals_straight_run(self, tmp_path):
         from large_scale_recommendation_tpu.parallel.dsgd_mesh import MeshDSGD
+        from large_scale_recommendation_tpu.utils.checkpoint import (
+            ShardedCheckpointManager,
+        )
 
         gen = SyntheticMFGenerator(num_users=64, num_items=48, rank=4, seed=5)
         train = gen.generate(4000)
         straight = MeshDSGD(self._mesh_cfg()).fit(train)
 
-        mgr = CheckpointManager(str(tmp_path))
+        mgr = ShardedCheckpointManager(str(tmp_path))
         segmented = MeshDSGD(self._mesh_cfg()).fit(
             train, checkpoint_manager=mgr, checkpoint_every=2)
         np.testing.assert_allclose(np.asarray(segmented.U),
                                    np.asarray(straight.U),
                                    rtol=1e-5, atol=1e-6)
         assert mgr.latest_step() == 6
+        # the save path must be shard files + manifest, and must never
+        # write a monolithic full-model snapshot
+        import os as _os
+        names = sorted(_os.listdir(tmp_path))
+        assert any(".shard0of" in n for n in names), names
+        assert any(n.endswith(".manifest.json") for n in names), names
+        assert not any(n.endswith(".npz") and ".shard" not in n
+                       for n in names), names
 
     def test_resume_from_partial(self, tmp_path):
         from large_scale_recommendation_tpu.parallel.dsgd_mesh import (
             MeshDSGD,
             MeshDSGDConfig,
         )
+        from large_scale_recommendation_tpu.utils.checkpoint import (
+            ShardedCheckpointManager,
+        )
 
         gen = SyntheticMFGenerator(num_users=64, num_items=48, rank=4, seed=6)
         train = gen.generate(4000)
-        mgr = CheckpointManager(str(tmp_path))
+        mgr = ShardedCheckpointManager(str(tmp_path))
         half = MeshDSGDConfig(num_factors=4, iterations=4, seed=0,
                               minibatch_size=64)
         MeshDSGD(half).fit(train, checkpoint_manager=mgr, checkpoint_every=2)
@@ -238,3 +252,80 @@ class TestSegmentedMeshDSGD:
         np.testing.assert_allclose(np.asarray(resumed.U),
                                    np.asarray(straight.U),
                                    rtol=1e-5, atol=1e-6)
+
+    def test_plain_manager_is_retargeted_to_sharded_format(self, tmp_path):
+        """API compatibility: passing a plain CheckpointManager to the mesh
+        driver writes the sharded format into the same directory (and a
+        ShardedCheckpointManager on that directory can resume from it)."""
+        from large_scale_recommendation_tpu.parallel.dsgd_mesh import MeshDSGD
+        from large_scale_recommendation_tpu.utils.checkpoint import (
+            ShardedCheckpointManager,
+        )
+
+        gen = SyntheticMFGenerator(num_users=64, num_items=48, rank=4, seed=7)
+        train = gen.generate(4000)
+        mgr = CheckpointManager(str(tmp_path))
+        MeshDSGD(self._mesh_cfg()).fit(
+            train, checkpoint_manager=mgr, checkpoint_every=3)
+        assert ShardedCheckpointManager(str(tmp_path)).latest_step() == 6
+
+
+class TestShardedManagerGuards:
+    def test_legacy_monolithic_dir_refused_on_resume(self, tmp_path):
+        """A directory of old-format monolithic snapshots must not be
+        silently restarted-over (and later swept) by the sharded manager."""
+        from large_scale_recommendation_tpu.utils.checkpoint import (
+            ShardedCheckpointManager,
+            restore_segment_state_sharded,
+        )
+
+        old = CheckpointManager(str(tmp_path))
+        old.save(3, {"U": np.zeros((4, 2), np.float32),
+                     "V": np.zeros((4, 2), np.float32)}, {"kind": "host"})
+        mgr = ShardedCheckpointManager(str(tmp_path))
+        with pytest.raises(ValueError, match="legacy monolithic"):
+            restore_segment_state_sharded(mgr, "host",
+                                          np.zeros((4, 2), np.float32),
+                                          np.zeros((4, 2), np.float32))
+
+    def test_non_row_sharding_refused_on_save(self, tmp_path):
+        """Column sharding would alias every shard to row-offset 0 and
+        silently drop columns — save must refuse it loudly."""
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from large_scale_recommendation_tpu.utils.checkpoint import (
+            ShardedCheckpointManager,
+        )
+
+        devs = jax.devices("cpu")[:2]
+        mesh = Mesh(np.asarray(devs), ("m",))
+        cols = jax.device_put(np.ones((4, 8), np.float32),
+                              NamedSharding(mesh, P(None, "m")))
+        mgr = ShardedCheckpointManager(str(tmp_path))
+        with pytest.raises(ValueError, match="non-row dimension"):
+            mgr.save(1, {"U": cols}, {})
+
+    def test_restore_array_only_reads_overlapping_pieces(self, tmp_path):
+        """Round-trip on an uneven host stand-in: restore serves each
+        device range from the right pieces and errors on missing rows."""
+        import jax
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from large_scale_recommendation_tpu.utils.checkpoint import (
+            ShardedCheckpointManager,
+        )
+
+        devs = jax.devices("cpu")[:4]
+        mesh = Mesh(np.asarray(devs), ("m",))
+        shard = NamedSharding(mesh, P("m"))
+        rng = np.random.default_rng(0)
+        A = rng.normal(size=(16, 3)).astype(np.float32)
+        g = jax.device_put(A, shard)
+        mgr = ShardedCheckpointManager(str(tmp_path))
+        mgr.save(2, {"U": g}, {"kind": "k"})
+        back = mgr.restore_array(2, "U", shard, (16, 3), np.float32)
+        np.testing.assert_array_equal(np.asarray(back), A)
+        # shape drift is a loud error
+        with pytest.raises(ValueError, match="shape"):
+            mgr.restore_array(2, "U", shard, (20, 3), np.float32)

@@ -52,10 +52,12 @@ class TestHostShard:
 
 @pytest.mark.slow
 class TestTwoProcessDemo:
-    def test_two_process_cpu_demo(self):
+    def test_two_process_cpu_demo(self, tmp_path):
         """Launch the demo as two REAL processes coordinated over localhost;
         the global 4-device mesh spans both, so the ppermute ring crosses
-        the process boundary (the DCN hop of SURVEY §2.3)."""
+        the process boundary (the DCN hop of SURVEY §2.3). LSR_CKPT_DIR
+        additionally exercises per-shard checkpoint save/restore across the
+        2-process mesh (each process writes only its own device rows)."""
         with socket.socket() as s:
             s.bind(("127.0.0.1", 0))
             port = s.getsockname()[1]
@@ -64,6 +66,7 @@ class TestTwoProcessDemo:
             "LSR_COORDINATOR": f"127.0.0.1:{port}",
             "LSR_NUM_PROCESSES": "2",
             "JAX_PLATFORMS": "cpu",
+            "LSR_CKPT_DIR": str(tmp_path),
         }
         procs = [
             subprocess.Popen(
@@ -86,6 +89,13 @@ class TestTwoProcessDemo:
         assert all(p.returncode == 0 for p in procs), \
             "\n---\n".join(outs)[-4000:]
         assert "DISTRIBUTED DEMO PASS" in outs[0], outs[0][-2000:]
+        for p, out in enumerate(outs):
+            assert "SHARDED CKPT RESUME OK" in out, out[-2000:]
+        # both processes wrote their own shard file + one manifest exists
+        names = os.listdir(tmp_path)
+        assert any(".shard0of2" in n for n in names), names
+        assert any(".shard1of2" in n for n in names), names
+        assert any(n.endswith(".manifest.json") for n in names), names
 
 
 class TestGlobalDeviceBlocking:
