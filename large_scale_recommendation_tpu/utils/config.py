@@ -1,0 +1,76 @@
+"""Config composition: the reference's ParameterMap merge, TPU-native form.
+
+The reference's solvers are FlinkML pipeline ``Predictor``s whose
+parameters live in ``ParameterMap``s composed in layers — fluent setters
+write the instance map, ``fit`` folds the call-site map over it, later
+values win (reference: MatrixFactorization.scala:195-223 parameter
+registry; DSGDforMF.scala:268 ``instance.parameters ++ fitParameters``).
+The pipeline *machinery* (operator chaining, plan rewriting) is Flink's,
+not the algorithm's, so this repo does not rebuild an estimator graph —
+composition here is plain function composition over frozen config
+dataclasses. What IS the algorithm's surface is the merge semantics, and
+this module provides exactly that:
+
+    base = DSGDConfig(num_factors=64, iterations=10)
+    site = {"iterations": 5, "learning_rate": 0.1}      # ≙ fit ParameterMap
+    cfg  = merge_config(base, site)                      # later wins
+
+Layers compose left to right like ``ParameterMap ++``:
+
+    cfg = merge_config(defaults, experiment_overrides, {"seed": 1})
+
+Unknown keys fail loudly (the reference's typed ``Parameter`` keys make an
+unknown key unrepresentable; a dict overlay needs the explicit check).
+
+The other deliberately-collapsed seam documented here: Spark's
+``offlineDSGDWithCustomMap`` injection point (OfflineSpark.scala:115-133)
+let callers swap the factor-container strategy — its
+``UpdateSeparatedHashMap`` overlay (OfflineSpark.scala:33-67) existed to
+ship *updates-only* deltas between supersteps. The TPU design keeps the
+capability, not the hook: factors are dense device tables (the only layout
+the MXU/HBM can stream), and updates-only output is provided by masks
+(``models.online`` update masks, ``ps`` push-merge deltas). A container
+*strategy* parameter would have nothing to vary — there is one right
+container on this hardware. See docs/PARITY.md "Collapsed seams".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping
+
+
+def merge_config(base: Any, *overlays: Mapping[str, Any] | Any, **kw: Any):
+    """Fold overlays over ``base`` (a frozen config dataclass), later
+    values winning — ``instance.parameters ++ fitParameters`` semantics
+    (DSGDforMF.scala:268). Overlays are dicts or config instances of the
+    SAME type (an instance overlay replaces wholesale, like retraining
+    with a fresh ParameterMap). Returns a new frozen instance; ``base`` is
+    never mutated. Unknown keys raise ``ValueError``.
+    """
+    if not dataclasses.is_dataclass(base):
+        raise TypeError(f"merge_config needs a config dataclass, "
+                        f"got {type(base).__name__}")
+    fields = {f.name for f in dataclasses.fields(base)}
+    out = base
+    for ov in overlays + ((kw,) if kw else ()):
+        if dataclasses.is_dataclass(ov) and not isinstance(ov, type):
+            if type(ov) is not type(base):
+                raise TypeError(
+                    f"cannot merge {type(ov).__name__} into "
+                    f"{type(base).__name__}")
+            out = ov  # wholesale replace, like a rebuilt ParameterMap
+            continue
+        unknown = set(ov) - fields
+        if unknown:
+            raise ValueError(
+                f"unknown config key(s) {sorted(unknown)} for "
+                f"{type(base).__name__}; have {sorted(fields)}")
+        out = dataclasses.replace(out, **dict(ov))
+    return out
+
+
+def config_to_dict(cfg: Any) -> dict[str, Any]:
+    """The full parameter map of a config instance (``asdict`` without
+    recursing into array-valued fields, which configs here never hold)."""
+    return {f.name: getattr(cfg, f.name) for f in dataclasses.fields(cfg)}

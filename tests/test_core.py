@@ -340,3 +340,48 @@ class TestDeltaNpTwin:
                                            rtol=1e-5, atol=1e-7)
                 np.testing.assert_allclose(dv_np, np.asarray(dv[0]),
                                            rtol=1e-5, atol=1e-7)
+
+
+class TestConfigMerge:
+    """utils.config.merge_config: the reference's ParameterMap fold
+    (instance.parameters ++ fitParameters, DSGDforMF.scala:268) over the
+    frozen config dataclasses."""
+
+    def test_overlay_fold_later_wins(self):
+        from large_scale_recommendation_tpu.models.dsgd import DSGDConfig
+        from large_scale_recommendation_tpu.utils.config import (
+            config_to_dict,
+            merge_config,
+        )
+
+        base = DSGDConfig(num_factors=64, iterations=10, learning_rate=0.3)
+        cfg = merge_config(base, {"iterations": 5},
+                           {"iterations": 7, "seed": 9}, learning_rate=0.1)
+        assert (cfg.iterations, cfg.seed, cfg.learning_rate) == (7, 9, 0.1)
+        assert cfg.num_factors == 64          # untouched key flows through
+        assert base.iterations == 10          # base never mutated
+        # round-trip: dict → merge → dict is the identity on full maps
+        d = config_to_dict(cfg)
+        assert config_to_dict(merge_config(base, d)) == d
+
+    def test_unknown_key_and_type_guards(self):
+        import pytest
+
+        from large_scale_recommendation_tpu.models.als import ALSConfig
+        from large_scale_recommendation_tpu.models.dsgd import DSGDConfig
+        from large_scale_recommendation_tpu.utils.config import merge_config
+
+        with pytest.raises(ValueError, match="unknown config key"):
+            merge_config(DSGDConfig(), {"learning_rte": 0.1})
+        with pytest.raises(TypeError, match="cannot merge"):
+            merge_config(DSGDConfig(), ALSConfig())
+        with pytest.raises(TypeError, match="config dataclass"):
+            merge_config({"not": "a config"}, {})
+
+    def test_instance_overlay_replaces_wholesale(self):
+        from large_scale_recommendation_tpu.models.dsgd import DSGDConfig
+        from large_scale_recommendation_tpu.utils.config import merge_config
+
+        a = DSGDConfig(iterations=3)
+        b = DSGDConfig(iterations=8)
+        assert merge_config(a, b, {"seed": 4}).iterations == 8
