@@ -127,11 +127,14 @@ def main() -> None:
     Vh = np.asarray(jax.jit(lambda x: x, out_shardings=rep)(V))
 
     tu, ti, tv, _ = test.to_numpy()
-    urow, um = problem.users.rows_for(tu)
-    irow, im = problem.items.rows_for(ti)
-    m = (um * im) > 0
-    pred = np.einsum("nk,nk->n", Uh[urow[m]], Vh[irow[m]])
-    rmse = float(np.sqrt(np.mean((tv[m] - pred) ** 2)))
+
+    def score(Uhost, Vhost, urows, umask, irows, imask):
+        m = (umask * imask) > 0
+        pred = np.einsum("nk,nk->n", Uhost[urows[m]], Vhost[irows[m]])
+        return float(np.sqrt(np.mean((tv[m] - pred) ** 2)))
+
+    rmse = score(Uh, Vh, *problem.users.rows_for(tu),
+                 *problem.items.rows_for(ti))
     print(f"[p{pid}] rmse={rmse:.4f} total_ratings={float(total):.0f}",
           flush=True)
     assert abs(float(total) - len(ru)) < 1e-3, (float(total), len(ru))
@@ -163,9 +166,7 @@ def main() -> None:
     Ugh = np.asarray(jax.jit(lambda x: x, out_shardings=rep)(Ug))
     Vgh = np.asarray(jax.jit(lambda x: x, out_shardings=rep)(Vg))
     gur, gir, gm = g.holdout_rows(tu, ti)
-    gm = gm > 0
-    gpred = np.einsum("nk,nk->n", Ugh[gur[gm]], Vgh[gir[gm]])
-    grmse = float(np.sqrt(np.mean((tv[gm] - gpred) ** 2)))
+    grmse = score(Ugh, Vgh, gur, np.asarray(gm), gir, np.ones_like(gm))
     print(f"[p{pid}] global-device-blocked rmse={grmse:.4f}", flush=True)
     assert grmse < 0.1, grmse
 
@@ -201,6 +202,30 @@ def main() -> None:
         U2h = np.asarray(jax.jit(lambda x: x, out_shardings=rep)(Us2))
         np.testing.assert_allclose(U2h, Ugh, rtol=1e-5, atol=1e-6)
         print(f"[p{pid}] SHARDED CKPT RESUME OK", flush=True)
+
+    # -- mesh ALS across the process-spanning mesh (the MLlib retrain
+    # branch, OnlineSpark.scala:125-131, out-scaled: the only cross-host
+    # traffic is the two factor-table all_gathers per round on the mesh;
+    # MLlib routed factor blocks through the block manager). Parity: the
+    # identical config fit single-device on this host must agree. --------
+    from large_scale_recommendation_tpu.models.als import ALS, ALSConfig
+    from large_scale_recommendation_tpu.parallel.als_mesh import MeshALS
+
+    acfg = ALSConfig(num_factors=8, iterations=3, lambda_=0.02,
+                     reg_mode="als_wr", seed=0)
+    mals = MeshALS(acfg, mesh=mesh).fit(ratings)
+    Uma = np.asarray(jax.jit(lambda x: x, out_shardings=rep)(mals.U))
+    Vma = np.asarray(jax.jit(lambda x: x, out_shardings=rep)(mals.V))
+    armse = score(Uma, Vma, *mals.users.rows_for(tu),
+                  *mals.items.rows_for(ti))
+    # parity vs the identical config fit on this host's single device —
+    # row layouts differ (k-block vs 1-block deal), so compare by score,
+    # the same contract tests/test_als.py pins single-process
+    local_rmse = ALS(acfg).fit(ratings).rmse(test)
+    assert abs(armse - local_rmse) < 2e-2, (armse, local_rmse)
+    print(f"[p{pid}] mesh-ALS rmse={armse:.4f} single={local_rmse:.4f} "
+          "(parity OK)", flush=True)
+    assert armse < 0.1, armse
 
     if pid == 0:
         print("DISTRIBUTED DEMO PASS", flush=True)

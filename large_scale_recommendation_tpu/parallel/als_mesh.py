@@ -36,7 +36,6 @@ from large_scale_recommendation_tpu.models.mf import MFModel
 from large_scale_recommendation_tpu.ops import als as als_ops
 from large_scale_recommendation_tpu.parallel.mesh import (
     BLOCK_AXIS,
-    block_sharding,
     make_block_mesh,
 )
 
@@ -134,10 +133,36 @@ class MeshALS:
         real = rw > 0
         ru, ri, rv = ru[real], ri[real], rv[real]
 
+        if jax.process_count() > 1 and cfg.seed is None:
+            # seed=None draws a fresh blocking permutation PER PROCESS;
+            # the global assembly below would then mix mutually
+            # inconsistent row layouts into one array — garbage factors
+            # with no error. Refuse up front.
+            raise ValueError(
+                "MeshALS across processes requires a fixed config seed — "
+                "the host blocking must be identical on every process")
+
         users = blocking.build_id_index(ru, num_blocks=k, seed=cfg.seed)
         items = blocking.build_id_index(
             ri, num_blocks=k, seed=None if cfg.seed is None else cfg.seed + 1
         )
+        if jax.process_count() > 1:
+            # the identical-host-copy contract make_global_array depends
+            # on, enforced: a cheap deterministic digest of the blocking
+            # (CRC, not the per-process-salted builtin hash) must agree
+            # everywhere, or some process was handed different ratings
+            from jax.experimental import multihost_utils
+            import zlib
+
+            digest = np.int64(zlib.crc32(
+                users.ids.tobytes() + items.ids.tobytes()
+                + np.asarray(rv, np.float32).tobytes()))
+            all_d = np.asarray(multihost_utils.process_allgather(digest))
+            if not (all_d == all_d[0]).all():
+                raise ValueError(
+                    "host blocking diverged across processes "
+                    f"(digests {all_d.tolist()}) — every process must pass "
+                    "the IDENTICAL full ratings set to MeshALS.fit")
         u_rows, _ = users.rows_for(ru)
         i_rows, _ = items.rows_for(ri)
         rv = np.asarray(rv, np.float32)
@@ -160,8 +185,17 @@ class MeshALS:
 
         U, V = ALS(cfg)._init_factors(users, items)
 
-        shard = block_sharding(self.mesh)
-        put = lambda x: jax.device_put(jnp.asarray(x), shard)
+        # process-spanning placement: every process supplies the shards of
+        # its OWN devices from its host copy (the host blocking above is
+        # deterministic, so all processes hold identical arrays — the same
+        # contract as the 2-process DSGD demo). Single-process this is
+        # plain sharded placement.
+        from large_scale_recommendation_tpu.parallel.distributed import (
+            make_global_array,
+        )
+
+        put = lambda x: make_global_array(np.asarray(x), self.mesh,
+                                          P(BLOCK_AXIS))
         step_fn = build_mesh_als_step(
             self.mesh, cfg.lambda_, cfg.reg_mode, cfg.iterations,
             len(user_plan), len(item_plan),

@@ -91,6 +91,7 @@ class TestTwoProcessDemo:
         assert "DISTRIBUTED DEMO PASS" in outs[0], outs[0][-2000:]
         for p, out in enumerate(outs):
             assert "SHARDED CKPT RESUME OK" in out, out[-2000:]
+            assert "mesh-ALS" in out and "parity OK" in out, out[-2000:]
         # both processes wrote their own shard file + one manifest exists
         names = os.listdir(tmp_path)
         assert any(".shard0of2" in n for n in names), names
