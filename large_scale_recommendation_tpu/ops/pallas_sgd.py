@@ -1,0 +1,319 @@
+"""Pallas DSGD block-sweep prototype: VMEM-staged factor slices.
+
+The measured ceiling of the XLA kernel is the per-row HBM gather/scatter:
+random 512-byte rows stream at ~5 GB/s effective (~0.6% of HBM peak,
+docs/PERF.md "Kernel facts") because every row access is an HBM-latency
+round trip. This kernel attacks that ceiling with the one structural fact
+the XLA gather cannot exploit: in the DSGD blocked layout each
+(stratum, block) visit touches only a CONTIGUOUS row range of U and of V
+(``data.blocking`` deals rows block-major — the whole point of the
+stratum schedule, DSGDforMF.scala:337-344 ≙ the visit order). So:
+
+    1. DMA the block's U-rows and V-rows HBM→VMEM as two big contiguous
+       copies (streams at full HBM bandwidth, not per-row latency);
+    2. run every minibatch of the block against the VMEM-resident slices —
+       gather, delta, scatter all VMEM-local;
+    3. DMA the updated slices back.
+
+Per-sweep HBM traffic drops from ~2 row-latency round trips per rating to
+one contiguous read+write of each factor row per block visit plus the COO
+stream — at ML-25M shape ~2 GB/sweep, ~100× less latency-bound work than
+the measured gather path.
+
+Two in-kernel gather strategies are built (the hardware question is which
+one Mosaic lowers well on v5e — measure, don't argue; scripts/
+pallas_probe.py):
+
+- ``gather="take"``: vectorized ``jnp.take`` on the VMEM slice (lowers to
+  Mosaic dynamic-gather where supported);
+- ``gather="loop"``: per-entry ``lax.fori_loop`` of dynamic row loads —
+  the guaranteed-to-lower fallback.
+
+Scatter is a per-entry read-modify-write ``fori_loop`` on the VMEM slice
+either way: sequential within the minibatch, so duplicate rows accumulate
+EXACTLY like the XLA kernel's ``.at[].add`` (and unlike a "last write
+wins" bulk store). Minibatch boundaries see each other's writes through
+the VMEM slice, matching ``lax.scan`` semantics in ``ops.sgd``.
+
+The updater math is the λ/ω-regularized SGD rule inlined (the bench
+configuration, ``core.updaters.RegularizedSGDUpdater`` with per-row ω
+scaling and precomputed collision scales); parity is pinned against
+``ops.sgd.sgd_minibatch_update`` in tests/test_pallas_sgd.py (interpret
+mode on CPU — Mosaic lowering and speed are measured on real TPU by the
+probe script).
+
+VMEM budget: U-slice [rpb_u, r] + V-slice [rpb_v, r] + FOUR [mb, r]
+tiles (gathered u, v and deltas du, dv) + per-minibatch index/value
+blocks must fit ~16 MB; at rank 128 that means k=16 blocks for the
+ML-25M shape (5.2 MB + 1.9 MB slices) with mb ≤ 2048 (four 1 MB tiles),
+or rank 64 at k=8. The wrapper checks.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+try:  # pallas TPU backend is absent on some CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+
+def _sweep_kernel(ur_ref, ir_ref, vals_ref, w_ref, icu_ref, icv_ref,
+                  ou_ref, ov_ref, u_hbm, v_hbm,
+                  u_out, v_out, sems,
+                  *, lr: float, lam: float, mb: int, rank: int,
+                  n_mb: int, gather: str):
+    """One grid step = one minibatch. u_out/v_out are the VMEM-resident
+    block slices, persistent across grid steps (constant index_map)."""
+    g = pl.program_id(0)
+
+    # -- step 0: stage the block's factor slices HBM→VMEM (contiguous) ----
+    @pl.when(g == 0)
+    def _stage():
+        cu = pltpu.make_async_copy(u_hbm, u_out, sems.at[0])
+        cv = pltpu.make_async_copy(v_hbm, v_out, sems.at[1])
+        cu.start()
+        cv.start()
+        cu.wait()
+        cv.wait()
+
+    ur = ur_ref[...]
+    ir = ir_ref[...]
+    w = w_ref[...]
+
+    if gather == "take":
+        u = jnp.take(u_out[...], ur, axis=0)
+        v = jnp.take(v_out[...], ir, axis=0)
+    else:  # "loop": guaranteed-to-lower dynamic row loads
+
+        def load_rows(tbl_ref, rows):
+            def body(j, acc):
+                row = rows[j]
+                acc = jax.lax.dynamic_update_slice(
+                    acc, tbl_ref[pl.ds(row, 1), :], (j, 0))
+                return acc
+
+            return jax.lax.fori_loop(
+                0, mb, body, jnp.zeros((mb, rank), jnp.float32))
+
+        u = load_rows(u_out, ur)
+        v = load_rows(v_out, ir)
+
+    # -- delta: the λ/ω rule (core.updaters.RegularizedSGDUpdater),
+    # vectorized over the minibatch — one fused einsum + elementwise ------
+    e = (vals_ref[...] - jnp.sum(u * v, axis=-1)) * w
+    t_lr = jnp.float32(lr)
+    gu = jnp.maximum(ou_ref[...], 1.0)
+    gv = jnp.maximum(ov_ref[...], 1.0)
+    du = t_lr * (e[:, None] * v - (lam / gu)[:, None] * u * w[:, None])
+    dv = t_lr * (e[:, None] * u - (lam / gv)[:, None] * v * w[:, None])
+    du = du * icu_ref[...][:, None]
+    dv = dv * icv_ref[...][:, None]
+
+    # -- scatter: sequential per-entry RMW on the VMEM slice — duplicates
+    # accumulate exactly like .at[].add ------------------------------------
+    def rmw(j, _):
+        row_u = ur[j]
+        u_out[pl.ds(row_u, 1), :] += jax.lax.dynamic_slice(
+            du, (j, 0), (1, rank))
+        row_v = ir[j]
+        v_out[pl.ds(row_v, 1), :] += jax.lax.dynamic_slice(
+            dv, (j, 0), (1, rank))
+        return 0
+
+    jax.lax.fori_loop(0, mb, rmw, 0)
+
+
+def pallas_block_sweep(
+    U_blk: jax.Array,  # f32[rpb_u, r] — the block's contiguous U rows
+    V_blk: jax.Array,  # f32[rpb_v, r]
+    ur_local: jax.Array,  # int32[E] block-LOCAL user rows
+    ir_local: jax.Array,
+    vals: jax.Array,  # f32[E]
+    w: jax.Array,  # f32[E] (0 = padding no-op)
+    icu: jax.Array,  # f32[E] precomputed 1/occurrence collision scales
+    icv: jax.Array,
+    omega_u: jax.Array,  # f32[rpb_u] per-row ω for the λ/ω rule
+    omega_v: jax.Array,
+    *,
+    lr: float,
+    lam: float,
+    minibatch: int,
+    gather: str = "take",
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """Sweep one rating block with VMEM-resident factor slices.
+
+    Returns the updated (U_blk, V_blk). Semantics ≡
+    ``ops.sgd.sgd_block_sweep`` with the RegularizedSGDUpdater(lr, lam)
+    constant-schedule rule and precomputed collision scales.
+    """
+    if pltpu is None:
+        # the grid spec / DMA / semaphore APIs below all live in pltpu, so
+        # even interpreter mode needs the import to have succeeded
+        raise RuntimeError(
+            "jax.experimental.pallas.tpu is unavailable in this jax build; "
+            "the Pallas DSGD kernel cannot run (even interpreted)")
+    e = ur_local.shape[0]
+    if e % minibatch != 0:
+        raise ValueError(f"block nnz {e} not divisible by mb {minibatch}")
+    rank = int(U_blk.shape[-1])
+    n_mb = e // minibatch
+    vmem_mb = (U_blk.size + V_blk.size + 4 * minibatch * rank) * 4 / 2**20
+    if vmem_mb > 15 and not interpret:
+        raise ValueError(
+            f"~{vmem_mb:.1f} MB of VMEM-resident state (slices + 4 [mb, "
+            "rank] tiles) exceeds the ~16 MB budget; use more blocks "
+            "(smaller row slices), a smaller minibatch, or a smaller rank")
+
+    # ω gathered host-side per entry would defeat the point; gather the
+    # per-ROW omegas inside the kernel instead — they are part of the
+    # resident slices' row metadata. (Streamed per-minibatch here: the
+    # per-entry gather of ω is fused into the delta math by XLA in the
+    # reference kernel too, so streaming it keeps the comparison honest.)
+    ou_entry = omega_u[ur_local]
+    ov_entry = omega_v[ir_local]
+
+    mbspec = lambda: pl.BlockSpec((minibatch,), lambda g: (g,))
+    kernel = functools.partial(
+        _sweep_kernel, lr=lr, lam=lam, mb=minibatch, rank=rank,
+        n_mb=n_mb, gather=gather)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        grid=(n_mb,),
+        in_specs=[
+            mbspec(),  # ur
+            mbspec(),  # ir
+            mbspec(),  # vals
+            mbspec(),  # w
+            mbspec(),  # icu
+            mbspec(),  # icv
+            mbspec(),  # ou per entry
+            mbspec(),  # ov per entry
+            pl.BlockSpec(memory_space=pl.ANY),  # U_blk stays in HBM
+            pl.BlockSpec(memory_space=pl.ANY),  # V_blk stays in HBM
+        ],
+        out_specs=[
+            pl.BlockSpec(U_blk.shape, lambda g: (0, 0)),  # persistent VMEM
+            pl.BlockSpec(V_blk.shape, lambda g: (0, 0)),
+        ],
+        scratch_shapes=[pltpu.SemaphoreType.DMA((2,))],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(U_blk.shape, jnp.float32),
+            jax.ShapeDtypeStruct(V_blk.shape, jnp.float32),
+        ],
+        interpret=interpret,
+    )(ur_local.astype(jnp.int32), ir_local.astype(jnp.int32),
+      vals.astype(jnp.float32), w.astype(jnp.float32),
+      icu.astype(jnp.float32), icv.astype(jnp.float32),
+      ou_entry.astype(jnp.float32), ov_entry.astype(jnp.float32),
+      U_blk, V_blk)
+
+
+@functools.partial(jax.jit, static_argnames=("rank", "mb", "rpb_u",
+                                             "rpb_v", "e", "sort"))
+def _probe_inputs(key, rank: int, mb: int, rpb_u: int, rpb_v: int,
+                  e: int, sort: bool):
+    """Generate the probe workload ON DEVICE — nothing but a PRNG key
+    crosses the host link (the tunneled chip dies under bulk device_put;
+    round-3 lesson, and the reason the whole data pipeline is on-chip)."""
+    from large_scale_recommendation_tpu.data.device_blocking import (
+        truncated_exp_ids,
+    )
+
+    k1, k2, k3, k4, k5 = jax.random.split(key, 5)
+    ur = truncated_exp_ids(k1, 2.0, rpb_u, e)
+    ir = truncated_exp_ids(k2, 2.0, rpb_v, e)
+    if sort:
+        ur2 = ur.reshape(-1, mb)
+        order = jnp.argsort(ur2, axis=1, stable=True)
+        ur = jnp.take_along_axis(ur2, order, axis=1).reshape(-1)
+        ir = jnp.take_along_axis(ir.reshape(-1, mb), order,
+                                 axis=1).reshape(-1)
+    vals = jax.random.normal(k3, (e,), jnp.float32)
+    w = jnp.ones(e, jnp.float32)
+    U = 0.1 * jax.random.normal(k4, (rpb_u, rank), jnp.float32)
+    V = 0.1 * jax.random.normal(k5, (rpb_v, rank), jnp.float32)
+    ou = jnp.maximum(
+        jnp.zeros(rpb_u, jnp.float32).at[ur].add(1.0), 1.0)
+    ov = jnp.maximum(
+        jnp.zeros(rpb_v, jnp.float32).at[ir].add(1.0), 1.0)
+
+    def batch_inv(rows, nrows):
+        r2 = rows.reshape(-1, mb)
+        counts = jax.vmap(
+            lambda r: jnp.zeros(nrows, jnp.float32).at[r].add(1.0))(r2)
+        inv = 1.0 / jnp.take_along_axis(counts, r2, axis=1)
+        return inv.reshape(-1)
+
+    return (ur, ir, vals, w, batch_inv(ur, rpb_u), batch_inv(ir, rpb_v),
+            ou, ov, U, V)
+
+
+def probe_variants(rank: int = 128, mb: int = 2048, rpb_u: int = 10160,
+                   rpb_v: int = 3696, nnz: int = 92160, reps: int = 5,
+                   seed: int = 0, sort: bool = False,
+                   interpret: bool | None = None) -> dict:
+    """Measure the XLA kernel vs both Pallas gather variants on ONE
+    realistic (stratum, block) visit on the CURRENT device; returns
+    ``{variant: ratings_per_s | "FAILED <err>"}``. Shared by
+    scripts/pallas_probe.py and the bench extras (BENCH_PALLAS) so the
+    experiment runs whenever a real chip is reachable — a Mosaic lowering
+    failure is recorded as a measured negative, not hidden. All inputs
+    are generated on device: only the PRNG key crosses the link."""
+    import time
+
+    from large_scale_recommendation_tpu.core.updaters import (
+        RegularizedSGDUpdater,
+        constant_lr,
+    )
+    from large_scale_recommendation_tpu.ops import sgd as sgd_ops
+
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    e = nnz - nnz % mb
+    lr, lam = 0.1, 0.1
+    (urd, ird, valsd, wd, icud, icvd, oud, ovd, Ud, Vd) = _probe_inputs(
+        jax.random.PRNGKey(seed), rank, mb, rpb_u, rpb_v, e, sort)
+    jax.block_until_ready(Ud)
+
+    upd = RegularizedSGDUpdater(learning_rate=lr, lambda_=lam,
+                                schedule=constant_lr)
+    variants = {
+        "xla": jax.jit(lambda: sgd_ops.sgd_block_sweep(
+            Ud, Vd, urd, ird, valsd, wd, oud, ovd, upd, 1, mb, "mean",
+            icud, icvd)),
+        "pallas_take": jax.jit(lambda: pallas_block_sweep(
+            Ud, Vd, urd, ird, valsd, wd, icud, icvd, oud, ovd,
+            lr=lr, lam=lam, minibatch=mb, gather="take",
+            interpret=interpret)),
+        "pallas_loop": jax.jit(lambda: pallas_block_sweep(
+            Ud, Vd, urd, ird, valsd, wd, icud, icvd, oud, ovd,
+            lr=lr, lam=lam, minibatch=mb, gather="loop",
+            interpret=interpret)),
+    }
+    out: dict = {}
+    for label, fn in variants.items():
+        try:
+            jax.block_until_ready(fn())
+        except Exception as ex:
+            out[label] = f"FAILED {type(ex).__name__}: {str(ex)[:200]}"
+            continue
+        walls = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            r = fn()
+            jax.block_until_ready(r)
+            walls.append(time.perf_counter() - t0)
+        out[label] = round(e / min(walls), 1)
+    return out

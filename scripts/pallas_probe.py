@@ -1,0 +1,76 @@
+"""Pallas vs XLA DSGD kernel: the gather-ceiling experiment, measured.
+
+Round-3 verdict: the claim "a Pallas kernel has no physics headroom" was
+argued from an XLA gather microbenchmark, not from a pipelined kernel —
+and the host CPU within 2x of the TPU kernel says headroom exists. This
+script MEASURES the question on the current device:
+
+  xla    — ops.sgd.sgd_block_sweep (the production kernel) on one
+           realistic (stratum, block) visit;
+  take   — ops.pallas_sgd.pallas_block_sweep, VMEM-staged factor slices,
+           vectorized jnp.take gather (Mosaic dynamic-gather);
+  loop   — same staging, per-entry fori_loop gather (guaranteed lowering).
+
+The Pallas kernels stage the block's CONTIGUOUS factor-row ranges in VMEM
+(one big DMA each way) and do all row access VMEM-side — the structural
+lever the XLA gather cannot express (its every row access is an HBM
+latency round trip, measured ~0.6% of HBM peak, docs/PERF.md).
+
+A Mosaic lowering failure is itself a result: it prints as
+``variant=... FAILED <error>`` — record it, don't hide it.
+
+Usage:
+    python scripts/pallas_probe.py                    # current device
+    PROBE_RANK=64 PROBE_MB=4096 python scripts/pallas_probe.py
+    PROBE_CPU=1 python scripts/pallas_probe.py        # interpret fallback
+
+Defaults model one ML-25M block visit at k=16 (rpb_u 10160, rpb_v 3696,
+~92K ratings) — VMEM-sized for v5e at rank 128.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    if os.environ.get("PROBE_CPU") == "1":
+        from large_scale_recommendation_tpu.utils.platform import force_cpu
+
+        force_cpu()
+
+    import jax
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    rank = int(os.environ.get("PROBE_RANK", 128))
+    mb = int(os.environ.get("PROBE_MB", 4096))
+    rpb_u = int(os.environ.get("PROBE_RPB_U", 10160))
+    rpb_v = int(os.environ.get("PROBE_RPB_V", 3696))
+    e = int(os.environ.get("PROBE_NNZ", 92160))
+    e -= e % mb
+    reps = int(os.environ.get("PROBE_REPS", 5))
+    lr, lam = 0.1, 0.1
+
+    print(f"# device={dev} rank={rank} mb={mb} rpb_u={rpb_u} "
+          f"rpb_v={rpb_v} nnz={e}", flush=True)
+
+    from large_scale_recommendation_tpu.ops.pallas_sgd import probe_variants
+
+    res = probe_variants(rank=rank, mb=mb, rpb_u=rpb_u, rpb_v=rpb_v,
+                         nnz=e, reps=reps,
+                         sort=os.environ.get("PROBE_SORT") == "1",
+                         interpret=not on_tpu)
+    for label, val in res.items():
+        if isinstance(val, str):
+            print(f"{label:12s} {val}", flush=True)
+        else:
+            print(f"{label:12s} ratings_per_s={val:14.0f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

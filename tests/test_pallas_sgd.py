@@ -1,0 +1,153 @@
+"""Pallas DSGD block-sweep: interpret-mode parity against the XLA kernel.
+
+The Pallas kernel (ops/pallas_sgd.py) exists to attack the measured HBM
+row-gather ceiling on real TPU hardware; on CPU we can only pin its MATH.
+These tests run it in interpreter mode and require exact agreement with
+``ops.sgd.sgd_block_sweep`` under the same updater rule — including
+duplicate rows inside a minibatch (the sequential RMW scatter must
+accumulate like ``.at[].add``) and weight-0 padding no-ops. Throughput is
+measured by scripts/pallas_probe.py on the device that matters.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from large_scale_recommendation_tpu.core.updaters import (
+    RegularizedSGDUpdater,
+    constant_lr,
+)
+from large_scale_recommendation_tpu.ops import sgd as sgd_ops
+from large_scale_recommendation_tpu.ops.pallas_sgd import pallas_block_sweep
+
+
+def _problem(seed, e, rpb_u, rpb_v, rank, pad_frac=0.0):
+    rng = np.random.default_rng(seed)
+    ur = rng.integers(0, rpb_u, e).astype(np.int32)
+    ir = rng.integers(0, rpb_v, e).astype(np.int32)
+    vals = rng.normal(0, 1, e).astype(np.float32)
+    w = np.ones(e, np.float32)
+    if pad_frac:
+        w[rng.random(e) < pad_frac] = 0.0
+    U = rng.normal(0, 0.1, (rpb_u, rank)).astype(np.float32)
+    V = rng.normal(0, 0.1, (rpb_v, rank)).astype(np.float32)
+    omega_u = np.maximum(
+        np.bincount(ur, weights=w, minlength=rpb_u), 0).astype(np.float32)
+    omega_v = np.maximum(
+        np.bincount(ir, weights=w, minlength=rpb_v), 0).astype(np.float32)
+    return ur, ir, vals, w, U, V, omega_u, omega_v
+
+
+def _inv_counts(rows, w, mb):
+    """Per-entry 1/occurrence within each minibatch (the precomputed
+    collision scales, data.blocking.minibatch_inv_counts semantics)."""
+    inv = np.ones_like(w)
+    for s in range(0, len(rows), mb):
+        sl = slice(s, s + mb)
+        cnt = {}
+        for r, ww in zip(rows[sl], w[sl]):
+            if ww > 0:
+                cnt[r] = cnt.get(r, 0) + 1
+        inv[sl] = [1.0 / max(cnt.get(r, 1), 1) if ww > 0 else 1.0
+                   for r, ww in zip(rows[sl], w[sl])]
+    return inv.astype(np.float32)
+
+
+@pytest.mark.parametrize("gather", ["take", "loop"])
+@pytest.mark.parametrize("pad_frac", [0.0, 0.15])
+def test_matches_xla_kernel(gather, pad_frac):
+    lr, lam, mb, rank = 0.1, 0.05, 64, 8
+    ur, ir, vals, w, U, V, ou, ov = _problem(0, 256, 40, 24, rank,
+                                             pad_frac)
+    icu = _inv_counts(ur, w, mb)
+    icv = _inv_counts(ir, w, mb)
+
+    upd = RegularizedSGDUpdater(learning_rate=lr, lambda_=lam,
+                                schedule=constant_lr)
+    U_ref, V_ref = sgd_ops.sgd_block_sweep(
+        jnp.asarray(U), jnp.asarray(V),
+        jnp.asarray(ur), jnp.asarray(ir), jnp.asarray(vals),
+        jnp.asarray(w), jnp.asarray(ou), jnp.asarray(ov),
+        upd, 1, mb, "mean", jnp.asarray(icu), jnp.asarray(icv))
+
+    U_p, V_p = pallas_block_sweep(
+        jnp.asarray(U), jnp.asarray(V), jnp.asarray(ur), jnp.asarray(ir),
+        jnp.asarray(vals), jnp.asarray(w), jnp.asarray(icu),
+        jnp.asarray(icv), jnp.asarray(ou), jnp.asarray(ov),
+        lr=lr, lam=lam, minibatch=mb, gather=gather, interpret=True)
+
+    np.testing.assert_allclose(np.asarray(U_p), np.asarray(U_ref),
+                               rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(np.asarray(V_p), np.asarray(V_ref),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_duplicate_rows_accumulate_not_overwrite():
+    """Many entries hitting ONE row in the same minibatch: the scatter
+    must behave like .at[].add (a bulk last-write-wins store would keep
+    only one delta)."""
+    lr, mb, rank = 0.1, 16, 4
+    e = 16
+    ur = np.zeros(e, np.int32)  # every entry → row 0
+    ir = np.arange(e, dtype=np.int32)
+    rng = np.random.default_rng(1)
+    vals = rng.normal(0, 1, e).astype(np.float32)
+    w = np.ones(e, np.float32)
+    U = rng.normal(0, 0.1, (4, rank)).astype(np.float32)
+    V = rng.normal(0, 0.1, (e, rank)).astype(np.float32)
+    ou = np.maximum(np.bincount(ur, minlength=4), 1).astype(np.float32)
+    ov = np.ones(e, np.float32)
+    icu = _inv_counts(ur, w, mb)
+    icv = _inv_counts(ir, w, mb)
+
+    upd = RegularizedSGDUpdater(learning_rate=lr, lambda_=0.05,
+                                schedule=constant_lr)
+    U_ref, V_ref = sgd_ops.sgd_block_sweep(
+        jnp.asarray(U), jnp.asarray(V), jnp.asarray(ur), jnp.asarray(ir),
+        jnp.asarray(vals), jnp.asarray(w), jnp.asarray(ou),
+        jnp.asarray(ov), upd, 1, mb, "mean",
+        jnp.asarray(icu), jnp.asarray(icv))
+    U_p, V_p = pallas_block_sweep(
+        jnp.asarray(U), jnp.asarray(V), jnp.asarray(ur), jnp.asarray(ir),
+        jnp.asarray(vals), jnp.asarray(w), jnp.asarray(icu),
+        jnp.asarray(icv), jnp.asarray(ou), jnp.asarray(ov),
+        lr=lr, lam=0.05, minibatch=mb, gather="loop", interpret=True)
+    np.testing.assert_allclose(np.asarray(U_p), np.asarray(U_ref),
+                               rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(np.asarray(V_p), np.asarray(V_ref),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_minibatch_boundary_visibility():
+    """Minibatch t+1 must read rows written by minibatch t (the lax.scan
+    carry semantics) — two minibatches hitting the same row."""
+    lr, mb, rank = 0.2, 8, 4
+    e = 16  # two minibatches
+    ur = np.full(e, 2, np.int32)
+    ir = np.arange(e, dtype=np.int32) % 8
+    rng = np.random.default_rng(2)
+    vals = rng.normal(0, 1, e).astype(np.float32)
+    w = np.ones(e, np.float32)
+    U = rng.normal(0, 0.1, (4, rank)).astype(np.float32)
+    V = rng.normal(0, 0.1, (8, rank)).astype(np.float32)
+    ou = np.maximum(np.bincount(ur, minlength=4), 1).astype(np.float32)
+    ov = np.maximum(np.bincount(ir, minlength=8), 1).astype(np.float32)
+    icu = _inv_counts(ur, w, mb)
+    icv = _inv_counts(ir, w, mb)
+    upd = RegularizedSGDUpdater(learning_rate=lr, lambda_=0.05,
+                                schedule=constant_lr)
+    U_ref, V_ref = sgd_ops.sgd_block_sweep(
+        jnp.asarray(U), jnp.asarray(V), jnp.asarray(ur), jnp.asarray(ir),
+        jnp.asarray(vals), jnp.asarray(w), jnp.asarray(ou),
+        jnp.asarray(ov), upd, 1, mb, "mean",
+        jnp.asarray(icu), jnp.asarray(icv))
+    U_p, V_p = pallas_block_sweep(
+        jnp.asarray(U), jnp.asarray(V), jnp.asarray(ur), jnp.asarray(ir),
+        jnp.asarray(vals), jnp.asarray(w), jnp.asarray(icu),
+        jnp.asarray(icv), jnp.asarray(ou), jnp.asarray(ov),
+        lr=lr, lam=0.05, minibatch=mb, gather="take", interpret=True)
+    np.testing.assert_allclose(np.asarray(U_p), np.asarray(U_ref),
+                               rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(np.asarray(V_p), np.asarray(V_ref),
+                               rtol=2e-5, atol=2e-6)
