@@ -34,6 +34,27 @@ def _next_pow2(n: int) -> int:
     return p
 
 
+@jax.jit
+def _install_rows(table: jax.Array, fresh: jax.Array,
+                  base: jax.Array) -> jax.Array:
+    """Write ``fresh`` into rows [base, base+len(fresh)).
+
+    ``fresh`` is PADDED by the caller (pow2 with a capacity-scaled floor)
+    so this compiles once per (capacity, pad) pair instead of once per
+    distinct fresh-id count — the eager ``at[rows].set`` it replaces
+    recompiled a new scatter for every micro-batch's unique-id count
+    (measured: ~60% of online partial_fit wall was XLA compilation of
+    these one-shot kernels). Rows beyond the real count receive
+    initializer output for padding ids; they land in UNREGISTERED
+    capacity rows (never read, and re-initialized properly if later
+    registered), so the overwrite is harmless. NOT donated: the ingest
+    API's documented polling pattern (``models/online.py`` partial_fit —
+    snapshot ``table.array`` between micro-batches) must keep old
+    snapshots valid, so the update pays one table copy instead of
+    invalidating them."""
+    return jax.lax.dynamic_update_slice(table, fresh, (base, 0))
+
+
 class GrowableFactorTable:
     """A factor matrix with ``getOrElseUpdate`` semantics on device.
 
@@ -98,8 +119,21 @@ class GrowableFactorTable:
         rows[new_mask] = base + rank_of[inv]
 
         m = len(uniq)
+        # pow2-pad the install so downstream shapes repeat (see
+        # _install_rows); the pad rows land in unregistered capacity.
+        # The capacity-scaled FLOOR pins the steady-state install to ONE
+        # shape: a long stream's fresh-id counts decay through every pow2
+        # (8192, 4096, ... 8), and without the floor each size compiles
+        # its own installer+initializer pair — measured as the dominant
+        # cost of the online ingest loop even after warm-up. Small tables
+        # (PS shards) keep a small floor so 1-id registrations stay cheap.
+        floor = min(1024, max(8, self.capacity >> 3))
+        pad = max(floor, _next_pow2(m))
         if base + m > self.capacity:
+            # grow for REAL need only — padding headroom must not double
+            # the table when the vocab lands near a capacity boundary
             self._grow(base + m)
+        pad = min(pad, self.capacity - base)  # boundary clamp (pad ≥ m)
         self._ids_buf[base:base + m] = uniq[order]
         self._n = base + m
         if self._sorted_cache is not None:
@@ -113,11 +147,11 @@ class GrowableFactorTable:
                 np.insert(s_ids, pos, uniq),
                 np.insert(s_rows, pos, base + rank_of),
             )
-        fresh = self.initializer(
-            jnp.asarray(self._ids_buf[base:base + m], dtype=jnp.int32)
-        )
-        new_rows = jnp.arange(base, base + m, dtype=jnp.int32)
-        self.array = self._device_put(self.array.at[new_rows].set(fresh))
+        ids_pad = np.zeros(pad, np.int64)
+        ids_pad[:m] = self._ids_buf[base:base + m]
+        fresh = self.initializer(jnp.asarray(ids_pad, dtype=jnp.int32))
+        self.array = self._device_put(
+            _install_rows(self.array, fresh, np.int32(base)))
         return rows
 
     def rows_for(self, ids: np.ndarray) -> tuple[np.ndarray, np.ndarray]:

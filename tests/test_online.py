@@ -24,6 +24,30 @@ from large_scale_recommendation_tpu.models.online import (
 
 
 class TestGrowableFactorTable:
+    def test_array_snapshot_survives_ensure(self):
+        """The documented ingest polling pattern: a .array snapshot taken
+        between micro-batches must stay readable after later ensure()
+        calls register fresh ids (the padded install must NOT donate the
+        old buffer away)."""
+        init = PseudoRandomFactorInitializer(4, scale=1.0)
+        t = GrowableFactorTable(init, capacity=64)
+        t.ensure(np.array([1, 2, 3]))
+        snap = t.array
+        before = np.asarray(snap).copy()
+        t.ensure(np.array([10, 11, 12, 13]))  # fresh ids -> install
+        np.testing.assert_array_equal(np.asarray(snap), before)
+
+    def test_pow2_vocab_does_not_double_capacity(self):
+        """A vocab that exactly fills a pow2 capacity must not trigger a
+        growth (and its memory doubling + downstream recompiles) for
+        install-padding headroom alone."""
+        init = PseudoRandomFactorInitializer(2, scale=1.0)
+        t = GrowableFactorTable(init, capacity=256)
+        t.ensure(np.arange(200))
+        t.ensure(np.arange(200, 256))  # lands exactly at capacity
+        assert t.num_rows == 256
+        assert t.capacity == 256, t.capacity
+
     def test_ensure_registers_and_initializes_by_id(self):
         init = PseudoRandomFactorInitializer(4, scale=1.0)
         t = GrowableFactorTable(init, capacity=8)
