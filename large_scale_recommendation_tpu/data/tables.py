@@ -127,13 +127,16 @@ class GrowableFactorTable:
         # its own installer+initializer pair — measured as the dominant
         # cost of the online ingest loop even after warm-up. Small tables
         # (PS shards) keep a small floor so 1-id registrations stay cheap.
-        floor = min(1024, max(8, self.capacity >> 3))
-        pad = max(floor, _next_pow2(m))
         if base + m > self.capacity:
             # grow for REAL need only — padding headroom must not double
             # the table when the vocab lands near a capacity boundary
             self._grow(base + m)
-        pad = min(pad, self.capacity - base)  # boundary clamp (pad ≥ m)
+        # floor from the POST-grow capacity: a growth event must land on
+        # the new capacity's steady-state install shape, not compile a
+        # one-off for the stale smaller floor
+        floor = min(1024, max(8, self.capacity >> 3))
+        pad = min(max(floor, _next_pow2(m)),
+                  self.capacity - base)  # boundary clamp (pad ≥ m)
         self._ids_buf[base:base + m] = uniq[order]
         self._n = base + m
         if self._sorted_cache is not None:
@@ -147,7 +150,10 @@ class GrowableFactorTable:
                 np.insert(s_ids, pos, uniq),
                 np.insert(s_rows, pos, base + rank_of),
             )
-        ids_pad = np.zeros(pad, np.int64)
+        # pad with a REPEATED REAL id, not a fabricated 0: a
+        # domain-sensitive FunctionFactorInitializer (pretrained lookups,
+        # id validation) must only ever see ids the caller registered
+        ids_pad = np.full(pad, self._ids_buf[base + m - 1], np.int64)
         ids_pad[:m] = self._ids_buf[base:base + m]
         fresh = self.initializer(jnp.asarray(ids_pad, dtype=jnp.int32))
         self.array = self._device_put(
