@@ -611,6 +611,12 @@ def _extra_lines(extra: dict, rank: int, jax, h2d_mbps: float,
         extra["online_batch_ms_p99"] = round(
             float(np.percentile(lat, 99)) * 1e3, 1)
         extra["online_batch_ms_max"] = round(max(lat) * 1e3, 1)
+        # steady-state line (second half of the stream): the first batches
+        # carry the one-time jit tail of the shrinking fresh-id sizes, a
+        # cold-start cost a long-lived stream pays once
+        half = lat[len(lat) // 2:]
+        extra["online_ratings_per_s_steady"] = round(
+            on_bs * len(half) / sum(half), 1)
     up_bs = min(20_000, on_bs)
     up_batches = [ngen.generate(up_bs) for _ in range(2)]
     om.partial_fit(up_batches[0])  # warm the updates-emitting path

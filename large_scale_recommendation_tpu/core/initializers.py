@@ -58,9 +58,11 @@ def _keyed_uniform_rows(key: jax.Array, ids, rank: int,
     pass a different fresh-id count every micro-batch, and per-length
     compiles would grow the jit cache without bound.
     """
+    from large_scale_recommendation_tpu.utils.shapes import pow2_pad
+
     ids = np.asarray(ids, dtype=np.int32)
     n = ids.shape[0]
-    padded = max(8, 1 << (max(n - 1, 1)).bit_length())
+    padded = pow2_pad(n)
     if padded != n:
         ids = np.concatenate([ids, np.zeros(padded - n, np.int32)])
     return _keyed_uniform_rows_padded(key, jnp.asarray(ids), rank, scale)[:n]

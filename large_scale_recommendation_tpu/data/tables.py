@@ -27,11 +27,9 @@ from large_scale_recommendation_tpu.core.initializers import FactorInitializer
 from large_scale_recommendation_tpu.core.types import FactorVector
 
 
-def _next_pow2(n: int) -> int:
-    p = 1
-    while p < n:
-        p <<= 1
-    return p
+from large_scale_recommendation_tpu.utils.shapes import (  # noqa: E402
+    next_pow2 as _next_pow2,
+)
 
 
 @jax.jit

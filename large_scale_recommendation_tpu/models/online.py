@@ -49,6 +49,7 @@ from large_scale_recommendation_tpu.core.types import (
 from large_scale_recommendation_tpu.core.updaters import SGDUpdater
 from large_scale_recommendation_tpu.data.tables import GrowableFactorTable
 from large_scale_recommendation_tpu.ops import sgd as sgd_ops
+from large_scale_recommendation_tpu.utils.shapes import pow2_pad
 
 
 @dataclasses.dataclass(frozen=True)
@@ -222,11 +223,22 @@ class OnlineMF:
             return None
 
         # updates-only output: ONE bulk device gather of the touched rows
-        # per side; per-row objects materialize lazily (BatchUpdates)
+        # per side; per-row objects materialize lazily (BatchUpdates).
+        # The gather index is pow2-padded (repeat row 0) so the per-batch
+        # unique-row count doesn't compile a fresh gather kernel every
+        # micro-batch — the same recompile churn measured and fixed in
+        # GrowableFactorTable.ensure (data/tables.py).
         uniq_u, first_u = np.unique(ru, return_index=True)
         uniq_i, first_i = np.unique(ri, return_index=True)
-        u_vecs = np.asarray(U[jnp.asarray(u_rows[first_u])])
-        i_vecs = np.asarray(V[jnp.asarray(i_rows[first_i])])
+
+        def gather(table, rows):
+            n = len(rows)
+            idx = np.zeros(pow2_pad(n), np.int64)
+            idx[:n] = rows
+            return np.asarray(table[jnp.asarray(idx)])[:n]
+
+        u_vecs = gather(U, u_rows[first_u])
+        i_vecs = gather(V, i_rows[first_i])
         return BatchUpdates(
             user_arrays=(uniq_u.astype(np.int64), u_vecs),
             item_arrays=(uniq_i.astype(np.int64), i_vecs),

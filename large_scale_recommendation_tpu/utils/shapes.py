@@ -1,0 +1,25 @@
+"""Shape bucketing shared by every dynamic-size → static-shape seam.
+
+XLA compiles one kernel per shape: any host path that feeds
+data-dependent lengths into jitted (or eager) ops must bucket them, or a
+long stream compiles an unbounded family of one-shot kernels (measured
+as the dominant cost of the online ingest loop — docs/PERF.md
+"Ingest-side host machinery"). One definition so the policy cannot
+silently diverge between the table installer, the initializers, and the
+updates gather.
+"""
+
+from __future__ import annotations
+
+
+def next_pow2(n: int) -> int:
+    """Smallest power of two ≥ n (n ≥ 0; 0 → 1)."""
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+def pow2_pad(n: int, floor: int = 8) -> int:
+    """Pad a dynamic length to its pow2 bucket, with a minimum bucket."""
+    return max(floor, next_pow2(n))
