@@ -642,6 +642,10 @@ def _extra_lines(extra: dict, rank: int, jax, h2d_mbps: float,
                                worker_parallelism=4, ps_parallelism=4,
                                pull_limit=4, chunk_size=512,
                                minibatch_size=4096)
+    # warm-up on a small run: the PS line measures the threads+queues
+    # protocol + jitted chunk kernels, not one-time XLA compiles (every
+    # other line here warms its kernels the same way)
+    PSOfflineMF(ps_cfg).offline(pgen.generate(max(ps_nnz // 10, 5_000)))
     t0 = time.perf_counter()
     PSOfflineMF(ps_cfg).offline(ps_ratings)
     wall = time.perf_counter() - t0
@@ -808,9 +812,11 @@ CPU_FALLBACK_ENV = {
     "BENCH_ALS_CONV_NNZ": "1000000",
     "BENCH_ALS_CONV_TARGET": "0.135",
     "BENCH_ALS_CONV_ROUNDS": "7",
-    "BENCH_ONLINE_BATCHES": "6",
+    "BENCH_ONLINE_BATCHES": "8",
     "BENCH_ONLINE_BATCH": "50000",
-    "BENCH_PS_NNZ": "100000",
+    # full-size PS line: the ingest-path fixes made it cheap enough that
+    # the reduced 100K run's thread-setup overhead dominated the number
+    "BENCH_PS_NNZ": "200000",
 }
 
 

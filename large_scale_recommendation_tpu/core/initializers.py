@@ -35,6 +35,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from large_scale_recommendation_tpu.utils.shapes import pow2_pad
+
 
 @partial(jax.jit, static_argnames=("rank",))
 def _keyed_uniform_rows_padded(key: jax.Array, ids: jax.Array, rank: int,
@@ -58,8 +60,6 @@ def _keyed_uniform_rows(key: jax.Array, ids, rank: int,
     pass a different fresh-id count every micro-batch, and per-length
     compiles would grow the jit cache without bound.
     """
-    from large_scale_recommendation_tpu.utils.shapes import pow2_pad
-
     ids = np.asarray(ids, dtype=np.int32)
     n = ids.shape[0]
     padded = pow2_pad(n)

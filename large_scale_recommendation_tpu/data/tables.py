@@ -25,11 +25,7 @@ import jax.numpy as jnp
 
 from large_scale_recommendation_tpu.core.initializers import FactorInitializer
 from large_scale_recommendation_tpu.core.types import FactorVector
-
-
-from large_scale_recommendation_tpu.utils.shapes import (  # noqa: E402
-    next_pow2 as _next_pow2,
-)
+from large_scale_recommendation_tpu.utils.shapes import next_pow2 as _next_pow2
 
 
 @jax.jit

@@ -289,10 +289,11 @@ def pad_minibatches(
     """
     import numpy as np
 
+    from large_scale_recommendation_tpu.utils.shapes import next_pow2
+
     n = len(u_rows)
     n_mb = max(1, -(-n // minibatch))
-    bucket = 1 << (n_mb - 1).bit_length() if n_mb > 1 else 1
-    padded = bucket * minibatch
+    padded = next_pow2(n_mb) * minibatch  # pow2 minibatch-count buckets
     if buffers is not None:
         if padded not in buffers:
             buffers[padded] = (
