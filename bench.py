@@ -448,9 +448,12 @@ def _extra_lines(extra: dict, rank: int, jax, h2d_mbps: float,
 
     # ---- ALS: bucketed-matmul normal equations, all on device ------------
     als_nnz = int(os.environ.get("BENCH_ALS_NNZ", 2_000_000))
+    # vocab overrides flow through (the fallback runs THESE extras at its
+    # reduced shape — full 162K×59K plans would solve mostly-empty normal
+    # equations on CPU and burn the attempt window)
     (au, ai, ar), _, (anu, ani) = synthetic_like_device(
         "ml-25m", nnz=int(als_nnz / 0.95) + 1, rank=16, noise=0.1, seed=1,
-        skew_lam=2.0)
+        skew_lam=2.0, num_users=num_users, num_items=num_items)
     t0 = time.perf_counter()
     # one prepared set per orientation serves both ranks (chunk geometry
     # sized for the larger) — built on chip, ≤33-int readback each

@@ -154,8 +154,14 @@ class MeshALS:
             from jax.experimental import multihost_utils
             import zlib
 
+            # the digest must cover the (u, i, r) STREAM, not just the id
+            # sets: two hosts with the same ids and values but different
+            # pairings would agree on ids/values bytes yet block
+            # differently (pair-permutation divergence)
             digest = np.int64(zlib.crc32(
                 users.ids.tobytes() + items.ids.tobytes()
+                + np.asarray(ru, np.int64).tobytes()
+                + np.asarray(ri, np.int64).tobytes()
                 + np.asarray(rv, np.float32).tobytes()))
             all_d = np.asarray(multihost_utils.process_allgather(digest))
             if not (all_d == all_d[0]).all():
@@ -185,17 +191,26 @@ class MeshALS:
 
         U, V = ALS(cfg)._init_factors(users, items)
 
-        # process-spanning placement: every process supplies the shards of
-        # its OWN devices from its host copy (the host blocking above is
-        # deterministic, so all processes hold identical arrays — the same
-        # contract as the 2-process DSGD demo). Single-process this is
-        # plain sharded placement.
-        from large_scale_recommendation_tpu.parallel.distributed import (
-            make_global_array,
-        )
+        # placement: single-process uses a device-side reshard (no host
+        # round-trip — np.asarray on the device-resident U/V would pull
+        # the full tables across the narrow host link just to re-upload
+        # them); multi-process assembles globally, each process supplying
+        # the shards of its OWN devices from its host copy (the host
+        # blocking above is deterministic + digest-checked identical).
+        if jax.process_count() > 1:
+            from large_scale_recommendation_tpu.parallel.distributed import (
+                make_global_array,
+            )
 
-        put = lambda x: make_global_array(np.asarray(x), self.mesh,
-                                          P(BLOCK_AXIS))
+            put = lambda x: make_global_array(np.asarray(x), self.mesh,
+                                              P(BLOCK_AXIS))
+        else:
+            from large_scale_recommendation_tpu.parallel.mesh import (
+                block_sharding,
+            )
+
+            shard = block_sharding(self.mesh)
+            put = lambda x: jax.device_put(jnp.asarray(x), shard)
         step_fn = build_mesh_als_step(
             self.mesh, cfg.lambda_, cfg.reg_mode, cfg.iterations,
             len(user_plan), len(item_plan),

@@ -131,6 +131,15 @@ class _WorkerClient:
         all_vals = np.concatenate([p.values for p in parts])
         order = np.argsort(all_ids)
         pos = np.searchsorted(all_ids[order], ids)
+        pos = np.minimum(pos, len(all_ids) - 1)
+        if not (all_ids[order[pos]] == ids).all():
+            # a dropped/mis-routed id would otherwise hand the worker a
+            # NEIGHBORING id's factor row — fail loudly like the dict
+            # merge this replaced did
+            missing = np.asarray(ids)[all_ids[order[pos]] != ids]
+            raise KeyError(
+                f"pull answer is missing ids {missing[:5].tolist()} — "
+                "shard routing bug")
         values = all_vals[order[pos]]  # one composed gather, no sorted copy
         return PullAnswer(ids, values, request_id=part.request_id)
 

@@ -247,12 +247,18 @@ class ShardedCheckpointManager:
             self._retain()
         return shard_name
 
+    _SHARD = re.compile(r"^ckpt_(\d+)\.(?:manifest\.json|shard\d+of\d+\.npz)$")
+
     def _retain(self) -> None:
         steps = self.steps()  # complete checkpoints only
-        for s in steps[: max(0, len(steps) - self.keep)]:
-            for name in os.listdir(self.directory):
-                if name.startswith(f"ckpt_{s}."):
-                    os.unlink(os.path.join(self.directory, name))
+        retire = set(steps[: max(0, len(steps) - self.keep)])
+        for name in os.listdir(self.directory):
+            m = self._SHARD.match(name)
+            # only THIS manager's file kinds: a bare ckpt_<s>.npz is a
+            # legacy monolithic snapshot (protected by the resume guard,
+            # and must survive retention for manual recovery)
+            if m and int(m.group(1)) in retire:
+                os.unlink(os.path.join(self.directory, name))
 
     # -- read ----------------------------------------------------------------
 

@@ -348,7 +348,6 @@ class TestDeviceBlocking:
         np.testing.assert_allclose(np.asarray(straight.U),
                                    np.asarray(resumed.U), rtol=1e-5)
 
-    @pytest.mark.slow
     def test_validate_dense_ids_mixed_host_device_no_int32_wrap(self):
         """A wild int64 id in a HOST array must fail validation even when
         the other side is a device array — the mixed path must not route
@@ -370,6 +369,7 @@ class TestDeviceBlocking:
         device_blocking.validate_dense_ids(
             np.array([0, 1]), dev_ok, 100, 100, "t")
 
+    @pytest.mark.slow
     def test_fuzz_layout_invariants(self):
         """Randomized shapes/skews/weights: the layout contract must hold
         for every draw (multiset preservation, stratum property, weighted
