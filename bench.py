@@ -36,7 +36,10 @@ Env knobs: BENCH_NNZ, BENCH_RANK, BENCH_ITERS (max sweeps), BENCH_MB,
 BENCH_BLOCKS, BENCH_RMSE_TARGET, BENCH_TIMEOUT (per-attempt seconds),
 BENCH_SKIP_EXTRAS (=1 → DSGD line only), BENCH_MIN_MBPS (extras gate),
 BENCH_HOST_PIPELINE (=1 → round-2 host-side gen+blocking path),
-BENCH_SORT (=user|item → intra-minibatch locality ordering),
+BENCH_SORT (intra-minibatch locality ordering, BOTH pipelines; default
+"item" — measured +19% per sweep at identical RMSE, docs/PERF.md
+"Sort lever"; set =none to reproduce earlier unsorted runs, =user for
+the other side),
 BENCH_AUTOTUNE (=1 → A/B the kernel minibatch vs its 2× on one timed
 sweep each, same blocked layout, before the timed run; OFF by default
 because sweep time is only half the story — at full scale mb 65536
@@ -149,6 +152,17 @@ def run_child() -> None:
                      collision_mode="mean")
     solver = DSGD(cfg)
 
+    # BENCH_SORT=user|item|none — intra-minibatch locality ordering, BOTH
+    # pipelines (pure gather/scatter-locality lever, math unchanged).
+    # Default "item": measured at full scale — 19% faster per sweep than
+    # unsorted at IDENTICAL rmse trajectory (docs/PERF.md "Sort lever");
+    # index clustering helps the TPU gather more than the CPU one (~3x
+    # clustering effect, "Kernel facts").
+    sort = os.environ.get("BENCH_SORT", "item")
+    sort = None if sort in ("", "none", "0") else sort
+    if sort:
+        extra["minibatch_sort"] = sort
+
     if os.environ.get("BENCH_HOST_PIPELINE") == "1":
         # round-2 style: host generation + host/native blocking + bulk
         # device_put (~600 MB at the default config — needs a wide link)
@@ -170,7 +184,8 @@ def run_child() -> None:
 
         t0 = time.perf_counter()
         problem = blocking.block_problem(train, num_blocks=blocks, seed=0,
-                                         minibatch_multiple=mb)
+                                         minibatch_multiple=mb,
+                                         minibatch_sort=sort)
         icu, icv = blocking.minibatch_inv_counts(problem.ratings, mb)
         extra["blocking_wall_s"] = round(time.perf_counter() - t0, 1)
         extra["max_pad_ratio"] = round(problem.ratings.max_pad_ratio, 3)
@@ -213,11 +228,6 @@ def run_child() -> None:
         extra["gen_wall_s"] = round(time.perf_counter() - t0, 1)
         train_nnz = int(du.shape[0])
 
-        # BENCH_SORT=user|item: intra-minibatch locality ordering (pure
-        # gather/scatter-locality lever, math unchanged — docs/PERF.md)
-        sort = os.environ.get("BENCH_SORT") or None
-        if sort:
-            extra["minibatch_sort"] = sort
         # BENCH_AUTOTUNE=1 (opt-in): A/B the kernel minibatch against one
         # 2× candidate on a single timed sweep from the SAME blocked layout
         # (pad to the larger candidate; both divide it). Off by default:
