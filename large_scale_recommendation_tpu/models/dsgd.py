@@ -60,7 +60,11 @@ class DSGDConfig:
     # math, removes two full-table scatter+gather rounds per kernel step)
     precompute_collisions: bool = True
     # intra-minibatch ordering ("user"|"item"|None): gather/scatter locality
-    # lever, same math (data.blocking.block_ratings)
+    # lever, same math (data.blocking.block_ratings). Measured at full
+    # ML-25M scale: "item" sweeps ~19% faster at an RMSE trajectory
+    # identical to 4 decimals (docs/PERF.md "Sort lever") — the default
+    # stays None for bit-reproducibility with earlier runs; perf-sensitive
+    # callers should set "item" (the bench does).
     minibatch_sort: str | None = None
 
     def schedule_fn(self):
