@@ -665,6 +665,39 @@ def _extra_lines(extra: dict, rank: int, jax, h2d_mbps: float,
     extra["ps_ratings_per_s"] = round(ps_nnz * ps_cfg.iterations / wall, 1)
     extra["ps_wall_s"] = round(wall, 2)
 
+    # ---- PS online+batch combo (the reference's most intricate mode,
+    # PSOfflineOnlineMF.scala) — online stream with ONE mid-stream batch
+    # retrain trigger; events/s counts each rating exactly once ----------
+    from large_scale_recommendation_tpu.ps import (
+        BATCH_TRIGGER,
+        PSOnlineBatchConfig,
+        PSOnlineBatchMF,
+    )
+
+    ad_nnz = int(os.environ.get("BENCH_PS_ADAPTIVE_NNZ", 50_000))
+    aru, ari, arv, _ = pgen.generate(ad_nnz).to_numpy()
+    events: list = list(zip(aru[: ad_nnz // 2].tolist(),
+                            ari[: ad_nnz // 2].tolist(),
+                            arv[: ad_nnz // 2].tolist()))
+    events.append(BATCH_TRIGGER)
+    events.extend(zip(aru[ad_nnz // 2:].tolist(),
+                      ari[ad_nnz // 2:].tolist(),
+                      arv[ad_nnz // 2:].tolist()))
+    ad_cfg = PSOnlineBatchConfig(
+        num_factors=rank, iterations=2, learning_rate=0.05,
+        lr_schedule="inverse_sqrt", worker_parallelism=4,
+        ps_parallelism=4, chunk_size=512, minibatch_size=4096)
+    # warm-up (same policy as every line here): a small stream with its
+    # own trigger compiles the online AND batch-retrain kernel shapes
+    warm = events[: max(ad_nnz // 10, 2_000)] + [BATCH_TRIGGER] \
+        + events[-1_000:]
+    PSOnlineBatchMF(ad_cfg).run(warm)
+    t0 = time.perf_counter()
+    PSOnlineBatchMF(ad_cfg).run(events)
+    wall = time.perf_counter() - t0
+    extra["ps_adaptive_ratings_per_s"] = round(ad_nnz / wall, 1)
+    extra["ps_adaptive_wall_s"] = round(wall, 2)
+
 
 # --------------------------------------------------------------------------
 # Parent: retry orchestration. Never imports jax itself.

@@ -72,9 +72,13 @@ class GrowableFactorTable:
         # registered ids in row order; row of _ids_buf[j] is j
         self._ids_buf = np.empty(self.capacity, np.int64)
         self._n = 0
-        self.array: jax.Array = self._device_put(
-            jnp.zeros((self.capacity, self.rank), jnp.float32)
-        )
+        self.array = self._make_array()
+
+    def _make_array(self):
+        """Initial storage — subclass hook (HostFactorTable allocates on
+        host instead of paying a device zeros round trip per table)."""
+        return self._device_put(
+            jnp.zeros((self.capacity, self.rank), jnp.float32))
 
     # -- vocabulary --------------------------------------------------------
 
@@ -150,9 +154,12 @@ class GrowableFactorTable:
         ids_pad = np.full(pad, self._ids_buf[base + m - 1], np.int64)
         ids_pad[:m] = self._ids_buf[base:base + m]
         fresh = self.initializer(jnp.asarray(ids_pad, dtype=jnp.int32))
+        self._install(fresh, base)
+        return rows
+
+    def _install(self, fresh, base: int) -> None:
         self.array = self._device_put(
             _install_rows(self.array, fresh, np.int32(base)))
-        return rows
 
     def rows_for(self, ids: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
         """Look up rows WITHOUT registering; unknown ids → row 0, mask 0
@@ -227,3 +234,42 @@ class GrowableFactorTable:
 
     def ids(self) -> list[int]:
         return self._ids_buf[:self._n].tolist()
+
+
+class HostFactorTable(GrowableFactorTable):
+    """Host-resident twin of ``GrowableFactorTable`` — numpy storage, same
+    getOrElseUpdate semantics and id machinery.
+
+    For BOOKKEEPING-ONLY consumers: the PS server shards do nothing but
+    gather rows on pull and add deltas on push (SimplePSLogic.scala:13-24
+    — a JVM hash map in the reference). No matmul ever touches the server
+    table, so device residency bought nothing and cost two device round
+    trips per request — ruinous for the online path's one-rating pulls
+    (measured: ~10 eager dispatches per rating, docs/PERF.md). Worker
+    COMPUTE tables stay on device; this is the parameter shard only.
+    """
+
+    def _make_array(self):
+        return np.zeros((self.capacity, self.rank), np.float32)
+
+    def as_dict(self) -> dict[int, np.ndarray]:
+        """Copies, not views: numpy indexing into the live table would
+        hand out aliases that later pushes mutate in place (the device
+        base class copies implicitly on the device→host transfer)."""
+        host = self.array
+        return {int(i): host[r].copy()
+                for r, i in enumerate(self._ids_buf[:self._n].tolist())}
+
+    def _install(self, fresh, base: int) -> None:
+        f = np.asarray(fresh, dtype=np.float32)
+        self.array[base:base + len(f)] = f
+
+    def _grow(self, need: int) -> None:
+        new_cap = _next_pow2(need)
+        arr = np.zeros((new_cap, self.rank), np.float32)
+        arr[: self.capacity] = self.array
+        self.array = arr
+        ids_buf = np.empty(new_cap, np.int64)
+        ids_buf[: self._n] = self._ids_buf[: self._n]
+        self._ids_buf = ids_buf
+        self.capacity = new_cap

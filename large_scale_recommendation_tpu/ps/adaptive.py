@@ -72,7 +72,7 @@ from large_scale_recommendation_tpu.core.updaters import (
     SGDUpdater,
     schedule_from_name,
 )
-from large_scale_recommendation_tpu.data.tables import GrowableFactorTable
+from large_scale_recommendation_tpu.data.tables import HostFactorTable
 from large_scale_recommendation_tpu.ops import sgd as sgd_ops
 from large_scale_recommendation_tpu.ps.core import PullAnswer
 from large_scale_recommendation_tpu.ps.server import ShardedParameterStore
@@ -389,13 +389,14 @@ class AdaptivePSLogic:
     parameter shard whose behavior depends on the batch lifecycle."""
 
     def __init__(self, initializer, worker_parallelism: int, device=None):
-        import jax
-
-        put = (lambda x: jax.device_put(x, device)) if device is not None \
-            else None
+        # host-resident shard (``device`` ignored, API compat): the server
+        # table is bookkeeping — gathers on pull, adds on push, never a
+        # matmul — and the online path pulls ONE rating's item per request
+        # (reference contract), where a device shard paid ~10 eager
+        # dispatches per rating (see ps/server.py)
+        del device
         self._initializer = initializer
-        self._device_put = put
-        self.table = GrowableFactorTable(initializer, device_put=put)
+        self.table = HostFactorTable(initializer)
         self.state = ONLINE
         self.worker_parallelism = worker_parallelism
         # ≙ workerHasStartedBatch / workerHasFinishedBatch bitsets (:268,283)
@@ -410,7 +411,7 @@ class AdaptivePSLogic:
         not signed yet (the reference drops those, :260-265; see the module
         docstring for why that deadlocks a FIFO channel)."""
         rows = self.table.ensure(ids)
-        return np.asarray(self.table.array[jnp.asarray(rows)])
+        return self.table.array[rows]
 
     def on_push(self, ids: np.ndarray, deltas: np.ndarray, outputs: list,
                 worker_id: int = -1) -> None:
@@ -418,16 +419,13 @@ class AdaptivePSLogic:
             # a stale online push from a worker still pre-trigger (:349-353)
             return
         rows = self.table.ensure(ids)
-        jrows = jnp.asarray(rows)
-        self.table.array = self.table.array.at[jrows].add(
-            jnp.asarray(deltas, dtype=jnp.float32)
-        )
+        np.add.at(self.table.array, rows, np.asarray(deltas, np.float32))
         if self.state == ONLINE:
             # Online pushes emit the updated vectors (:335) — and persist,
             # which the reference's normalUpdate forgets (module docstring)
-            new = np.asarray(self.table.array[jrows])
+            new = self.table.array[rows]
             outputs.extend(
-                (int(i), new[j]) for j, i in enumerate(ids.tolist())
+                (int(i), new[j].copy()) for j, i in enumerate(ids.tolist())
             )
 
     def on_control(self, worker_id: int, payload: Any,
@@ -455,8 +453,7 @@ class AdaptivePSLogic:
         if self.state == ONLINE:
             self.state = BATCH_INIT
             # retrain from scratch: drop every parameter (:313-314)
-            self.table = GrowableFactorTable(self._initializer,
-                                             device_put=self._device_put)
+            self.table = HostFactorTable(self._initializer)
         self._started.add(worker_id)
         if len(self._started) == self.worker_parallelism:
             self.state = BATCH  # (:289-295)
@@ -519,12 +516,8 @@ class PSOnlineBatchMF:
         workers = [OnlineBatchWorkerLogic(cfg, w) for w in range(W)]
         init = PseudoRandomFactorInitializer(cfg.num_factors,
                                              scale=cfg.init_scale)
-        import jax
-
-        devices = jax.local_devices()
         store = ShardedParameterStore(
-            lambda p: AdaptivePSLogic(init, W,
-                                      device=devices[p % len(devices)]),
+            lambda p: AdaptivePSLogic(init, W),
             cfg.ps_parallelism,
         )
         # pull windows are enforced by the worker state machine itself

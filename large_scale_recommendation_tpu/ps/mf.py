@@ -225,14 +225,10 @@ class PSOfflineMF:
                    for w in range(cfg.worker_parallelism)]
         init = PseudoRandomFactorInitializer(cfg.num_factors,
                                              scale=cfg.init_scale)
-        import jax
-
-        devices = jax.local_devices()
+        # shards are host-resident (ps/server.py) — ≙ one JVM hash map per
+        # PS operator instance (FlinkPS.scala:208)
         store = ShardedParameterStore(
-            # one device per PS shard, round-robin — ≙ one task slot per PS
-            # operator instance (FlinkPS.scala:208)
-            lambda p: SimplePSLogic(init, emit_updates=False,
-                                    device=devices[p % len(devices)]),
+            lambda p: SimplePSLogic(init, emit_updates=False),
             cfg.ps_parallelism,
         )
         worker_outs, _ = ps_transform(

@@ -1,23 +1,25 @@
-"""PS server logics: device-table parameter shards.
+"""PS server logics: host-table parameter shards.
 
 ≙ the reference's default server logic (reference:
 ps/server/SimplePSLogic.scala:7-27): an in-memory map with
 pull → ``getOrElseUpdate(init)`` and push → ``update(old, delta)`` + emit
-``(id, newValue)``. Here the shard's storage is a ``GrowableFactorTable`` —
-a dense device array with getOrElseUpdate semantics — so pull answers are
-device gathers and pushes are one scatter-add per request batch.
+``(id, newValue)``. The shard's storage is a ``HostFactorTable`` — the
+reference's shard is a JVM hash map, and OURS is bookkeeping too: no
+matmul ever touches the server table (worker compute tables live on
+device), so device residency bought nothing and cost two device round
+trips per request. Measured on the adaptive online path (one-rating
+pulls, the reference contract): the device shard spent ~10 eager
+dispatches per rating; host-side gather/scatter-add is microseconds.
 """
 
 from __future__ import annotations
 
 from typing import Callable
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 
 from large_scale_recommendation_tpu.core.initializers import FactorInitializer
-from large_scale_recommendation_tpu.data.tables import GrowableFactorTable
+from large_scale_recommendation_tpu.data.tables import HostFactorTable
 
 
 class SimplePSLogic:
@@ -27,25 +29,28 @@ class SimplePSLogic:
     add-delta merge the MF driver uses (PSOfflineMF.scala:277-279).
     ``emit_updates`` controls whether pushes emit (id, new_value) outputs
     (the reference always emits; the offline driver ignores them until the
-    end, so skipping the device→host readback per push is a big win).
+    end, so skipping the per-push row copies is a win).
     """
 
     def __init__(
         self,
         initializer: FactorInitializer,
-        update: Callable[[jax.Array, jax.Array], jax.Array] | None = None,
+        update: Callable | None = None,
         emit_updates: bool = True,
         device=None,
     ):
-        put = (lambda x: jax.device_put(x, device)) if device is not None else None
-        self.table = GrowableFactorTable(initializer, device_put=put)
+        # ``device`` is accepted for API compatibility and ignored: the
+        # shard is host-resident by design (docstring above) — it also
+        # frees the HBM the old per-shard device tables occupied.
+        del device
+        self.table = HostFactorTable(initializer)
         self._update = update  # None → add (vec + delta)
         self.emit_updates = emit_updates
 
     def on_pull(self, ids: np.ndarray) -> np.ndarray:
         """pull → getOrElseUpdate(init) gather (SimplePSLogic.scala:13-18)."""
         rows = self.table.ensure(ids)
-        return np.asarray(self.table.array[jnp.asarray(rows)])
+        return self.table.array[rows]
 
     def on_push(self, ids: np.ndarray, deltas: np.ndarray,
                 outputs: list, worker_id: int = -1) -> None:
@@ -54,21 +59,19 @@ class SimplePSLogic:
 
         Unlike the reference, pushing an id never pulled is allowed (the
         reference throws, SimplePSLogic.scala:22) — ``ensure`` just
-        initializes it; the stricter protocol buys nothing on device."""
+        initializes it; the stricter protocol buys nothing here."""
         rows = self.table.ensure(ids)
-        jrows = jnp.asarray(rows)
-        jdeltas = jnp.asarray(deltas, dtype=jnp.float32)
+        deltas = np.asarray(deltas, dtype=np.float32)
         if self._update is None:
-            self.table.array = self.table.array.at[jrows].add(jdeltas)
+            # np.add.at accumulates duplicate ids like the scatter-add did
+            np.add.at(self.table.array, rows, deltas)
         else:
-            old = self.table.array[jrows]
-            self.table.array = self.table.array.at[jrows].set(
-                self._update(old, jdeltas)
-            )
+            old = self.table.array[rows]
+            self.table.array[rows] = np.asarray(self._update(old, deltas))
         if self.emit_updates:
-            new = np.asarray(self.table.array[jrows])
+            new = self.table.array[rows]
             outputs.extend(
-                (int(i), new[j]) for j, i in enumerate(ids.tolist())
+                (int(i), new[j].copy()) for j, i in enumerate(ids.tolist())
             )
 
     def snapshot(self) -> dict[int, np.ndarray]:
@@ -79,10 +82,9 @@ class ShardedParameterStore:
     """Routes ids to ``ps_parallelism`` shards by ``id % P``.
 
     ≙ the worker→PS hash partitioner (FlinkPS.scala:185-189 /
-    PSOfflineMF.scala:281-286 ``abs(id) % psParallelism``). Device placement
-    is the caller's choice: ``make_logic(p)`` receives the shard index so it
-    can pass ``SimplePSLogic(device=...)`` to spread shards over local
-    devices (as ``PSOfflineMF`` does)."""
+    PSOfflineMF.scala:281-286 ``abs(id) % psParallelism``). Shards are
+    host-resident (see ``SimplePSLogic``); ``make_logic(p)`` still
+    receives the shard index for logics that want per-shard state."""
 
     def __init__(self, make_logic: Callable[[int], SimplePSLogic],
                  ps_parallelism: int):
