@@ -453,6 +453,12 @@ def _extra_lines(extra: dict, rank: int, jax, h2d_mbps: float,
                                        sort=True)
             for label, val in pv_sorted.items():
                 extra[f"kernel_{label}_sorted_ratings_per_s"] = val
+            if pr != 64:
+                # apples-to-apples vs the historical 13.6M r/s figure
+                # (rank 64, round-2 TPU measurement)
+                for label, val in probe_variants(rank=64, mb=2048,
+                                                 reps=5).items():
+                    extra[f"kernel64_{label}_ratings_per_s"] = val
         except Exception as ex:  # never let the experiment kill the extras
             extra["kernel_probe_error"] = f"{type(ex).__name__}: {ex}"
 
