@@ -151,3 +151,55 @@ def test_minibatch_boundary_visibility():
                                rtol=2e-5, atol=2e-6)
     np.testing.assert_allclose(np.asarray(V_p), np.asarray(V_ref),
                                rtol=2e-5, atol=2e-6)
+
+
+def test_full_training_matches_dsgd_train():
+    """dsgd_train_pallas (all strata × blocks × sweeps under one scan)
+    must equal ops.sgd.dsgd_train in the exact-parity configuration:
+    minibatch == block size, so the flat stratum sweep's minibatches
+    coincide with per-block visits in the same order."""
+    from large_scale_recommendation_tpu.core.generators import (
+        SyntheticMFGenerator,
+    )
+    from large_scale_recommendation_tpu.data import blocking
+    from large_scale_recommendation_tpu.models.dsgd import DSGD, DSGDConfig
+    from large_scale_recommendation_tpu.ops.pallas_sgd import (
+        dsgd_train_pallas,
+    )
+
+    gen = SyntheticMFGenerator(num_users=48, num_items=40, rank=4,
+                               noise=0.1, seed=0)
+    train = gen.generate(2000)
+    k = 2
+    b = blocking.block_problem(train, num_blocks=k, seed=0,
+                               minibatch_multiple=1).ratings.u_rows.shape[-1]
+    problem = blocking.block_problem(train, num_blocks=k, seed=0,
+                                     minibatch_multiple=b)
+    b = problem.ratings.u_rows.shape[-1]
+    icu, icv = blocking.minibatch_inv_counts(problem.ratings, b)
+    U0, V0 = DSGD(DSGDConfig(num_factors=8, seed=0,
+                             init_scale=0.2))._init_factors(problem)
+    lr, lam, iters = 0.05, 0.1, 3
+    upd = RegularizedSGDUpdater(learning_rate=lr, lambda_=lam,
+                                schedule=constant_lr)
+    args = (jnp.asarray(problem.ratings.u_rows, jnp.int32),
+            jnp.asarray(problem.ratings.i_rows, jnp.int32),
+            jnp.asarray(problem.ratings.values, jnp.float32),
+            jnp.asarray(problem.ratings.weights, jnp.float32))
+    Uref, Vref = sgd_ops.dsgd_train(
+        jnp.asarray(U0), jnp.asarray(V0), *args,
+        jnp.asarray(problem.users.omega), jnp.asarray(problem.items.omega),
+        jnp.asarray(icu), jnp.asarray(icv),
+        updater=upd, minibatch=b, num_blocks=k, iterations=iters,
+        collision="mean")
+    # same positional order as dsgd_train (drop-in twin)
+    Up, Vp = dsgd_train_pallas(
+        jnp.asarray(U0), jnp.asarray(V0), *args,
+        jnp.asarray(problem.users.omega), jnp.asarray(problem.items.omega),
+        jnp.asarray(icu), jnp.asarray(icv),
+        lr=lr, lam=lam, minibatch=b, num_blocks=k, iterations=iters,
+        gather="take", interpret=True)
+    np.testing.assert_allclose(np.asarray(Up), np.asarray(Uref),
+                               rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(np.asarray(Vp), np.asarray(Vref),
+                               rtol=2e-5, atol=2e-6)
