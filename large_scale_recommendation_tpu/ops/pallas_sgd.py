@@ -21,19 +21,46 @@ stream — at ML-25M shape ~2 GB/sweep, ~100× less latency-bound work than
 the measured gather path.
 
 Two in-kernel gather strategies are built (the hardware question is which
-one Mosaic lowers well on v5e — measure, don't argue; scripts/
-pallas_probe.py):
+one runs faster on v5e — measure, don't argue; scripts/pallas_probe.py).
+Both are written against what Mosaic ACTUALLY lowers — verified chip-free
+by AOT compilation against a v5e topology (scripts/pallas_aot.py; the
+round-4 draft used ``jnp.take`` row-subset gathers and value-level
+``dynamic_slice``, and Mosaic rejects both — see docs/PERF.md "Mosaic
+lowering verdicts"):
 
-- ``gather="take"``: vectorized ``jnp.take`` on the VMEM slice (lowers to
-  Mosaic dynamic-gather where supported);
-- ``gather="loop"``: per-entry ``lax.fori_loop`` of dynamic row loads —
-  the guaranteed-to-lower fallback.
+- ``gather="take"``: the same-shape ``dynamic_gather`` trick. Mosaic's
+  only vectorized gather is ``take_along_axis`` where input, indices and
+  output shapes all MATCH (lax.gather_p lowering rule, jax
+  _src/pallas/mosaic/lowering.py — `tpu.dynamic_gather`). A row-subset
+  gather ([mb] rows out of [rpb]) is therefore expressed by padding the
+  index vector up to the table height, broadcasting it across lanes,
+  gathering [rpb, r]→[rpb, r], and statically slicing the first mb rows.
+  AOT VERDICT: lowers, but Mosaic's backend rejects it at every realistic
+  table height — ``tpu.dynamic_gather`` cannot span vregs along the
+  gather dimension ("Multiple source vregs along gather dimension", i.e.
+  sublane gathers reach at most 8 rows). Kept for parity testing and for
+  future Mosaic versions; NOT the production path.
+- ``gather="loop"`` (default): per-entry row copies ref→ref through a
+  VMEM scratch, with row numbers read as SCALARS from an SMEM copy of
+  the index block (dynamic addressing is only lowerable through Refs,
+  never on values). AOT VERDICT: compiles for v5e at the north-star
+  config (k=16, rank 128, mb 2048) — the production path.
 
 Scatter is a per-entry read-modify-write ``fori_loop`` on the VMEM slice
-either way: sequential within the minibatch, so duplicate rows accumulate
-EXACTLY like the XLA kernel's ``.at[].add`` (and unlike a "last write
-wins" bulk store). Minibatch boundaries see each other's writes through
-the VMEM slice, matching ``lax.scan`` semantics in ``ops.sgd``.
+either way — deltas are first stored to VMEM scratch so every dynamic
+index touches a Ref: sequential within the minibatch, so duplicate rows
+accumulate EXACTLY like the XLA kernel's ``.at[].add`` (and unlike a
+"last write wins" bulk store). Minibatch boundaries see each other's
+writes through the VMEM slice, matching ``lax.scan`` semantics in
+``ops.sgd``.
+
+Layout: per-entry streams are delivered as FULL [n_mb, mb] arrays (block
+== array shape — the only per-minibatch-addressable delivery Mosaic's
+(8, 128) block-tiling rule accepts when n_mb > 1); the kernel slices
+minibatch g's row itself and relayouts it to an [mb, 1] sublane column so
+the delta math is elementwise against the gathered factor rows. The
+row-index streams go to SMEM (scalar loop addressing) and, in take mode
+only, additionally to VMEM (vectorized gather operand).
 
 The updater math is the λ/ω-regularized SGD rule inlined (the bench
 configuration, ``core.updaters.RegularizedSGDUpdater`` with per-row ω
@@ -42,11 +69,14 @@ scaling and precomputed collision scales); parity is pinned against
 mode on CPU — Mosaic lowering and speed are measured on real TPU by the
 probe script).
 
-VMEM budget: U-slice [rpb_u, r] + V-slice [rpb_v, r] + FOUR [mb, r]
-tiles (gathered u, v and deltas du, dv) + per-minibatch index/value
-blocks must fit ~16 MB; at rank 128 that means k=16 blocks for the
-ML-25M shape (5.2 MB + 1.9 MB slices) with mb ≤ 2048 (four 1 MB tiles),
-or rank 64 at k=8. The wrapper checks.
+VMEM budget: U-slice [rpb_u, r] + V-slice [rpb_v, r] + the [mb, r]
+scratch tiles (gathered u, v in loop mode; deltas du, dv always) + the
+full stream arrays (6 f32 + in take mode 2 i32, 4 bytes × e each) must
+fit ~16 MB; at rank 128 that means k=16 blocks for the ML-25M shape
+(5.2 MB + 1.9 MB slices) with mb ≤ 2048. SMEM holds the two full
+row-index copies (2 × e int32) against v5e's 1.0 MB scoped budget,
+capping block-visit nnz at ~115K (k ≥ 16 for ML-25M). The wrapper
+checks both.
 """
 
 from __future__ import annotations
@@ -64,13 +94,58 @@ except ImportError:  # pragma: no cover
     pltpu = None
 
 
-def _sweep_kernel(ur_ref, ir_ref, vals_ref, w_ref, icu_ref, icv_ref,
-                  ou_ref, ov_ref, u_hbm, v_hbm,
-                  u_out, v_out, sems,
-                  *, lr: float, lam: float, mb: int, rank: int,
+def _gather_rows(tbl_ref, idx_col, mb: int, rank: int):
+    """Gather ``mb`` arbitrary rows of a VMEM table via Mosaic's only
+    vectorized gather: same-shape ``take_along_axis`` (tpu.dynamic_gather).
+    ``idx_col`` is the [mb, 1] int32 row-index column; the index vector is
+    padded up to the table height (pad rows re-read row 0 — discarded by
+    the static slice below), broadcast across lanes, gathered, and the
+    first mb rows kept."""
+    x = tbl_ref[...]
+    n = x.shape[0]
+    if mb > n:  # tiny-table case (tests): pad the TABLE up to mb rows
+        x = jnp.concatenate(
+            [x, jnp.zeros((mb - n, rank), x.dtype)], axis=0)
+        n = mb
+    if n > mb:
+        idx_col = jnp.concatenate(
+            [idx_col, jnp.zeros((n - mb, 1), idx_col.dtype)], axis=0)
+    idxb = jnp.broadcast_to(idx_col, (n, rank))
+    out = jnp.take_along_axis(x, idxb, axis=0, mode="promise_in_bounds")
+    return out[:mb]
+
+
+def _sweep_kernel(*refs, lr: float, lam: float, mb: int, rank: int,
                   n_mb: int, gather: str):
     """One grid step = one minibatch. u_out/v_out are the VMEM-resident
-    block slices, persistent across grid steps (constant index_map)."""
+    block slices, persistent across grid steps (constant index_map).
+
+    Stream delivery (AOT-verified — docs/PERF.md "Mosaic lowering
+    verdicts"): per-minibatch blocks like [1, mb] or [mb, 1] violate
+    Mosaic's (8, 128) block-tiling requirement whenever n_mb > 1, so every
+    stream arrives as a FULL [n_mb, mb] array (block == array shape, which
+    the tiling rule exempts) and the kernel slices minibatch g itself — a
+    dynamic sublane-start row slice plus a (1, mb)→(mb, 1) relayout, both
+    of which Mosaic lowers. urs/irs are full SMEM copies of the row
+    indices (scalar loop addressing, read as ``ref[g, j]``); urv/irv the
+    VMEM copies (vectorized gather operand); gu/gv/du/dv are [mb, rank]
+    VMEM scratch so every dynamically-indexed access goes through a Ref
+    (value-level dynamic_slice has no Mosaic lowering rule).
+
+    Mode-conditional operands (the wrapper builds matching specs): the
+    VMEM index copies urv/irv exist only in take mode (loop addresses
+    rows straight from SMEM), and the gu/gv gather scratch exists only in
+    loop mode (take produces the gathered rows as values)."""
+    it = iter(refs)
+    urs_ref, irs_ref = next(it), next(it)
+    urv_ref, irv_ref = ((next(it), next(it)) if gather == "take"
+                        else (None, None))
+    (vals_ref, w_ref, icu_ref, icv_ref, ou_ref, ov_ref,
+     u_hbm, v_hbm, u_out, v_out) = (next(it) for _ in range(10))
+    gu_ref, gv_ref = ((next(it), next(it)) if gather != "take"
+                      else (None, None))
+    du_ref, dv_ref, sems = next(it), next(it), next(it)
+
     g = pl.program_id(0)
 
     # -- step 0: stage the block's factor slices HBM→VMEM (contiguous) ----
@@ -83,48 +158,42 @@ def _sweep_kernel(ur_ref, ir_ref, vals_ref, w_ref, icu_ref, icv_ref,
         cu.wait()
         cv.wait()
 
-    ur = ur_ref[...]
-    ir = ir_ref[...]
-    w = w_ref[...]
+    def col(ref):  # minibatch g's stream as an [mb, 1] sublane column
+        return jnp.reshape(ref[pl.ds(g, 1), :], (mb, 1))
 
     if gather == "take":
-        u = jnp.take(u_out[...], ur, axis=0)
-        v = jnp.take(v_out[...], ir, axis=0)
-    else:  # "loop": guaranteed-to-lower dynamic row loads
+        u = _gather_rows(u_out, col(urv_ref), mb, rank)
+        v = _gather_rows(v_out, col(irv_ref), mb, rank)
+    else:  # "loop": per-entry ref→ref row copies, SMEM scalar addressing
 
-        def load_rows(tbl_ref, rows):
-            def body(j, acc):
-                row = rows[j]
-                acc = jax.lax.dynamic_update_slice(
-                    acc, tbl_ref[pl.ds(row, 1), :], (j, 0))
-                return acc
+        def load_rows(j, _):
+            gu_ref[pl.ds(j, 1), :] = u_out[pl.ds(urs_ref[g, j], 1), :]
+            gv_ref[pl.ds(j, 1), :] = v_out[pl.ds(irs_ref[g, j], 1), :]
+            return 0
 
-            return jax.lax.fori_loop(
-                0, mb, body, jnp.zeros((mb, rank), jnp.float32))
-
-        u = load_rows(u_out, ur)
-        v = load_rows(v_out, ir)
+        jax.lax.fori_loop(0, mb, load_rows, 0)
+        u = gu_ref[...]
+        v = gv_ref[...]
 
     # -- delta: the λ/ω rule (core.updaters.RegularizedSGDUpdater),
-    # vectorized over the minibatch — one fused einsum + elementwise ------
-    e = (vals_ref[...] - jnp.sum(u * v, axis=-1)) * w
+    # vectorized over the minibatch — one fused reduction + elementwise.
+    # All per-entry streams become [mb, 1] columns: entry on sublanes, the
+    # same axis as the gathered rows, so everything is elementwise -------
+    w = col(w_ref)
+    e = (col(vals_ref) - jnp.sum(u * v, axis=-1, keepdims=True)) * w
     t_lr = jnp.float32(lr)
-    gu = jnp.maximum(ou_ref[...], 1.0)
-    gv = jnp.maximum(ov_ref[...], 1.0)
-    du = t_lr * (e[:, None] * v - (lam / gu)[:, None] * u * w[:, None])
-    dv = t_lr * (e[:, None] * u - (lam / gv)[:, None] * v * w[:, None])
-    du = du * icu_ref[...][:, None]
-    dv = dv * icv_ref[...][:, None]
+    gu = jnp.maximum(col(ou_ref), 1.0)
+    gv = jnp.maximum(col(ov_ref), 1.0)
+    du_ref[...] = (t_lr * (e * v - (lam / gu) * u * w)) * col(icu_ref)
+    dv_ref[...] = (t_lr * (e * u - (lam / gv) * v * w)) * col(icv_ref)
 
     # -- scatter: sequential per-entry RMW on the VMEM slice — duplicates
     # accumulate exactly like .at[].add ------------------------------------
     def rmw(j, _):
-        row_u = ur[j]
-        u_out[pl.ds(row_u, 1), :] += jax.lax.dynamic_slice(
-            du, (j, 0), (1, rank))
-        row_v = ir[j]
-        v_out[pl.ds(row_v, 1), :] += jax.lax.dynamic_slice(
-            dv, (j, 0), (1, rank))
+        row_u = urs_ref[g, j]
+        u_out[pl.ds(row_u, 1), :] += du_ref[pl.ds(j, 1), :]
+        row_v = irs_ref[g, j]
+        v_out[pl.ds(row_v, 1), :] += dv_ref[pl.ds(j, 1), :]
         return 0
 
     jax.lax.fori_loop(0, mb, rmw, 0)
@@ -145,7 +214,7 @@ def pallas_block_sweep(
     lr: float,
     lam: float,
     minibatch: int,
-    gather: str = "take",
+    gather: str = "loop",
     interpret: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """Sweep one rating block with VMEM-resident factor slices.
@@ -165,12 +234,34 @@ def pallas_block_sweep(
         raise ValueError(f"block nnz {e} not divisible by mb {minibatch}")
     rank = int(U_blk.shape[-1])
     n_mb = e // minibatch
-    vmem_mb = (U_blk.size + V_blk.size + 4 * minibatch * rank) * 4 / 2**20
+    # VMEM budget (ADVICE r4): resident slices + [mb, rank] scratch tiles
+    # + the full f32 stream arrays (delivered whole — block == array, so
+    # no double buffering) + the take-only extras.
+    rpb_max = max(int(U_blk.shape[0]), int(V_blk.shape[0]))
+    take = gather == "take"
+    # take: + 2 idx streams in VMEM + the transient padded [rpb, rank]
+    # index/output pair (larger side only — the two gathers are
+    # sequential); loop: + 2 gather scratch tiles (du/dv counted always)
+    transient = (2 * rpb_max * rank + 2 * e) if take else 0
+    n_scratch = 2 if take else 4
+    vmem_mb = (U_blk.size + V_blk.size + n_scratch * minibatch * rank
+               + 6 * e + transient) * 4 / 2**20
     if vmem_mb > 15 and not interpret:
         raise ValueError(
-            f"~{vmem_mb:.1f} MB of VMEM-resident state (slices + 4 [mb, "
-            "rank] tiles) exceeds the ~16 MB budget; use more blocks "
-            "(smaller row slices), a smaller minibatch, or a smaller rank")
+            f"~{vmem_mb:.1f} MB of VMEM-resident state (slices + scratch "
+            "tiles + stream arrays"
+            + (" + take-gather transients" if gather == "take" else "")
+            + ") exceeds the ~16 MB budget; use more blocks (smaller row "
+            "slices), a smaller minibatch, a smaller rank, or "
+            "gather='loop'")
+    # SMEM budget (AOT-measured: v5e exposes 1.0 MB of scoped SMEM, and
+    # the two full row-index copies live there for scalar addressing)
+    smem_kb = 2 * e * 4 / 1024
+    if smem_kb > 900 and not interpret:
+        raise ValueError(
+            f"~{smem_kb:.0f} KB of SMEM-resident row indices (2 × {e} "
+            "int32) exceeds the ~1 MB v5e scoped-SMEM budget; use more "
+            "blocks (fewer ratings per block visit)")
 
     # ω gathered host-side per entry would defeat the point; gather the
     # per-ROW omegas inside the kernel instead — they are part of the
@@ -180,30 +271,52 @@ def pallas_block_sweep(
     ou_entry = omega_u[ur_local]
     ov_entry = omega_v[ir_local]
 
-    mbspec = lambda: pl.BlockSpec((minibatch,), lambda g: (g,))
+    # Streams are delivered as FULL [n_mb, mb] arrays (block == array —
+    # the only per-minibatch-addressable shape Mosaic's block-tiling rule
+    # accepts for n_mb > 1; the kernel row-slices minibatch g itself).
+    def rows(a, dt):
+        return jnp.asarray(a, dt).reshape(n_mb, minibatch)
+
+    fullspec = lambda: pl.BlockSpec((n_mb, minibatch), lambda g: (0, 0))
+    smemspec = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)
     kernel = functools.partial(
         _sweep_kernel, lr=lr, lam=lam, mb=minibatch, rank=rank,
         n_mb=n_mb, gather=gather)
+    ur32 = jnp.asarray(ur_local, jnp.int32)
+    ir32 = jnp.asarray(ir_local, jnp.int32)
+    in_specs = [smemspec(), smemspec()]  # ur, ir (scalar loop addressing)
+    operands = [ur32.reshape(n_mb, minibatch),
+                ir32.reshape(n_mb, minibatch)]
+    if take:  # VMEM index copies: the vectorized gather operand
+        in_specs += [fullspec(), fullspec()]
+        operands += [rows(ur32, jnp.int32), rows(ir32, jnp.int32)]
+    in_specs += [fullspec()] * 6 + [
+        pl.BlockSpec(memory_space=pl.ANY),  # U_blk stays in HBM
+        pl.BlockSpec(memory_space=pl.ANY),  # V_blk stays in HBM
+    ]
+    operands += [
+        rows(vals, jnp.float32), rows(w, jnp.float32),
+        rows(icu, jnp.float32), rows(icv, jnp.float32),
+        rows(ou_entry, jnp.float32), rows(ov_entry, jnp.float32),
+        U_blk, V_blk,
+    ]
+    scratch = ([] if take else
+               [pltpu.VMEM((minibatch, rank), jnp.float32),  # gathered u
+                pltpu.VMEM((minibatch, rank), jnp.float32)])  # gathered v
+    scratch += [
+        pltpu.VMEM((minibatch, rank), jnp.float32),  # du
+        pltpu.VMEM((minibatch, rank), jnp.float32),  # dv
+        pltpu.SemaphoreType.DMA((2,)),
+    ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=0,
         grid=(n_mb,),
-        in_specs=[
-            mbspec(),  # ur
-            mbspec(),  # ir
-            mbspec(),  # vals
-            mbspec(),  # w
-            mbspec(),  # icu
-            mbspec(),  # icv
-            mbspec(),  # ou per entry
-            mbspec(),  # ov per entry
-            pl.BlockSpec(memory_space=pl.ANY),  # U_blk stays in HBM
-            pl.BlockSpec(memory_space=pl.ANY),  # V_blk stays in HBM
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec(U_blk.shape, lambda g: (0, 0)),  # persistent VMEM
             pl.BlockSpec(V_blk.shape, lambda g: (0, 0)),
         ],
-        scratch_shapes=[pltpu.SemaphoreType.DMA((2,))],
+        scratch_shapes=scratch,
     )
     return pl.pallas_call(
         kernel,
@@ -213,11 +326,7 @@ def pallas_block_sweep(
             jax.ShapeDtypeStruct(V_blk.shape, jnp.float32),
         ],
         interpret=interpret,
-    )(ur_local.astype(jnp.int32), ir_local.astype(jnp.int32),
-      vals.astype(jnp.float32), w.astype(jnp.float32),
-      icu.astype(jnp.float32), icv.astype(jnp.float32),
-      ou_entry.astype(jnp.float32), ov_entry.astype(jnp.float32),
-      U_blk, V_blk)
+    )(*operands)
 
 
 @functools.partial(jax.jit, static_argnames=("rank", "mb", "rpb_u",
@@ -339,7 +448,7 @@ def dsgd_train_pallas(
     minibatch: int,
     num_blocks: int,
     iterations: int,
-    gather: str = "take",
+    gather: str = "loop",
     interpret: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """Full DSGD training through the VMEM-staged Pallas kernel — the

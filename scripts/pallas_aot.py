@@ -1,0 +1,163 @@
+"""Chip-free Mosaic lowering verdicts: AOT-compile the Pallas DSGD kernel
+against a real TPU topology.
+
+The round-4 kernel was validated only in interpreter mode; interpret mode
+validates semantics, not lowerability (VERDICT r4 "what's weak" #2). This
+script retires that risk WITHOUT a live chip: ``libtpu`` is installed, and
+Mosaic compilation happens inside the XLA:TPU compiler at ``.compile()``
+time, so a compile-only PJRT client reached through
+``jax.experimental.topologies.get_topology_desc`` runs the REAL lowering
+pipeline — BlockSpec legalization, Mosaic vectorization, VMEM allocation —
+with no device attached.
+
+Usage:  python scripts/pallas_aot.py [topology]   (default v5e:2x2)
+
+Prints one JSON line per (kernel, config, gather):
+``{"kernel": ..., "config": ..., "gather": ..., "topology": ...,
+"ok": bool, "detail": ...}`` with the verbatim Mosaic error on failure,
+and writes the full list to ``docs/MOSAIC_AOT.json`` (default topology
+only — exploratory topologies get a ``docs/MOSAIC_AOT.<topology>.json``
+suffix so the committed v5e verdicts that PERF.md cites are never
+clobbered). Exit code 0 iff every production (gather="loop") variant
+compiled; "take" failures are recorded verdicts, not regressions.
+Narrative in docs/PERF.md ("Mosaic lowering verdicts").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from large_scale_recommendation_tpu.utils.platform import force_cpu  # noqa: E402
+
+jax = force_cpu()
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.experimental import topologies  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec  # noqa: E402
+
+
+def tpu_sharding(topology_name: str):
+    topo = topologies.get_topology_desc(
+        platform="tpu", topology_name=topology_name)
+    mesh = Mesh(np.array(topo.devices[:1]).reshape(1), ("d",))
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def compile_block_sweep(s, *, rank, mb, rpb_u, rpb_v, nnz, gather):
+    """AOT-compile one pallas_block_sweep variant; returns (ok, detail)."""
+    from large_scale_recommendation_tpu.ops.pallas_sgd import (
+        pallas_block_sweep,
+    )
+
+    e = nnz - nnz % mb
+
+    def make(shape, dt):
+        return jax.ShapeDtypeStruct(shape, dt, sharding=s)
+
+    args = (
+        make((rpb_u, rank), jnp.float32), make((rpb_v, rank), jnp.float32),
+        make((e,), jnp.int32), make((e,), jnp.int32),
+        make((e,), jnp.float32), make((e,), jnp.float32),
+        make((e,), jnp.float32), make((e,), jnp.float32),
+        make((rpb_u,), jnp.float32), make((rpb_v,), jnp.float32),
+    )
+    f = jax.jit(lambda *a: pallas_block_sweep(
+        *a, lr=0.1, lam=0.1, minibatch=mb, gather=gather))
+    try:
+        f.lower(*args).compile()
+        return True, "compiled"
+    except Exception as ex:  # noqa: BLE001 — the error text IS the result
+        return False, f"{type(ex).__name__}: {str(ex)[:400]}"
+
+
+def compile_full_training(s, *, rank, mb, rpb_u, rpb_v, k, gather):
+    """AOT-compile dsgd_train_pallas (the lax.scan-of-pallas_call loop)."""
+    from large_scale_recommendation_tpu.ops.pallas_sgd import (
+        dsgd_train_pallas,
+    )
+
+    b = mb  # one minibatch per block visit is enough to exercise lowering
+
+    def make(shape, dt):
+        return jax.ShapeDtypeStruct(shape, dt, sharding=s)
+
+    args = (
+        make((k * rpb_u, rank), jnp.float32),
+        make((k * rpb_v, rank), jnp.float32),
+        make((k, k, b), jnp.int32), make((k, k, b), jnp.int32),
+        make((k, k, b), jnp.float32), make((k, k, b), jnp.float32),
+        make((k * rpb_u,), jnp.float32), make((k * rpb_v,), jnp.float32),
+        make((k, k, b), jnp.float32), make((k, k, b), jnp.float32),
+    )
+    f = jax.jit(lambda *a: dsgd_train_pallas(
+        *a, lr=0.1, lam=0.1, minibatch=mb, num_blocks=k, iterations=1,
+        gather=gather))
+    try:
+        f.lower(*args).compile()
+        return True, "compiled"
+    except Exception as ex:  # noqa: BLE001
+        return False, f"{type(ex).__name__}: {str(ex)[:400]}"
+
+
+# (config label, kwargs) — the north-star block shape at k=16 (ML-25M
+# geometry: 162541/16=10160 user rows, 59047/16=3696 item rows per block,
+# 25M/256 visits ≈ 92K nnz per block visit), the k=32 halving, and the
+# rank-64 twin (k=16: the k=8 rank-64 shape is SMEM-infeasible — two full
+# 184K-entry index copies need 1.5 MB of v5e's 1.0 MB scoped SMEM; the
+# wrapper's budget check now rejects it up front).
+BLOCK_CONFIGS = [
+    ("k16_rank128_mb2048",
+     dict(rank=128, mb=2048, rpb_u=10160, rpb_v=3696, nnz=92160)),
+    ("k32_rank128_mb2048",
+     dict(rank=128, mb=2048, rpb_u=5080, rpb_v=1848, nnz=46080)),
+    ("k16_rank64_mb2048",
+     dict(rank=64, mb=2048, rpb_u=10160, rpb_v=3696, nnz=92160)),
+]
+
+
+def main() -> int:
+    topology_name = sys.argv[1] if len(sys.argv) > 1 else "v5e:2x2"
+    s = tpu_sharding(topology_name)
+    results = []
+    for label, cfg in BLOCK_CONFIGS:
+        for gather in ("take", "loop"):
+            ok, detail = compile_block_sweep(s, gather=gather, **cfg)
+            results.append({
+                "kernel": "block_sweep", "config": label,
+                "gather": gather, "topology": topology_name,
+                "ok": ok, "detail": detail,
+            })
+            print(json.dumps(results[-1]), flush=True)
+    for gather in ("take", "loop"):
+        ok, detail = compile_full_training(
+            s, rank=128, mb=2048, rpb_u=10160, rpb_v=3696, k=4,
+            gather=gather)
+        results.append({
+            "kernel": "dsgd_train_pallas", "config": "k4_rank128_mb2048",
+            "gather": gather, "topology": topology_name,
+            "ok": ok, "detail": detail,
+        })
+        print(json.dumps(results[-1]), flush=True)
+
+    suffix = "" if topology_name == "v5e:2x2" else (
+        "." + topology_name.replace(":", "_").replace("/", "_"))
+    out = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", f"MOSAIC_AOT{suffix}.json")
+    with open(out, "w") as fh:
+        json.dump(results, fh, indent=1)
+
+    # gather="loop" is the production path: it must compile everywhere.
+    # gather="take" failures are recorded verdicts, not regressions
+    # (tpu.dynamic_gather cannot span vregs — see ops/pallas_sgd.py).
+    return 1 if any(
+        not r["ok"] for r in results if r["gather"] == "loop") else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
