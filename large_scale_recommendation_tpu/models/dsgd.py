@@ -66,6 +66,12 @@ class DSGDConfig:
     # stays None for bit-reproducibility with earlier runs; perf-sensitive
     # callers should set "item" (the bench does).
     minibatch_sort: str | None = None
+    # "xla" (ops.sgd.dsgd_train) | "pallas" (ops.pallas_sgd VMEM-staged
+    # sweeps — AOT-verified to compile for v5e, docs/PERF.md "Mosaic
+    # lowering verdicts"). The pallas path inlines the λ/ω rule, so it
+    # requires the default RegularizedSGDUpdater family,
+    # collision_mode="mean" and precompute_collisions=True.
+    kernel: str = "xla"
 
     def schedule_fn(self):
         return schedule_from_name(self.lr_schedule, self.lambda_)
@@ -169,17 +175,10 @@ class DSGD:
         # Module-level jitted train fn: stable function object + hashable
         # static args (frozen-dataclass updater) → refits/segments with the
         # same shapes/config hit the XLA compile cache.
+        train = self._train_fn(args)
         while done < cfg.iterations:
             seg = min(segment, cfg.iterations - done)
-            U, V = sgd_ops.dsgd_train(
-                U, V, *args,
-                updater=self.updater,
-                minibatch=cfg.minibatch_size,
-                num_blocks=k,
-                iterations=seg,
-                collision=cfg.collision_mode,
-                t0=done,
-            )
+            U, V = train(U, V, iterations=seg, t0=done, k=k)
             done += seg
             if checkpoint_manager is not None:
                 checkpoint_manager.save(
@@ -187,6 +186,52 @@ class DSGD:
                     {"kind": kind, "iterations": cfg.iterations},
                 )
         return U, V
+
+    def _train_fn(self, args):
+        """Kernel routing for the segment loop: ``cfg.kernel`` picks the
+        XLA scatter-add path (default) or the VMEM-staged Pallas path
+        (``ops.pallas_sgd.dsgd_train_pallas`` — the drop-in twin, same
+        positional layout; parity pinned by tests/test_pallas_sgd.py at
+        minibatch == and < block size, with and without LR schedules)."""
+        cfg = self.config
+
+        def xla(U, V, *, iterations, t0, k):
+            return sgd_ops.dsgd_train(
+                U, V, *args,
+                updater=self.updater,
+                minibatch=cfg.minibatch_size,
+                num_blocks=k,
+                iterations=iterations,
+                collision=cfg.collision_mode,
+                t0=t0,
+            )
+
+        if cfg.kernel == "xla":
+            return xla
+        if cfg.kernel != "pallas":
+            raise ValueError(
+                f"unknown kernel {cfg.kernel!r}; expected 'xla' or 'pallas'")
+
+        from large_scale_recommendation_tpu.ops.pallas_sgd import (
+            default_interpret,
+            dsgd_train_pallas,
+            validate_pallas_contract,
+        )
+
+        upd = self.updater
+        validate_pallas_contract(upd, cfg.collision_mode,
+                                 args[-1] is not None)
+
+        def pallas(U, V, *, iterations, t0, k):
+            return dsgd_train_pallas(
+                U, V, *args,
+                lr=float(upd.learning_rate), lam=float(upd.lambda_),
+                minibatch=cfg.minibatch_size, num_blocks=k,
+                iterations=iterations, interpret=default_interpret(),
+                schedule=upd.schedule, t0=t0,
+            )
+
+        return pallas
 
     def fit_device(
         self,

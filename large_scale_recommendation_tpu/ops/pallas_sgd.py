@@ -94,6 +94,28 @@ except ImportError:  # pragma: no cover
     pltpu = None
 
 
+def default_interpret() -> bool:
+    """True when the default backend cannot run Mosaic kernels (CPU/GPU
+    test environments) — the routing default for ``kernel='pallas'``
+    callers that don't pass ``interpret`` explicitly."""
+    return jax.default_backend() != "tpu"
+
+
+def validate_pallas_contract(updater, collision: str, has_inv: bool):
+    """The ``kernel='pallas'`` routing contract, shared by the
+    single-device (models.dsgd) and mesh (parallel.dsgd_mesh) routes so
+    they cannot drift: the kernel inlines the λ/ω RegularizedSGDUpdater
+    rule and consumes precomputed collision scales."""
+    missing = [a for a in ("learning_rate", "lambda_", "schedule")
+               if not hasattr(updater, a)]
+    if missing or collision != "mean" or not has_inv:
+        raise ValueError(
+            "kernel='pallas' inlines the λ/ω RegularizedSGDUpdater rule "
+            "and the precomputed collision scales; it requires an updater "
+            f"with learning_rate/lambda_/schedule (missing: {missing}), "
+            "collision_mode='mean' and precompute_collisions=True")
+
+
 def _gather_rows(tbl_ref, idx_col, mb: int, rank: int):
     """Gather ``mb`` arbitrary rows of a VMEM table via Mosaic's only
     vectorized gather: same-shape ``take_along_axis`` (tpu.dynamic_gather).
@@ -115,7 +137,7 @@ def _gather_rows(tbl_ref, idx_col, mb: int, rank: int):
     return out[:mb]
 
 
-def _sweep_kernel(*refs, lr: float, lam: float, mb: int, rank: int,
+def _sweep_kernel(*refs, lam: float, mb: int, rank: int,
                   n_mb: int, gather: str):
     """One grid step = one minibatch. u_out/v_out are the VMEM-resident
     block slices, persistent across grid steps (constant index_map).
@@ -137,6 +159,8 @@ def _sweep_kernel(*refs, lr: float, lam: float, mb: int, rank: int,
     rows straight from SMEM), and the gu/gv gather scratch exists only in
     loop mode (take produces the gathered rows as values)."""
     it = iter(refs)
+    lr_ref = next(it)  # [1, 1] SMEM — the schedule-evaluated η for this
+    # visit (runtime scalar so decaying schedules don't recompile)
     urs_ref, irs_ref = next(it), next(it)
     urv_ref, irv_ref = ((next(it), next(it)) if gather == "take"
                         else (None, None))
@@ -181,7 +205,7 @@ def _sweep_kernel(*refs, lr: float, lam: float, mb: int, rank: int,
     # same axis as the gathered rows, so everything is elementwise -------
     w = col(w_ref)
     e = (col(vals_ref) - jnp.sum(u * v, axis=-1, keepdims=True)) * w
-    t_lr = jnp.float32(lr)
+    t_lr = lr_ref[0, 0]
     gu = jnp.maximum(col(ou_ref), 1.0)
     gv = jnp.maximum(col(ov_ref), 1.0)
     du_ref[...] = (t_lr * (e * v - (lam / gu) * u * w)) * col(icu_ref)
@@ -211,7 +235,7 @@ def pallas_block_sweep(
     omega_u: jax.Array,  # f32[rpb_u] per-row ω for the λ/ω rule
     omega_v: jax.Array,
     *,
-    lr: float,
+    lr: float | jax.Array,
     lam: float,
     minibatch: int,
     gather: str = "loop",
@@ -280,12 +304,19 @@ def pallas_block_sweep(
     fullspec = lambda: pl.BlockSpec((n_mb, minibatch), lambda g: (0, 0))
     smemspec = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)
     kernel = functools.partial(
-        _sweep_kernel, lr=lr, lam=lam, mb=minibatch, rank=rank,
+        _sweep_kernel, lam=lam, mb=minibatch, rank=rank,
         n_mb=n_mb, gather=gather)
     ur32 = jnp.asarray(ur_local, jnp.int32)
     ir32 = jnp.asarray(ir_local, jnp.int32)
-    in_specs = [smemspec(), smemspec()]  # ur, ir (scalar loop addressing)
-    operands = [ur32.reshape(n_mb, minibatch),
+    # lr arrives as a runtime SMEM scalar: a python float stays one compile,
+    # and a schedule-evaluated traced scalar (dsgd_train_pallas) reuses the
+    # SAME compiled kernel across sweeps
+    in_specs = [smemspec(),  # lr
+                smemspec(), smemspec()]  # ur, ir (scalar loop addressing)
+    operands = [jnp.full((1, 1), lr, jnp.float32)
+                if not isinstance(lr, jax.Array)
+                else jnp.asarray(lr, jnp.float32).reshape(1, 1),
+                ur32.reshape(n_mb, minibatch),
                 ir32.reshape(n_mb, minibatch)]
     if take:  # VMEM index copies: the vectorized gather operand
         in_specs += [fullspec(), fullspec()]
@@ -318,13 +349,17 @@ def pallas_block_sweep(
         ],
         scratch_shapes=scratch,
     )
+    # vma: propagate the mesh axes the inputs vary over, so the kernel
+    # composes with shard_map under check_vma (the mesh kernel="pallas"
+    # route); outside shard_map this is the empty set
+    def out(a):
+        return jax.ShapeDtypeStruct(
+            a.shape, jnp.float32, vma=getattr(jax.typeof(a), "vma", None))
+
     return pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=[
-            jax.ShapeDtypeStruct(U_blk.shape, jnp.float32),
-            jax.ShapeDtypeStruct(V_blk.shape, jnp.float32),
-        ],
+        out_shape=[out(U_blk), out(V_blk)],
         interpret=interpret,
     )(*operands)
 
@@ -430,7 +465,7 @@ def probe_variants(rank: int = 128, mb: int = 2048, rpb_u: int = 10160,
 
 @functools.partial(jax.jit, static_argnames=(
     "lr", "lam", "minibatch", "num_blocks", "iterations", "gather",
-    "interpret"))
+    "interpret", "schedule"))
 def dsgd_train_pallas(
     U: jax.Array,  # f32[k*rpb_u, r]
     V: jax.Array,  # f32[k*rpb_v, r]
@@ -450,6 +485,8 @@ def dsgd_train_pallas(
     iterations: int,
     gather: str = "loop",
     interpret: bool = False,
+    schedule=None,
+    t0: jax.Array | int = 0,
 ) -> tuple[jax.Array, jax.Array]:
     """Full DSGD training through the VMEM-staged Pallas kernel — the
     drop-in twin of ``ops.sgd.dsgd_train`` (same stratum-major layout from
@@ -457,11 +494,18 @@ def dsgd_train_pallas(
     on hardware can be exercised on the WHOLE training loop immediately.
 
     Visit order: for each sweep, strata s = 0..k-1; within a stratum the
-    k disjoint blocks run sequentially p = 0..k-1 — identical to the flat
-    stratum order of ``dsgd_train`` when ``minibatch == b`` (one minibatch
-    per block), which is the exact-parity configuration the tests pin.
-    Constant learning rate (the kernel inlines the λ/ω rule; schedule
-    support belongs to the XLA path until the kernel earns its place).
+    k disjoint blocks run sequentially p = 0..k-1. Because the blocked
+    layout deals each stratum's entries block-major, this is IDENTICAL to
+    the flat stratum order of ``dsgd_train`` for every ``minibatch`` that
+    divides the block size — pinned by tests at ``minibatch == b`` and
+    ``minibatch < b``.
+
+    ``schedule`` (static, same callables as ``core.updaters``) and ``t0``
+    give full LR-schedule parity with the XLA path: the per-sweep η is
+    evaluated OUTSIDE the kernel at trace level (t = visit // k² + 1 + t0,
+    the ``dsgd_train`` superstep convention) and enters the kernel as a
+    runtime SMEM scalar — so a decaying schedule costs zero recompiles.
+    ``schedule=None`` keeps the constant-η behavior.
 
     Each block visit slices the block's contiguous factor-row ranges,
     runs the Pallas sweep against them, and writes them back — under one
@@ -481,7 +525,13 @@ def dsgd_train_pallas(
 
     def visit(carry, sp):
         U, V = carry
-        s, p = sp[0], sp[1]
+        s, p, v_idx = sp[0], sp[1], sp[2]
+        # superstep convention of dsgd_train: t advances once per SWEEP
+        # (k strata × k blocks = k² visits), continuing from t0 on
+        # checkpoint segments
+        t = v_idx // (k * k) + 1 + jnp.asarray(t0, jnp.int32)
+        lr_t = (jnp.float32(lr) if schedule is None
+                else schedule(jnp.float32(lr), t))
         q = (p + s) % k
         # clamp: weight-0 PADDING entries carry global row 0, which maps
         # to a NEGATIVE local index for blocks p>0 — their deltas are zero
@@ -496,7 +546,7 @@ def dsgd_train_pallas(
         Ub, Vb = pallas_block_sweep(
             U_blk, V_blk, ur_l, ir_l, sv[s, p], sw[s, p],
             icu[s, p], icv[s, p], ou_blk, ov_blk,
-            lr=lr, lam=lam, minibatch=minibatch, gather=gather,
+            lr=lr_t, lam=lam, minibatch=minibatch, gather=gather,
             interpret=interpret)
         U = jax.lax.dynamic_update_slice(U, Ub, (p * rpb_u, 0))
         V = jax.lax.dynamic_update_slice(V, Vb, (q * rpb_v, 0))
@@ -504,5 +554,6 @@ def dsgd_train_pallas(
 
     ss = jnp.tile(jnp.repeat(jnp.arange(k, dtype=jnp.int32), k), iterations)
     ps = jnp.tile(jnp.tile(jnp.arange(k, dtype=jnp.int32), k), iterations)
-    (U, V), _ = jax.lax.scan(visit, (U, V), jnp.stack([ss, ps], axis=1))
+    vs = jnp.arange(iterations * k * k, dtype=jnp.int32)
+    (U, V), _ = jax.lax.scan(visit, (U, V), jnp.stack([ss, ps, vs], axis=1))
     return U, V

@@ -78,6 +78,8 @@ def build_mesh_dsgd_step(
     iterations: int,
     collision: str = "mean",
     with_inv: bool = False,
+    kernel: str = "xla",
+    pallas_interpret: bool = False,
 ):
     """Build the jitted multi-chip training function.
 
@@ -92,12 +94,25 @@ def build_mesh_dsgd_step(
     perm = ring_backward(k)
     spec = P(BLOCK_AXIS)
     n_sharded = 10 if with_inv else 8
+    if kernel not in ("xla", "pallas"):
+        raise ValueError(
+            f"unknown kernel {kernel!r}; expected 'xla' or 'pallas'")
+    if kernel == "pallas":
+        from large_scale_recommendation_tpu.ops.pallas_sgd import (
+            validate_pallas_contract,
+        )
+
+        validate_pallas_contract(updater, collision, with_inv)
 
     @partial(
         shard_map,
         mesh=mesh,
         in_specs=(spec,) * n_sharded + (P(),),
         out_specs=(spec, spec),
+        # the Pallas interpreter's internal scan drops varying-axis
+        # metadata on index arrays (jax hlo_interpreter.py suggests this
+        # exact workaround); the XLA route keeps the checker on
+        check_vma=kernel != "pallas" or not pallas_interpret,
     )
     def run(U_l, V_l, ru_l, ri_l, rv_l, rw_l, ou_l, ov_l, *rest):
         # shard_map gives [1, k, b] for the device-major strata; drop the
@@ -116,12 +131,28 @@ def build_mesh_dsgd_step(
             # η/√t schedule continues instead of restarting (same contract
             # as ops.sgd.dsgd_train)
             t = idx // k + 1 + t0
-            U, V = sgd_ops.sgd_block_sweep(
-                U, V, ru[s], ri[s], rv[s], rw[s], ou_l, ov,
-                updater, t, minibatch, collision,
-                None if icu is None else icu[s],
-                None if icv is None else icv[s],
-            )
+            if kernel == "pallas":
+                from large_scale_recommendation_tpu.ops.pallas_sgd import (
+                    pallas_block_sweep,
+                )
+
+                # per-device block sweep through the VMEM-staged kernel;
+                # η evaluated here (trace level) and passed as a runtime
+                # scalar — same convention as ops.pallas_sgd.dsgd_train_pallas
+                lr_t = updater.schedule(
+                    jnp.float32(updater.learning_rate), t)
+                U, V = pallas_block_sweep(
+                    U, V, ru[s], ri[s], rv[s], rw[s], icu[s], icv[s],
+                    ou_l, ov, lr=lr_t, lam=float(updater.lambda_),
+                    minibatch=minibatch, interpret=pallas_interpret,
+                )
+            else:
+                U, V = sgd_ops.sgd_block_sweep(
+                    U, V, ru[s], ri[s], rv[s], rw[s], ou_l, ov,
+                    updater, t, minibatch, collision,
+                    None if icu is None else icu[s],
+                    None if icv is None else icv[s],
+                )
             # Rotate the item shard (and its omegas) one step down the ring
             # — ≙ the reference's inter-superstep shuffle of item blocks
             # (DSGDforMF.scala:611-619 / OfflineSpark.scala:196-201), now an
@@ -154,6 +185,7 @@ class MeshDSGDConfig:
     collision_mode: str = "mean"  # see ops.sgd.sgd_minibatch_update
     precompute_collisions: bool = True  # see DSGDConfig
     minibatch_sort: str | None = None  # see DSGDConfig
+    kernel: str = "xla"  # "xla" | "pallas" — see DSGDConfig.kernel
 
 
 class MeshDSGD:
@@ -341,12 +373,17 @@ class MeshDSGD:
         with_inv = bool(inv_args)
         inv_args = tuple(put(x) for x in inv_args)
 
+        from large_scale_recommendation_tpu.ops.pallas_sgd import (
+            default_interpret,
+        )
+
         segment = checkpoint_every or cfg.iterations
         while done < cfg.iterations:
             seg = min(segment, cfg.iterations - done)
             step_fn = build_mesh_dsgd_step(
                 self.mesh, self.updater, cfg.minibatch_size, k, seg,
-                cfg.collision_mode, with_inv,
+                cfg.collision_mode, with_inv, cfg.kernel,
+                default_interpret() if cfg.kernel == "pallas" else False,
             )
             U, V = step_fn(U, V, *args, ou, ov, *inv_args,
                            jnp.asarray(done, jnp.int32))

@@ -121,6 +121,50 @@ BLOCK_CONFIGS = [
 ]
 
 
+def compile_mesh_step(topology_name, *, rank, mb, rpb_u, rpb_v, k):
+    """AOT-compile the MESH route: shard_map + ppermute rotation with
+    per-device block sweeps through the Pallas kernel (kernel='pallas' on
+    MeshDSGDConfig), over all devices of the topology."""
+    from large_scale_recommendation_tpu.core.updaters import (
+        RegularizedSGDUpdater,
+        inverse_sqrt_lr,
+    )
+    from large_scale_recommendation_tpu.parallel.dsgd_mesh import (
+        build_mesh_dsgd_step,
+    )
+    from large_scale_recommendation_tpu.parallel.mesh import BLOCK_AXIS
+
+    topo = topologies.get_topology_desc(
+        platform="tpu", topology_name=topology_name)
+    devs = np.array(topo.devices[:k])
+    mesh = Mesh(devs, (BLOCK_AXIS,))
+    shard = NamedSharding(mesh, PartitionSpec(BLOCK_AXIS))
+    repl = NamedSharding(mesh, PartitionSpec())
+    upd = RegularizedSGDUpdater(learning_rate=0.05, lambda_=0.1,
+                                schedule=inverse_sqrt_lr)
+    step = build_mesh_dsgd_step(mesh, upd, mb, k, 1, "mean", True,
+                                "pallas", False)
+
+    def sh(shape):
+        return jax.ShapeDtypeStruct(shape, jnp.float32, sharding=shard)
+
+    def shi(shape):
+        return jax.ShapeDtypeStruct(shape, jnp.int32, sharding=shard)
+
+    b = mb  # one minibatch per block visit exercises the whole lowering
+    args = (sh((k * rpb_u, rank)), sh((k * rpb_v, rank)),
+            shi((k, k, b)), shi((k, k, b)),
+            sh((k, k, b)), sh((k, k, b)),
+            sh((k * rpb_u,)), sh((k * rpb_v,)),
+            sh((k, k, b)), sh((k, k, b)),
+            jax.ShapeDtypeStruct((), jnp.int32, sharding=repl))
+    try:
+        step.lower(*args).compile()
+        return True, "compiled"
+    except Exception as ex:  # noqa: BLE001
+        return False, f"{type(ex).__name__}: {str(ex)[:400]}"
+
+
 def main() -> int:
     topology_name = sys.argv[1] if len(sys.argv) > 1 else "v5e:2x2"
     s = tpu_sharding(topology_name)
@@ -144,6 +188,15 @@ def main() -> int:
             "ok": ok, "detail": detail,
         })
         print(json.dumps(results[-1]), flush=True)
+
+    ok, detail = compile_mesh_step(
+        topology_name, rank=128, mb=2048, rpb_u=10160, rpb_v=3696, k=4)
+    results.append({
+        "kernel": "mesh_dsgd_step[kernel=pallas]",
+        "config": "k4_rank128_mb2048", "gather": "loop",
+        "topology": topology_name, "ok": ok, "detail": detail,
+    })
+    print(json.dumps(results[-1]), flush=True)
 
     suffix = "" if topology_name == "v5e:2x2" else (
         "." + topology_name.replace(":", "_").replace("/", "_"))

@@ -153,11 +153,11 @@ def test_minibatch_boundary_visibility():
                                rtol=2e-5, atol=2e-6)
 
 
-def test_full_training_matches_dsgd_train():
-    """dsgd_train_pallas (all strata × blocks × sweeps under one scan)
-    must equal ops.sgd.dsgd_train in the exact-parity configuration:
-    minibatch == block size, so the flat stratum sweep's minibatches
-    coincide with per-block visits in the same order."""
+def _full_training_pair(minibatch_divisor: int, schedule, iters: int = 3,
+                        t0: int = 0, gather: str = "loop"):
+    """Run ops.sgd.dsgd_train and dsgd_train_pallas on the same blocked
+    problem; ``minibatch = block_size // minibatch_divisor``. Returns
+    ((Uref, Vref), (Up, Vp))."""
     from large_scale_recommendation_tpu.core.generators import (
         SyntheticMFGenerator,
     )
@@ -173,32 +173,94 @@ def test_full_training_matches_dsgd_train():
     k = 2
     b = blocking.block_problem(train, num_blocks=k, seed=0,
                                minibatch_multiple=1).ratings.u_rows.shape[-1]
+    # pad the block to a multiple of the divisor so mb divides b exactly
+    mb_mult = -(-b // minibatch_divisor)
     problem = blocking.block_problem(train, num_blocks=k, seed=0,
-                                     minibatch_multiple=b)
+                                     minibatch_multiple=mb_mult)
     b = problem.ratings.u_rows.shape[-1]
-    icu, icv = blocking.minibatch_inv_counts(problem.ratings, b)
+    mb = b // minibatch_divisor
+    icu, icv = blocking.minibatch_inv_counts(problem.ratings, mb)
     U0, V0 = DSGD(DSGDConfig(num_factors=8, seed=0,
                              init_scale=0.2))._init_factors(problem)
-    lr, lam, iters = 0.05, 0.1, 3
+    lr, lam = 0.05, 0.1
     upd = RegularizedSGDUpdater(learning_rate=lr, lambda_=lam,
-                                schedule=constant_lr)
+                                schedule=schedule)
     args = (jnp.asarray(problem.ratings.u_rows, jnp.int32),
             jnp.asarray(problem.ratings.i_rows, jnp.int32),
             jnp.asarray(problem.ratings.values, jnp.float32),
             jnp.asarray(problem.ratings.weights, jnp.float32))
+    common = (jnp.asarray(U0), jnp.asarray(V0), *args,
+              jnp.asarray(problem.users.omega),
+              jnp.asarray(problem.items.omega),
+              jnp.asarray(icu), jnp.asarray(icv))
     Uref, Vref = sgd_ops.dsgd_train(
-        jnp.asarray(U0), jnp.asarray(V0), *args,
-        jnp.asarray(problem.users.omega), jnp.asarray(problem.items.omega),
-        jnp.asarray(icu), jnp.asarray(icv),
-        updater=upd, minibatch=b, num_blocks=k, iterations=iters,
-        collision="mean")
+        *common, updater=upd, minibatch=mb, num_blocks=k,
+        iterations=iters, collision="mean", t0=t0)
     # same positional order as dsgd_train (drop-in twin)
     Up, Vp = dsgd_train_pallas(
-        jnp.asarray(U0), jnp.asarray(V0), *args,
-        jnp.asarray(problem.users.omega), jnp.asarray(problem.items.omega),
-        jnp.asarray(icu), jnp.asarray(icv),
-        lr=lr, lam=lam, minibatch=b, num_blocks=k, iterations=iters,
-        gather="take", interpret=True)
+        *common, lr=lr, lam=lam, minibatch=mb, num_blocks=k,
+        iterations=iters, gather=gather, interpret=True,
+        schedule=None if schedule is constant_lr else schedule, t0=t0)
+    return (Uref, Vref), (Up, Vp)
+
+
+@pytest.mark.parametrize("gather", ["take", "loop"])
+@pytest.mark.parametrize("divisor", [1, 4])
+def test_full_training_matches_dsgd_train(divisor, gather):
+    """dsgd_train_pallas (all strata × blocks × sweeps under one scan)
+    must equal ops.sgd.dsgd_train — at minibatch == block size (divisor
+    1: flat-stratum minibatches coincide with per-block visits) AND at
+    minibatch < block size (divisor 4: the stratum-major layout deals
+    entries block-major, so the flat chunk order still matches the
+    per-block minibatch order) — on both gather paths (loop is the
+    production path; take awaits a Mosaic that can gather across vregs)."""
+    (Uref, Vref), (Up, Vp) = _full_training_pair(divisor, constant_lr,
+                                                 gather=gather)
+    np.testing.assert_allclose(np.asarray(Up), np.asarray(Uref),
+                               rtol=2e-5, atol=2e-6)
+    np.testing.assert_allclose(np.asarray(Vp), np.asarray(Vref),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_dsgd_kernel_flag_routes_through_pallas():
+    """DSGDConfig(kernel='pallas') must produce the same model as the XLA
+    kernel through the PUBLIC fit surface (segmented twice to exercise the
+    t0 continuation), and reject configurations the Pallas rule can't
+    honor."""
+    from large_scale_recommendation_tpu.core.generators import (
+        SyntheticMFGenerator,
+    )
+    from large_scale_recommendation_tpu.models.dsgd import DSGD, DSGDConfig
+
+    gen = SyntheticMFGenerator(num_users=64, num_items=48, rank=4,
+                               noise=0.1, seed=1)
+    train = gen.generate(3000)
+    kw = dict(num_factors=8, lambda_=0.05, iterations=4,
+              learning_rate=0.05, lr_schedule="inverse_sqrt", seed=0,
+              minibatch_size=128, init_scale=0.3)
+    mx = DSGD(DSGDConfig(**kw, kernel="xla")).fit(train, num_blocks=2)
+    mp = DSGD(DSGDConfig(**kw, kernel="pallas")).fit(train, num_blocks=2)
+    np.testing.assert_allclose(np.asarray(mp.U), np.asarray(mx.U),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(mp.V), np.asarray(mx.V),
+                               rtol=2e-4, atol=2e-5)
+
+    with pytest.raises(ValueError, match="pallas"):
+        DSGD(DSGDConfig(**{**kw, "collision_mode": "sum"},
+                        kernel="pallas")).fit(train, num_blocks=2)
+    with pytest.raises(ValueError, match="kernel"):
+        DSGD(DSGDConfig(**kw, kernel="tensorcore")).fit(train,
+                                                        num_blocks=2)
+
+
+def test_full_training_schedule_parity():
+    """A decaying η/√t schedule with a nonzero t0 (checkpoint-segment
+    continuation) must match the XLA path exactly — the schedule is
+    evaluated at trace level and enters the kernel as a runtime scalar."""
+    from large_scale_recommendation_tpu.core.updaters import inverse_sqrt_lr
+
+    (Uref, Vref), (Up, Vp) = _full_training_pair(
+        2, inverse_sqrt_lr, iters=3, t0=5)
     np.testing.assert_allclose(np.asarray(Up), np.asarray(Uref),
                                rtol=2e-5, atol=2e-6)
     np.testing.assert_allclose(np.asarray(Vp), np.asarray(Vref),
