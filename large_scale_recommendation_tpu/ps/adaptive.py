@@ -47,6 +47,12 @@ Deliberate departures (reference bugs per SURVEY §2.4 — not replicated):
   (like ``ps.mf``, whose chunked design the per-item reference variant
   anticipated) instead of one rating at a time; the worker-side math is
   identical, amortized over the chunk.
+- The ONLINE phase is chunked too by default (``online_mode="chunked"``):
+  parked ratings drain in groups of up to ``online_chunk_size`` per pull
+  window slot — one multi-item pull, one vectorized minibatch-mean
+  update, one push per group. Measured ~16× the per-rating protocol
+  (docs/PERF.md "Chunked adaptive online path"); the reference-shaped
+  ``"per_rating"`` mode is retained and quality-parity-pinned.
 
 The reference worker needs a background thread plus a ReentrantLock/
 Condition dance (:94-137) because its PS client blocks on the pull window.
@@ -109,17 +115,29 @@ class PSOnlineBatchConfig:
     worker_parallelism: int = 4
     ps_parallelism: int = 4
     pull_limit: int = 4  # batch in-flight chunk window
-    pull_limit_online: int = 8  # online in-flight rating window
+    pull_limit_online: int = 8  # online in-flight window (ratings or chunks)
     chunk_size: int = 256  # items per batch pull
     minibatch_size: int = 256
     seed: int = 0
     init_scale: float = 0.1
+    # Online path granularity. "chunked" (default): drain up to
+    # online_chunk_size parked ratings per pull, one vectorized
+    # minibatch-mean update per answer — the TPU-native choice (measured
+    # ≥10× the per-rating protocol, docs/PERF.md). "per_rating": the
+    # reference's one-rating-per-pull protocol
+    # (PSOfflineOnlineMF.scala:154-180), retained for parity tests.
+    online_mode: str = "chunked"
+    online_chunk_size: int = 512  # max parked ratings drained per pull
 
 
 class OnlineBatchWorkerLogic:
     """The worker state machine (PSOfflineOnlineMF.scala:52-242)."""
 
     def __init__(self, cfg: PSOnlineBatchConfig, worker_id: int):
+        if cfg.online_mode not in ("chunked", "per_rating"):
+            raise ValueError(
+                f"unknown online_mode {cfg.online_mode!r}; expected "
+                "'chunked' or 'per_rating'")
         self.cfg = cfg
         self.worker_id = worker_id
         self._init = PseudoRandomFactorInitializer(cfg.num_factors,
@@ -135,8 +153,16 @@ class OnlineBatchWorkerLogic:
         # ratings awaiting an online pull slot (≙ onlinePullQueue, :72)
         self.online_queue: collections.deque = collections.deque()
         # item → FIFO of (user, rating) awaiting that item's answer
-        # (≙ itemRatings, :56)
+        # (≙ itemRatings, :56; per_rating mode)
         self._item_fifo: dict[int, collections.deque] = {}
+        # chunked mode: request_id → (users, item-positions, values) of the
+        # drained group. The client assigns request ids in pull() call
+        # order, so counting our own pulls gives exact, order-robust
+        # answer matching (answers can complete out of order when pulls
+        # span different shard sets).
+        self._pull_seq = 0
+        self._group_data: dict[int, tuple] = {}
+        self._input_ended = False
         self._outstanding = 0  # ≙ pullCounter (:66)
         self.updater = SGDUpdater(learning_rate=cfg.learning_rate)
         self._batch_sched = schedule_from_name(cfg.lr_schedule)
@@ -166,15 +192,33 @@ class OnlineBatchWorkerLogic:
             self.history.append(rating)
             self._try_sending_pulls(ps)
 
+    def on_input_end(self, ps) -> None:
+        """Input exhausted: flush any sub-chunk remainder (the chunked
+        mode's accumulation gate would otherwise strand it — the topology
+        considers this worker drained once no pulls are in flight)."""
+        self._input_ended = True
+        if self.state == ONLINE:
+            self._try_sending_pulls(ps)
+
     def on_pull_answer(self, answer: PullAnswer, ps) -> None:
         self._outstanding -= 1
+        chunked_online = answer.request_id in self._group_data
         if self.state == ONLINE:
-            self._online_update(answer, ps)  # ≙ vectorUpdateAndPush (:167-180)
+            if chunked_online:
+                self._chunked_online_update(answer, ps)
+            else:
+                # ≙ vectorUpdateAndPush (:167-180)
+                self._online_update(answer, ps)
             self._try_sending_pulls(ps)
         elif self.state == BATCH_INIT:
-            # throw away the answer; batch must start ASAP (:191-203)
-            item = int(answer.ids[0])
-            self._item_fifo[item].popleft()
+            # throw away the answer; batch must start ASAP (:191-203) —
+            # the discarded ratings are already in the history (appended
+            # on arrival), so the retrain still covers them
+            if chunked_online:
+                del self._group_data[answer.request_id]
+            else:
+                item = int(answer.ids[0])
+                self._item_fifo[item].popleft()
             if self._outstanding == 0:
                 self._start_batch(ps)
         else:  # BATCH
@@ -195,11 +239,63 @@ class OnlineBatchWorkerLogic:
             self.users[user] = vec
         return vec
 
+    def _init_missing(self, missing: np.ndarray) -> None:
+        """Initialize absent user vectors with ONE batched call, padded to
+        a pow2 id-count bucket: the initializer is jitted per shape, and
+        arbitrary ``missing`` lengths would compile a fresh ~0.5 s XLA
+        program per group (measured — the exact recompile storm
+        utils.shapes killed on the ingest paths in round 4)."""
+        n = len(missing)
+        if not n:
+            return
+        from large_scale_recommendation_tpu.utils.shapes import pow2_pad
+
+        # chunk-size FLOOR (same trick as data.tables.ensure): fresh-id
+        # counts decay through every pow2 as the stream warms, and each
+        # size would compile its own ~0.25 s initializer — the floor pins
+        # the steady state to ONE shape (initializing a few hundred spare
+        # rows is microseconds; compiling is not)
+        padded = np.zeros(pow2_pad(n, self.cfg.online_chunk_size), np.int64)
+        padded[:n] = missing
+        fresh = np.asarray(self._init(padded), np.float32)[:n]
+        for j, u in enumerate(missing.tolist()):
+            self.users[int(u)] = fresh[j]
+
+    def _issue_pull(self, ps, ids: np.ndarray) -> int:
+        """Every pull goes through here so ``_pull_seq`` mirrors the
+        client's request-id assignment (FIFO over pull() calls)."""
+        rid = self._pull_seq
+        self._pull_seq += 1
+        ps.pull(ids)
+        return rid
+
     # -- Online (:140-190) ---------------------------------------------------
 
     def _try_sending_pulls(self, ps) -> None:
         """≙ trySendingPulls (:154-165): admit parked ratings while the
-        online window has room."""
+        online window has room. In chunked mode one window slot carries up
+        to ``online_chunk_size`` ratings as a single multi-item pull, and
+        a pull goes out only for a FULL chunk, an idle pipeline, or after
+        input end — otherwise arrivals keep accumulating while earlier
+        pulls are in flight (per-arrival pulls would degenerate every
+        group to ~1 rating and pay the round trip per rating again)."""
+        if self.cfg.online_mode == "chunked":
+            while (self._outstanding < self.cfg.pull_limit_online
+                   and self.online_queue
+                   and (self._outstanding == 0 or self._input_ended
+                        or len(self.online_queue)
+                        >= self.cfg.online_chunk_size)):
+                n = min(len(self.online_queue), self.cfg.online_chunk_size)
+                group = [self.online_queue.popleft() for _ in range(n)]
+                gu = np.asarray([g[0] for g in group], np.int64)
+                gi = np.asarray([g[1] for g in group], np.int64)
+                gv = np.asarray([g[2] for g in group], np.float32)
+                items = np.unique(gi)
+                ipos = np.searchsorted(items, gi)
+                self._outstanding += 1
+                rid = self._issue_pull(ps, items)
+                self._group_data[rid] = (gu, ipos, gv)
+            return
         while (self._outstanding < self.cfg.pull_limit_online
                and self.online_queue):
             user, item, value = self.online_queue.popleft()
@@ -207,7 +303,7 @@ class OnlineBatchWorkerLogic:
                 (user, value)
             )
             self._outstanding += 1
-            ps.pull(np.asarray([item], dtype=np.int64))
+            self._issue_pull(ps, np.asarray([item], dtype=np.int64))
 
     def _online_update(self, answer: PullAnswer, ps) -> None:
         """≙ vectorUpdateAndPush (:167-180): update the local user vector,
@@ -237,6 +333,40 @@ class OnlineBatchWorkerLogic:
         self.users[user] = np.asarray(new_user, np.float32)
         ps.push(np.asarray([item], np.int64), dv)
         ps.output((user, new_user))  # ≙ ps.output(user, ...) (:176)
+
+    def _chunked_online_update(self, answer: PullAnswer, ps) -> None:
+        """One drained group: the same plain-SGD rule as ``_online_update``
+        vectorized over the whole group — minibatch semantics (every
+        rating reads the pre-group factors; row collisions within the
+        group take the mean of their deltas, exactly the framework-wide
+        ``collision='mean'`` convention of ``ops.sgd``). One pull, one
+        push, one output batch per group instead of per rating."""
+        gu, ipos, gv = self._group_data.pop(answer.request_id)
+        V = np.asarray(answer.values, np.float32)
+
+        uniq_u, u_inv = np.unique(gu, return_inverse=True)
+        self._init_missing(np.asarray(
+            [u for u in uniq_u.tolist() if u not in self.users], np.int64))
+        Umat = np.stack([self.users[int(u)] for u in uniq_u.tolist()])
+
+        uvec = Umat[u_inv]
+        ivec = V[ipos]
+        lr = np.float32(self.cfg.learning_rate)
+        e = lr * (gv - np.einsum("nk,nk->n", uvec, ivec))
+        # collision='mean': bound the accumulated step at the base η
+        cnt_u = np.bincount(u_inv).astype(np.float32)
+        cnt_i = np.bincount(ipos, minlength=len(V)).astype(np.float32)
+        du = (e / cnt_u[u_inv])[:, None] * ivec
+        dv = (e / cnt_i[ipos])[:, None] * uvec
+        np.add.at(Umat, u_inv, du)
+        dV = np.zeros_like(V)
+        np.add.at(dV, ipos, dv)
+
+        for j, u in enumerate(uniq_u.tolist()):
+            vec = Umat[j]
+            self.users[int(u)] = vec
+            ps.output((int(u), vec))
+        ps.push(answer.ids, dV)
 
     # -- Trigger → BatchInit (:74-138) ---------------------------------------
 
@@ -286,13 +416,19 @@ class OnlineBatchWorkerLogic:
         # are missing from the map — initialize ALL of them with one
         # batched call, not one dispatch each.
         self._batch_uids = np.unique(hu)
-        missing = np.asarray([u for u in self._batch_uids.tolist()
-                              if u not in self.users], np.int64)
-        if len(missing):
-            fresh = np.asarray(self._init(missing), np.float32)
-            for j, u in enumerate(missing.tolist()):
-                self.users[u] = fresh[j]
-        U_np = np.stack([self.users[int(u)] for u in self._batch_uids])
+        self._init_missing(np.asarray(
+            [u for u in self._batch_uids.tolist()
+             if u not in self.users], np.int64))
+        # pow2-pad the replay table rows: the unique-user count varies per
+        # retrain, and every distinct row count would compile a fresh
+        # online_train (measured ~0.14 s each — half the replay wall).
+        # Pad rows are zeros no stream entry references.
+        from large_scale_recommendation_tpu.utils.shapes import pow2_pad
+
+        U_np = np.zeros((pow2_pad(len(self._batch_uids)),
+                         self.cfg.num_factors), np.float32)
+        U_np[:len(self._batch_uids)] = np.stack(
+            [self.users[int(u)] for u in self._batch_uids])
         self._batch_U = jnp.asarray(U_np)
         order = np.argsort(hi, kind="stable")
         hu, hi, hv = hu[order], hi[order], hv[order]
@@ -324,7 +460,7 @@ class OnlineBatchWorkerLogic:
             chunk = self._chunks[self._order[self._chunk_cursor]]
             self._chunk_cursor += 1
             self._outstanding += 1
-            ps.pull(chunk)
+            self._issue_pull(ps, chunk)
 
     def _batch_chunk_update(self, answer: PullAnswer, ps) -> None:
         """One replayed chunk: same math as the online rule, batched through
@@ -341,7 +477,15 @@ class OnlineBatchWorkerLogic:
         mb = cfg.minibatch_size
         ur, ir, rv, w = sgd_ops.pad_minibatches(u_rows, ips, vals, mb)
 
-        V_old = jnp.asarray(V_chunk, dtype=jnp.float32)
+        # pow2-pad the chunk's item rows too (np.array_split deals
+        # near-equal — not fixed — chunk sizes, each of which would
+        # otherwise compile its own online_train)
+        from large_scale_recommendation_tpu.utils.shapes import pow2_pad
+
+        m = len(V_chunk)
+        V_pad = np.zeros((pow2_pad(m), V_chunk.shape[1]), np.float32)
+        V_pad[:m] = V_chunk
+        V_old = jnp.asarray(V_pad)
         batch_updater = SGDUpdater(learning_rate=cfg.learning_rate,
                                    schedule=self._batch_sched)
         U_new, V_new = sgd_ops.online_train(
@@ -351,7 +495,8 @@ class OnlineBatchWorkerLogic:
             t0=self._epoch,
         )
         self._batch_U = U_new
-        ps.push(items, np.asarray(V_new - V_old))
+        ps.push(items, np.asarray(V_new)[:m] - np.asarray(V_chunk,
+                                                          np.float32))
 
         self._answered_in_epoch += 1
         if self._answered_in_epoch == len(self._chunks):
