@@ -636,6 +636,15 @@ def _extra_lines(extra: dict, rank: int, jax, h2d_mbps: float,
         half = lat[len(lat) // 2:]
         extra["online_ratings_per_s_steady"] = round(
             on_bs * len(half) / sum(half), 1)
+        # warm-only latency percentiles (VERDICT r4 weak #5): the overall
+        # p99 over this few batches is just the max — i.e. the cold jit
+        # tail. A streaming SLA quotes the warm numbers; if a tail
+        # survives HERE, it is a real stall worth a profile.
+        extra["online_batch_ms_p50_warm"] = round(
+            float(np.percentile(half, 50)) * 1e3, 1)
+        extra["online_batch_ms_p99_warm"] = round(
+            float(np.percentile(half, 99)) * 1e3, 1)
+        extra["online_batch_ms_max_warm"] = round(max(half) * 1e3, 1)
     up_bs = min(20_000, on_bs)
     up_batches = [ngen.generate(up_bs) for _ in range(2)]
     om.partial_fit(up_batches[0])  # warm the updates-emitting path

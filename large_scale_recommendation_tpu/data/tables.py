@@ -125,16 +125,37 @@ class GrowableFactorTable:
         # its own installer+initializer pair — measured as the dominant
         # cost of the online ingest loop even after warm-up. Small tables
         # (PS shards) keep a small floor so 1-id registrations stay cheap.
-        if base + m > self.capacity:
-            # grow for REAL need only — padding headroom must not double
-            # the table when the vocab lands near a capacity boundary
-            self._grow(base + m)
         # floor from the POST-grow capacity: a growth event must land on
         # the new capacity's steady-state install shape, not compile a
-        # one-off for the stale smaller floor
-        floor = min(1024, max(8, self.capacity >> 3))
-        pad = min(max(floor, _next_pow2(m)),
-                  self.capacity - base)  # boundary clamp (pad ≥ m)
+        # one-off for the stale smaller floor. The cap bounds wasted init
+        # work on huge tables; 64K was 1K until round 5 — a 512K-vocab
+        # online stream's fresh counts decay 67K→13K across its first
+        # ten micro-batches, and every bucket crossed above the old floor
+        # compiled a fresh ~0.5 s installer MID-STREAM (measured: the
+        # whole online p99 tail, docs/PERF.md "Online latency tail").
+        # Initializing 64K spare rows costs single-digit ms per batch.
+        floor = min(65536, max(8, self.capacity >> 3))
+        pad = max(floor, _next_pow2(m))
+        if base + pad > self.capacity:
+            if base + m == self.capacity:
+                # exact fill: one one-off install shape beats doubling a
+                # table that is now FULL at this capacity (a bounded
+                # vocab sized to a pow2 never grows for padding headroom
+                # alone; any LATER fresh id grows for real need)
+                pad = m
+            else:
+                # partial boundary install: GROW rather than clamp. The
+                # pre-round-5 `pad = capacity - base` clamp handed every
+                # install in the last floor-sized stretch of a capacity
+                # level a UNIQUE shape — one fresh ~0.5 s compile per
+                # install exactly where the floor was supposed to
+                # prevent them. Growing ≤1/8 early costs some memory
+                # headroom; the shape set stays closed. (At most two
+                # rounds: the floor is capped, so the pad converges.)
+                while base + pad > self.capacity:
+                    self._grow(base + pad)
+                    floor = min(65536, max(8, self.capacity >> 3))
+                    pad = max(floor, _next_pow2(m))
         self._ids_buf[base:base + m] = uniq[order]
         self._n = base + m
         if self._sorted_cache is not None:

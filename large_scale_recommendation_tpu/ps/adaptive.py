@@ -83,6 +83,7 @@ from large_scale_recommendation_tpu.ops import sgd as sgd_ops
 from large_scale_recommendation_tpu.ps.core import PullAnswer
 from large_scale_recommendation_tpu.ps.server import ShardedParameterStore
 from large_scale_recommendation_tpu.ps.transform import ps_transform
+from large_scale_recommendation_tpu.utils.shapes import pad_axis0_pow2
 
 
 class _BatchTrigger:
@@ -248,15 +249,13 @@ class OnlineBatchWorkerLogic:
         n = len(missing)
         if not n:
             return
-        from large_scale_recommendation_tpu.utils.shapes import pow2_pad
-
         # chunk-size FLOOR (same trick as data.tables.ensure): fresh-id
         # counts decay through every pow2 as the stream warms, and each
         # size would compile its own ~0.25 s initializer — the floor pins
         # the steady state to ONE shape (initializing a few hundred spare
         # rows is microseconds; compiling is not)
-        padded = np.zeros(pow2_pad(n, self.cfg.online_chunk_size), np.int64)
-        padded[:n] = missing
+        padded = pad_axis0_pow2(np.asarray(missing, np.int64),
+                                self.cfg.online_chunk_size)
         fresh = np.asarray(self._init(padded), np.float32)[:n]
         for j, u in enumerate(missing.tolist()):
             self.users[int(u)] = fresh[j]
@@ -423,13 +422,8 @@ class OnlineBatchWorkerLogic:
         # retrain, and every distinct row count would compile a fresh
         # online_train (measured ~0.14 s each — half the replay wall).
         # Pad rows are zeros no stream entry references.
-        from large_scale_recommendation_tpu.utils.shapes import pow2_pad
-
-        U_np = np.zeros((pow2_pad(len(self._batch_uids)),
-                         self.cfg.num_factors), np.float32)
-        U_np[:len(self._batch_uids)] = np.stack(
-            [self.users[int(u)] for u in self._batch_uids])
-        self._batch_U = jnp.asarray(U_np)
+        self._batch_U = jnp.asarray(pad_axis0_pow2(np.stack(
+            [self.users[int(u)] for u in self._batch_uids])))
         order = np.argsort(hi, kind="stable")
         hu, hi, hv = hu[order], hi[order], hv[order]
         hrows = np.searchsorted(self._batch_uids, hu)
@@ -480,12 +474,9 @@ class OnlineBatchWorkerLogic:
         # pow2-pad the chunk's item rows too (np.array_split deals
         # near-equal — not fixed — chunk sizes, each of which would
         # otherwise compile its own online_train)
-        from large_scale_recommendation_tpu.utils.shapes import pow2_pad
-
         m = len(V_chunk)
-        V_pad = np.zeros((pow2_pad(m), V_chunk.shape[1]), np.float32)
-        V_pad[:m] = V_chunk
-        V_old = jnp.asarray(V_pad)
+        V_old = jnp.asarray(pad_axis0_pow2(
+            np.asarray(V_chunk, np.float32)))
         batch_updater = SGDUpdater(learning_rate=cfg.learning_rate,
                                    schedule=self._batch_sched)
         U_new, V_new = sgd_ops.online_train(

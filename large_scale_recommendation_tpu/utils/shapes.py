@@ -23,3 +23,18 @@ def next_pow2(n: int) -> int:
 def pow2_pad(n: int, floor: int = 8) -> int:
     """Pad a dynamic length to its pow2 bucket, with a minimum bucket."""
     return max(floor, next_pow2(n))
+
+
+def pad_axis0_pow2(a, floor: int = 8):
+    """Zero-pad a numpy array's leading axis to its pow2 bucket — the
+    allocate/copy-prefix idiom every host→jit seam repeats, centralized
+    so the bucket policy stays in this module."""
+    import numpy as np
+
+    n = a.shape[0]
+    p = pow2_pad(n, floor)
+    if p == n:
+        return np.asarray(a)
+    out = np.zeros((p,) + a.shape[1:], a.dtype)
+    out[:n] = a
+    return out
