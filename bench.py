@@ -34,6 +34,9 @@ link is too slow to carry their inputs inside the attempt window.
 
 Env knobs: BENCH_NNZ, BENCH_RANK, BENCH_ITERS (max sweeps), BENCH_MB,
 BENCH_BLOCKS, BENCH_RMSE_TARGET, BENCH_TIMEOUT (per-attempt seconds),
+BENCH_DATA (=path to a real ratings file/dir — ML-25M ratings.csv or
+ML-100K u.data; parse → compact → block → train with the real-data
+RMSE-0.85 target; BENCH_NNZ becomes a seeded subsample cap),
 BENCH_SKIP_EXTRAS (=1 → DSGD line only), BENCH_MIN_MBPS (extras gate),
 BENCH_HOST_PIPELINE (=1 → round-2 host-side gen+blocking path),
 BENCH_SORT (intra-minibatch locality ordering, BOTH pipelines; default
@@ -100,7 +103,14 @@ def run_child() -> None:
     # value is a property of the real data). Noise 0.1, not the
     # synthetic_like default 0.3: at 0.3 the SNR is < 1 and NO solver beats
     # predict-zero — measured, not assumed (ALS plateaus at the data std).
-    rmse_target = float(os.environ.get("BENCH_RMSE_TARGET", 0.155))
+    # BENCH_DATA=/path/to/ratings.csv (or a directory holding one): train
+    # on REAL data through the same timed loop — parse → compact → block →
+    # train. The RMSE target flips to the BASELINE.md real-ML-25M contract
+    # (0.85) unless overridden; the vocab knobs are ignored (the file is
+    # the workload) and BENCH_NNZ becomes a seeded subsample cap.
+    bench_data = os.environ.get("BENCH_DATA")
+    rmse_target = float(os.environ.get(
+        "BENCH_RMSE_TARGET", "0.85" if bench_data else "0.155"))
     skip_extras = os.environ.get("BENCH_SKIP_EXTRAS") == "1"
     # Vocab overrides: reduced runs MUST shrink the user/item space with
     # nnz — below ~100 obs/row the planted structure is unrecoverable by
@@ -163,7 +173,7 @@ def run_child() -> None:
     if sort:
         extra["minibatch_sort"] = sort
 
-    if os.environ.get("BENCH_HOST_PIPELINE") == "1":
+    if os.environ.get("BENCH_HOST_PIPELINE") == "1" and not bench_data:
         # round-2 style: host generation + host/native blocking + bulk
         # device_put (~600 MB at the default config — needs a wide link)
         from large_scale_recommendation_tpu.data import blocking
@@ -221,9 +231,54 @@ def run_child() -> None:
 
         extra["pipeline"] = "device"
         t0 = time.perf_counter()
-        (du, di, dr), (dhu, dhi, dhv), (nu, ni) = synthetic_like_device(
-            "ml-25m", nnz=nnz, rank=16, noise=0.1, seed=0, skew_lam=2.0,
-            num_users=num_users, num_items=num_items)
+        if bench_data:
+            # real data: parse → compact on host (the file lives there),
+            # then ship the dense COO (~12 B/rating — ML-25M ≈ 300 MB;
+            # the h2d probe above says what the link can take) and block
+            # on device like every other run
+            from large_scale_recommendation_tpu.data.movielens import (
+                compact_ratings,
+                load_ratings_file,
+            )
+
+            cu_, ci_, cv_, nu, ni = compact_ratings(
+                load_ratings_file(bench_data))
+            cap_env = os.environ.get("BENCH_NNZ")
+            if cap_env and int(cap_env) < len(cu_):
+                # honor an explicit size cap (the parent's CPU fallback
+                # shrinks every workload) with a seeded subsample that
+                # keeps the real distribution
+                keep = np.random.default_rng(1).choice(
+                    len(cu_), int(cap_env), replace=False)
+                cu_, ci_, cv_ = cu_[keep], ci_[keep], cv_[keep]
+                extra["data_subsampled_to"] = int(cap_env)
+            nnz = len(cu_)
+            extra["nnz"] = nnz
+            extra["data_file"] = bench_data
+            extra["data_vocab"] = [nu, ni]
+            eff_users, eff_items = nu, ni
+            rng = np.random.default_rng(0)
+            test_mask = np.zeros(nnz, bool)
+            test_mask[rng.choice(nnz, max(1, int(nnz * 0.05)),
+                                 replace=False)] = True
+            # center by the TRAIN mean: raw star ratings sit at ~3.5 and
+            # the plain bilinear model (no bias terms) must otherwise
+            # spend its first sweeps learning the offset — with the bench
+            # step sizes it diverges instead. Predictions are implicitly
+            # mean + u·v, so holdout values are centered identically and
+            # the reported RMSE is unchanged by the shift.
+            mu = float(cv_[~test_mask].mean())
+            extra["data_mean"] = round(mu, 4)
+            du = jnp.asarray(cu_[~test_mask])
+            di = jnp.asarray(ci_[~test_mask])
+            dr = jnp.asarray(cv_[~test_mask] - mu)
+            dhu = jnp.asarray(cu_[test_mask])
+            dhi = jnp.asarray(ci_[test_mask])
+            dhv = jnp.asarray(cv_[test_mask] - mu)
+        else:
+            (du, di, dr), (dhu, dhi, dhv), (nu, ni) = synthetic_like_device(
+                "ml-25m", nnz=nnz, rank=16, noise=0.1, seed=0, skew_lam=2.0,
+                num_users=num_users, num_items=num_items)
         jax.block_until_ready(dr)
         extra["gen_wall_s"] = round(time.perf_counter() - t0, 1)
         train_nnz = int(du.shape[0])
@@ -358,9 +413,13 @@ def run_child() -> None:
     baseline = _numpy_sequential_baseline(*base_sample, rank)
     extra["numpy_seq_baseline_ratings_per_s"] = round(baseline, 1)
 
-    shape_lbl = ("ML-25M-shaped skewed" if num_users is None
-                 and num_items is None else
-                 f"{eff_users}x{eff_items} skewed (reduced vocab)")
+    if bench_data:
+        shape_lbl = (f"real data {os.path.basename(bench_data.rstrip('/'))}"
+                     f" {eff_users}x{eff_items}")
+    else:
+        shape_lbl = ("ML-25M-shaped skewed" if num_users is None
+                     and num_items is None else
+                     f"{eff_users}x{eff_items} skewed (reduced vocab)")
 
     def result_line() -> dict:
         return {
@@ -885,6 +944,15 @@ def _cpu_fallback(per_attempt: float, errors: list[str]) -> None:
     """CPU fallback on a reduced workload — a real (if slower) number beats
     no number; the error field records the per-attempt failures."""
     cpu_env = dict(CPU_FALLBACK_ENV)
+    if os.environ.get("BENCH_DATA"):
+        # real-data run: the synthetic-calibrated target (0.135) and the
+        # regime-preserving vocab shrink are meaningless against a real
+        # file — drop them so the child keeps the real-data 0.85 target,
+        # and keep only the nnz cap (a seeded subsample). The subsample
+        # thins obs/row, so the target may legitimately be unreachable in
+        # the fallback; the RMSE curve still carries the information.
+        for k in ("BENCH_RMSE_TARGET", "BENCH_USERS", "BENCH_ITEMS"):
+            cpu_env.pop(k, None)
     nnz_cpu = os.environ.get("BENCH_NNZ_CPU")
     if nnz_cpu:
         # scale the vocab WITH the nnz override so obs/row (and thus the

@@ -56,6 +56,55 @@ def load_ml25m(path: str) -> Ratings:
     return Ratings.from_arrays(users=users, items=items, ratings=vals)
 
 
+def load_ratings_file(path: str) -> Ratings:
+    """Load a ratings file, sniffing the format: MovieLens-25M
+    ``ratings.csv`` (comma-separated, ``userId,movieId,...`` header) or
+    MovieLens-100K ``u.data`` (tab-separated, no header). The BENCH_DATA
+    entry point — a real-data bench run should accept either format
+    without the caller naming it."""
+    if os.path.isdir(path):
+        for cand in ("ratings.csv", "u.data"):
+            p = os.path.join(path, cand)
+            if os.path.exists(p):
+                path = p
+                break
+        else:
+            raise FileNotFoundError(
+                f"no ratings.csv or u.data in directory {path}")
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    with open(path, "r") as fh:
+        first = fh.readline()
+    if "," in first:
+        if any(c.isalpha() for c in first):
+            return load_ml25m(path)
+        users, items, vals = parse_ratings_file(path, delimiter=",")
+        return Ratings.from_arrays(users=users, items=items, ratings=vals)
+    return load_ml100k(path)
+
+
+def compact_ratings(ratings: Ratings):
+    """Dense-id compaction of a real-id ratings set — the parse→compact
+    seam in front of the on-device pipeline (``fit_device`` /
+    ``device_block_problem`` require ids in [0, num_users) × [0,
+    num_items); real MovieLens ids are sparse).
+
+    Returns ``(u, i, vals, num_users, num_items)`` with int32 dense ids
+    (row j of the dense space = j-th id in the compaction order — opaque
+    to training, which only needs density).
+    """
+    from large_scale_recommendation_tpu.data.native import compact_ids
+
+    ru, ri, rv, rw = ratings.to_numpy()
+    real = rw > 0
+    ru, ri, rv = ru[real], ri[real], rv[real]
+    _, u_dense, _ = compact_ids(ru)
+    _, i_dense, _ = compact_ids(ri)
+    return (u_dense.astype(np.int32), i_dense.astype(np.int32),
+            rv.astype(np.float32),
+            int(u_dense.max()) + 1, int(i_dense.max()) + 1)
+
+
 _SHAPES = {
     # name: (num_users, num_items, nnz)
     "ml-100k": (943, 1682, 100_000),

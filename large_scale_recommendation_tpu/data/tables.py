@@ -25,7 +25,10 @@ import jax.numpy as jnp
 
 from large_scale_recommendation_tpu.core.initializers import FactorInitializer
 from large_scale_recommendation_tpu.core.types import FactorVector
-from large_scale_recommendation_tpu.utils.shapes import next_pow2 as _next_pow2
+from large_scale_recommendation_tpu.utils.shapes import (
+    next_pow2 as _next_pow2,
+    pow2_pad as _pow2_pad,
+)
 
 
 @jax.jit
@@ -135,7 +138,7 @@ class GrowableFactorTable:
         # whole online p99 tail, docs/PERF.md "Online latency tail").
         # Initializing 64K spare rows costs single-digit ms per batch.
         floor = min(65536, max(8, self.capacity >> 3))
-        pad = max(floor, _next_pow2(m))
+        pad = _pow2_pad(m, floor)
         if base + pad > self.capacity:
             if base + m == self.capacity:
                 # exact fill: one one-off install shape beats doubling a
@@ -155,7 +158,7 @@ class GrowableFactorTable:
                 while base + pad > self.capacity:
                     self._grow(base + pad)
                     floor = min(65536, max(8, self.capacity >> 3))
-                    pad = max(floor, _next_pow2(m))
+                    pad = _pow2_pad(m, floor)
         self._ids_buf[base:base + m] = uniq[order]
         self._n = base + m
         if self._sorted_cache is not None:

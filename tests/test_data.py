@@ -1,14 +1,22 @@
-"""Dataset loaders: format parsing, splits, synthetic stand-ins."""
+"""Dataset loaders: format parsing, splits, synthetic stand-ins, and the
+real-format parse → compact → block → train integration."""
+
+import os
 
 import numpy as np
 import pytest
 
 from large_scale_recommendation_tpu.data.movielens import (
+    compact_ratings,
     load_ml100k,
     load_ml25m,
+    load_ratings_file,
     synthetic_like,
     train_test_split,
 )
+
+SAMPLE = os.path.join(os.path.dirname(__file__), "data",
+                      "sample_ratings.csv")
 
 
 class TestLoaders:
@@ -54,3 +62,54 @@ class TestSynthetic:
         assert a.n + b.n == train.n
         a2, b2 = train_test_split(train, test_fraction=0.2, seed=1)
         np.testing.assert_array_equal(b.to_numpy()[0], b2.to_numpy()[0])
+
+
+class TestRealFormatIntegration:
+    """The checked-in real-format sample (ML-25M ratings.csv layout:
+    header, sparse non-contiguous external ids, half-star ratings)
+    driven through the FULL path a real-data bench run takes:
+    parse → compact → block → train (VERDICT r4 ask #5)."""
+
+    def test_sample_file_is_real_format(self):
+        with open(SAMPLE) as fh:
+            header = fh.readline().strip()
+        assert header == "userId,movieId,rating,timestamp"
+        r = load_ratings_file(SAMPLE)
+        assert r.n > 4000
+        ru, ri, rv, _ = r.to_numpy()
+        # external ids are sparse (NOT dense rows) — the compaction seam
+        # is doing real work
+        assert ru.max() > 10 * len(np.unique(ru))
+        assert ri.max() > 10 * len(np.unique(ri))
+        assert rv.min() >= 0.5 and rv.max() <= 5.0
+
+    def test_parse_compact_block_train(self):
+        """Same order as the bench BENCH_DATA route: compact the whole
+        file, split the dense arrays, train via fit_device, score the
+        holdout through the model surface."""
+        from large_scale_recommendation_tpu.models.dsgd import (
+            DSGD,
+            DSGDConfig,
+        )
+
+        ratings = load_ratings_file(SAMPLE)
+        u, i, v, nu, ni = compact_ratings(ratings)
+        assert u.max() + 1 == nu and i.max() + 1 == ni
+        rng = np.random.default_rng(0)
+        test_mask = np.zeros(len(u), bool)
+        test_mask[rng.choice(len(u), len(u) // 10, replace=False)] = True
+        cfg = DSGDConfig(num_factors=8, lambda_=0.05, iterations=15,
+                         learning_rate=0.1, lr_schedule="constant",
+                         seed=0, minibatch_size=256, init_scale=0.2)
+        model = DSGD(cfg).fit_device(
+            u[~test_mask], i[~test_mask], v[~test_mask], nu, ni,
+            num_blocks=2)
+        scores, ok = model.predict(u[test_mask], i[test_mask],
+                                   return_mask=True)
+        tv = v[test_mask]
+        res = tv[ok] - np.asarray(scores)[ok]
+        rmse = float(np.sqrt(np.mean(res * res)))
+        # planted low-rank structure in the sample (std 0.567): training
+        # must beat predict-the-mean by a clear margin
+        base = float(np.sqrt(np.mean((tv[ok] - tv[ok].mean()) ** 2)))
+        assert rmse < 0.8 * base, (rmse, base)
