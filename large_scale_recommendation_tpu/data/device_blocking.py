@@ -92,9 +92,31 @@ def _planted_batch(key, factor_key, num_users: int, num_items: int,
     else:
         u = jax.random.randint(k1, (n,), 0, num_users, jnp.int32)
         i = jax.random.randint(k2, (n,), 0, num_items, jnp.int32)
-    r = jnp.einsum("nk,nk->n", Ut[u], Vt[i])
+    r = _planted_scores(Ut, Vt, u, i)
     r = r + noise * jax.random.normal(k3, (n,), jnp.float32)
     return u, i, r
+
+
+# ML-25M-shaped nnz at rank 128 would materialize two [23.7M, 128] f32
+# gather temps (2 × 11.3 GB — measured on-chip OOM against v5e's 15.75 GB
+# HBM, r5). Chunking the row-wise dot through lax.map keeps the transient
+# footprint at 2 × [chunk, rank] regardless of nnz.
+_SCORE_CHUNK = 1 << 20
+
+
+def _planted_scores(Ut, Vt, u, i, chunk: int = _SCORE_CHUNK):
+    """Row-wise ⟨Ut[u], Vt[i]⟩ in bounded-memory chunks."""
+    n = u.shape[0]
+    if n <= chunk:
+        return jnp.einsum("nk,nk->n", Ut[u], Vt[i])
+    nc = -(-n // chunk)
+    pad = nc * chunk - n
+    up = jnp.concatenate([u, jnp.zeros((pad,), u.dtype)]) if pad else u
+    ip = jnp.concatenate([i, jnp.zeros((pad,), i.dtype)]) if pad else i
+    r = jax.lax.map(
+        lambda ui: jnp.einsum("nk,nk->n", Ut[ui[0]], Vt[ui[1]]),
+        (up.reshape(nc, chunk), ip.reshape(nc, chunk)))
+    return r.reshape(-1)[:n]
 
 
 from large_scale_recommendation_tpu.data.movielens import _SHAPES  # noqa: E402
@@ -227,7 +249,14 @@ def validate_dense_ids(u, i, num_users: int, num_items: int,
     the int64→int32 wrap this check exists to catch); when BOTH sides are
     already device arrays, their four min/max reductions fuse into one
     jitted call so exactly ONE device→host sync crosses a narrow tunneled
-    link (ADVICE r3). A host array is never shipped to device here."""
+    link (ADVICE r3). A host array is never shipped to device here.
+
+    The fused reduction specializes per input length — an accepted
+    per-fit cost (ADVICE r4): both callers are once-per-fit entry points
+    (``device_block_problem``, ``ALS.fit_device``), never per-batch, and
+    bucketing cannot help a device-resident input (the pad op itself
+    would specialize on the unpadded length). Per-batch id paths (online
+    ingest, PS pulls) pass host arrays, which reduce on host for free."""
     if isinstance(u, jax.Array) and isinstance(i, jax.Array):
         ranges = np.asarray(_id_ranges(u, i))
         lo_u, hi_u, lo_i, hi_i = (int(x) for x in ranges)
