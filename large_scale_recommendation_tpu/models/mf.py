@@ -105,6 +105,43 @@ class MFModel:
         )
         return float(np.sqrt(float(sse) / n))
 
+    def ranking_quality(self, eval_u, eval_i, k: int = 10,
+                        train: "Ratings | tuple | None" = None,
+                        chunk: int = 2048) -> dict:
+        """HR@K / NDCG@K of held-out positives by full-catalog ranking —
+        the implicit-feedback quality metric the reference never had (its
+        only quality surface is ``empiricalRisk``,
+        MatrixFactorization.scala:133-192; MLlib's implicit branch is
+        likewise RMSE-proxied). Pairs whose user or item was never seen
+        are dropped, matching the reference's inner-join contract on
+        every other surface here.
+
+        ``train`` (a ``Ratings`` or an ``(user_ids, item_ids)`` pair)
+        excludes already-interacted items from each user's ranked list.
+        """
+        from large_scale_recommendation_tpu.utils.metrics import (
+            ranking_metrics,
+        )
+
+        u_rows, u_mask = self.users.rows_for(np.asarray(eval_u))
+        i_rows, i_mask = self.items.rows_for(np.asarray(eval_i))
+        keep = (u_mask * i_mask) > 0
+        tu = ti = None
+        if train is not None:
+            if isinstance(train, tuple):
+                tru, tri = train
+            else:
+                tru, tri, _, _ = train.to_numpy()
+            tr_u, tr_um = self.users.rows_for(np.asarray(tru))
+            tr_i, tr_im = self.items.rows_for(np.asarray(tri))
+            tkeep = (tr_um * tr_im) > 0
+            tu, ti = tr_u[tkeep], tr_i[tkeep]
+        # block-padded tables hold random-init rows with no item behind
+        # them; mask them out of the catalog or they rank as phantoms
+        return ranking_metrics(self.U, self.V, u_rows[keep], i_rows[keep],
+                               k=k, train_u=tu, train_i=ti, chunk=chunk,
+                               item_mask=np.asarray(self.items.ids) >= 0)
+
     # -- export -------------------------------------------------------------
 
     def user_factors(self) -> Iterator[FactorVector]:

@@ -129,6 +129,12 @@ class _WorkerClient:
         del self._assembling[part.request_id]
         all_ids = np.concatenate([p.ids for p in parts])
         all_vals = np.concatenate([p.values for p in parts])
+        if len(all_ids) == 0 and len(ids) > 0:
+            # every shard answered empty for a non-empty request; without
+            # this guard the clamp below would index into an empty array
+            raise KeyError(
+                f"pull answer is missing ids {np.asarray(ids)[:5].tolist()}"
+                " — shard routing bug (all parts empty)")
         order = np.argsort(all_ids)
         pos = np.searchsorted(all_ids[order], ids)
         pos = np.minimum(pos, len(all_ids) - 1)

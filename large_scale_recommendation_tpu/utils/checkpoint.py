@@ -223,6 +223,15 @@ class ShardedCheckpointManager:
             if os.path.exists(tmp):
                 os.unlink(tmp)
 
+        if nproc > 1:
+            # manifest-after-all-shards (ADVICE r4): without this barrier
+            # process 0 can publish the manifest while peers are still
+            # writing, and a crash in that window leaves a checkpoint that
+            # claims completeness but silently fails _is_complete forever
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices(
+                f"sharded_ckpt_save_{step}")
         if pid == 0:
             manifest = {
                 "step": step,
@@ -280,6 +289,17 @@ class ShardedCheckpointManager:
         for name in os.listdir(self.directory):
             m = self._MANIFEST.match(name)
             if m and self._is_complete(int(m.group(1))):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def incomplete_steps(self) -> list[int]:
+        """Manifests whose shard set is missing files — evidence of a
+        crashed or still-in-flight save (the save barrier makes these
+        impossible in a healthy run, so surface them on restore)."""
+        out = []
+        for name in os.listdir(self.directory):
+            m = self._MANIFEST.match(name)
+            if m and not self._is_complete(int(m.group(1))):
                 out.append(int(m.group(1)))
         return sorted(out)
 
@@ -368,6 +388,20 @@ def restore_segment_state_sharded(manager: ShardedCheckpointManager,
     import jax.numpy as jnp
 
     latest = manager.latest_step()
+    broken = [s for s in manager.incomplete_steps()
+              if latest is None or s > latest]
+    if broken:
+        # a newer manifest with missing shards means a save crashed
+        # mid-write; resuming from the older complete step is correct but
+        # must not be silent (ADVICE r4)
+        import warnings
+
+        warnings.warn(
+            f"{manager.directory} holds incomplete checkpoint(s) at "
+            f"step(s) {broken} (manifest present, shard files missing — "
+            f"crashed save?); resuming from "
+            f"{'scratch' if latest is None else f'step {latest}'} instead",
+            RuntimeWarning, stacklevel=2)
     if latest is None:
         legacy = [n for n in os.listdir(manager.directory)
                   if CheckpointManager._FILE.match(n)]
