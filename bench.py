@@ -504,19 +504,24 @@ def _extra_lines(extra: dict, rank: int, jax, h2d_mbps: float,
         try:
             # rank capped at 128: the VMEM budget (slices + 4 [mb, rank]
             # tiles) is sized for the k=16 ML-25M shape at rank ≤ 128
+            # sweeps=16 amortizes the tunneled dispatch RTT (~30-70 ms per
+            # call — at sweeps=1 the probe measures the link, not the
+            # kernel: rank-64 XLA read 2.8M r/s unamortized vs 18.7M
+            # amortized, measured r5)
             pr = min(rank, 128)
-            pv = probe_variants(rank=pr, mb=2048, reps=5)
+            pv = probe_variants(rank=pr, mb=2048, reps=3, sweeps=16)
             for label, val in pv.items():
                 extra[f"kernel_{label}_ratings_per_s"] = val
-            pv_sorted = probe_variants(rank=pr, mb=2048, reps=5,
-                                       sort=True)
+            pv_sorted = probe_variants(rank=pr, mb=2048, reps=3,
+                                       sweeps=16, sort=True)
             for label, val in pv_sorted.items():
                 extra[f"kernel_{label}_sorted_ratings_per_s"] = val
             if pr != 64:
                 # apples-to-apples vs the historical 13.6M r/s figure
-                # (rank 64, round-2 TPU measurement)
+                # (rank 64, round-2 TPU measurement — itself
+                # dispatch-bound; the amortized number is the real one)
                 for label, val in probe_variants(rank=64, mb=2048,
-                                                 reps=5).items():
+                                                 reps=3, sweeps=16).items():
                     extra[f"kernel64_{label}_ratings_per_s"] = val
         except Exception as ex:  # never let the experiment kill the extras
             extra["kernel_probe_error"] = f"{type(ex).__name__}: {ex}"

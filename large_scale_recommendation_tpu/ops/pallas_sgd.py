@@ -407,14 +407,23 @@ def _probe_inputs(key, rank: int, mb: int, rpb_u: int, rpb_v: int,
 def probe_variants(rank: int = 128, mb: int = 2048, rpb_u: int = 10160,
                    rpb_v: int = 3696, nnz: int = 92160, reps: int = 5,
                    seed: int = 0, sort: bool = False,
-                   interpret: bool | None = None) -> dict:
+                   interpret: bool | None = None,
+                   sweeps: int = 1) -> dict:
     """Measure the XLA kernel vs both Pallas gather variants on ONE
     realistic (stratum, block) visit on the CURRENT device; returns
     ``{variant: ratings_per_s | "FAILED <err>"}``. Shared by
     scripts/pallas_probe.py and the bench extras (BENCH_PALLAS) so the
     experiment runs whenever a real chip is reachable — a Mosaic lowering
     failure is recorded as a measured negative, not hidden. All inputs
-    are generated on device: only the PRNG key crosses the link."""
+    are generated on device: only the PRNG key crosses the link.
+
+    ``sweeps`` repeats the block sweep INSIDE one jitted call
+    (fori_loop-carried factors). On the tunneled bench device a single
+    sweep is ~30-70 ms of dispatch RTT per call — comparable to the
+    kernel itself — so sweeps=1 measures the link, not the kernel
+    (measured r5: rank-64 XLA read 2.8M r/s at sweeps=1 vs the same
+    kernel sustaining 17.9M inside the full training loop). sweeps≥16
+    amortizes the dispatch to noise."""
     import time
 
     from large_scale_recommendation_tpu.core.updaters import (
@@ -433,16 +442,21 @@ def probe_variants(rank: int = 128, mb: int = 2048, rpb_u: int = 10160,
 
     upd = RegularizedSGDUpdater(learning_rate=lr, lambda_=lam,
                                 schedule=constant_lr)
+
+    def loop(body):
+        return jax.jit(lambda: jax.lax.fori_loop(
+            0, sweeps, lambda _, uv: body(*uv), (Ud, Vd)))
+
     variants = {
-        "xla": jax.jit(lambda: sgd_ops.sgd_block_sweep(
-            Ud, Vd, urd, ird, valsd, wd, oud, ovd, upd, 1, mb, "mean",
+        "xla": loop(lambda u, v: sgd_ops.sgd_block_sweep(
+            u, v, urd, ird, valsd, wd, oud, ovd, upd, 1, mb, "mean",
             icud, icvd)),
-        "pallas_take": jax.jit(lambda: pallas_block_sweep(
-            Ud, Vd, urd, ird, valsd, wd, icud, icvd, oud, ovd,
+        "pallas_take": loop(lambda u, v: pallas_block_sweep(
+            u, v, urd, ird, valsd, wd, icud, icvd, oud, ovd,
             lr=lr, lam=lam, minibatch=mb, gather="take",
             interpret=interpret)),
-        "pallas_loop": jax.jit(lambda: pallas_block_sweep(
-            Ud, Vd, urd, ird, valsd, wd, icud, icvd, oud, ovd,
+        "pallas_loop": loop(lambda u, v: pallas_block_sweep(
+            u, v, urd, ird, valsd, wd, icud, icvd, oud, ovd,
             lr=lr, lam=lam, minibatch=mb, gather="loop",
             interpret=interpret)),
     }
@@ -459,7 +473,7 @@ def probe_variants(rank: int = 128, mb: int = 2048, rpb_u: int = 10160,
             r = fn()
             jax.block_until_ready(r)
             walls.append(time.perf_counter() - t0)
-        out[label] = round(e / min(walls), 1)
+        out[label] = round(e * sweeps / min(walls), 1)
     return out
 
 
