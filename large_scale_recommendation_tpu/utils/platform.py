@@ -17,6 +17,33 @@ import os
 import sys
 
 
+def enable_compilation_cache(directory: str | None = None):
+    """Turn on JAX's persistent compilation cache (idempotent).
+
+    Measured on the tunneled bench device (r5): every compile goes
+    through a remote helper at ~5-30 s per kernel, and ~90% of the
+     153 s blocking wall was compiles — all of it cacheable. The cache
+    verifiably works across processes under the axon backend
+    (1.95 s → 0.41 s for a toy jit), so enabling it here converts every
+    repeat bench/fit invocation to warm-start. Thresholds are dropped to
+    cache everything: on this link even sub-second compiles beat a
+    helper round-trip.
+    """
+    import jax
+
+    directory = directory or os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))), ".jax_cache"))
+    try:
+        jax.config.update("jax_compilation_cache_dir", directory)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:  # older jax spelling — cache stays off, not fatal
+        pass
+    return directory
+
+
 def force_cpu(n_devices: int | None = None):
     """Restrict JAX to the CPU backend; returns the imported ``jax`` module.
 
