@@ -133,12 +133,17 @@ def run_child() -> None:
     # Persistent compile cache: ~90% of the r5 blocking wall (153 s) was
     # remote-helper compiles, all cacheable across processes (measured).
     # BENCH_COMPILE_CACHE=0 opts out for cold-compile measurements.
+    cache_state = "off"
     if os.environ.get("BENCH_COMPILE_CACHE", "1") == "1":
         from large_scale_recommendation_tpu.utils.platform import (
             enable_compilation_cache,
         )
 
-        enable_compilation_cache()
+        cdir = enable_compilation_cache()
+        try:
+            cache_state = "warm" if os.listdir(cdir) else "cold"
+        except OSError:
+            cache_state = "cold"
     import jax.numpy as jnp
 
     from large_scale_recommendation_tpu.models.dsgd import DSGD, DSGDConfig
@@ -147,7 +152,8 @@ def run_child() -> None:
     device = jax.devices()[0]
     extra: dict = {"device": str(device), "nnz": nnz, "rank": rank,
                    "blocks": blocks, "minibatch": mb,
-                   "rmse_target": rmse_target}
+                   "rmse_target": rmse_target,
+                   "compile_cache": cache_state}
 
     # ---- link probe: host→device bandwidth -------------------------------
     # The chip may sit behind a narrow tunnel; everything below budgets its
@@ -519,19 +525,33 @@ def _extra_lines(extra: dict, rank: int, jax, h2d_mbps: float,
             # kernel: rank-64 XLA read 2.8M r/s unamortized vs 18.7M
             # amortized, measured r5)
             pr = min(rank, 128)
-            pv = probe_variants(rank=pr, mb=2048, reps=3, sweeps=16)
+            # pallas_take is excluded from RUNTIME probes: its Mosaic
+            # rejection is already recorded chip-free (MOSAIC_AOT.json —
+            # multi-vreg gather / VMEM budget), and attempting the
+            # runtime compile CRASHES the remote compile helper
+            # (subprocess exit 1, measured r5), destabilizing the very
+            # tunnel the rest of the harvest depends on.
+            pvar = ("xla", "pallas_loop")
+            pv = probe_variants(rank=pr, mb=2048, reps=3, sweeps=16,
+                                variants=pvar)
             for label, val in pv.items():
                 extra[f"kernel_{label}_ratings_per_s"] = val
+            extra["kernel_pallas_take_ratings_per_s"] = (
+                "SKIPPED: Mosaic-rejected at every realistic shape "
+                "(docs/MOSAIC_AOT.json); runtime attempt crashes the "
+                "remote compile helper")
             pv_sorted = probe_variants(rank=pr, mb=2048, reps=3,
-                                       sweeps=16, sort=True)
+                                       sweeps=16, sort=True,
+                                       variants=pvar)
             for label, val in pv_sorted.items():
                 extra[f"kernel_{label}_sorted_ratings_per_s"] = val
             if pr != 64:
                 # apples-to-apples vs the historical 13.6M r/s figure
                 # (rank 64, round-2 TPU measurement — itself
                 # dispatch-bound; the amortized number is the real one)
-                for label, val in probe_variants(rank=64, mb=2048,
-                                                 reps=3, sweeps=16).items():
+                for label, val in probe_variants(
+                        rank=64, mb=2048, reps=3, sweeps=16,
+                        variants=pvar).items():
                     extra[f"kernel64_{label}_ratings_per_s"] = val
         except Exception as ex:  # never let the experiment kill the extras
             extra["kernel_probe_error"] = f"{type(ex).__name__}: {ex}"

@@ -408,7 +408,9 @@ def probe_variants(rank: int = 128, mb: int = 2048, rpb_u: int = 10160,
                    rpb_v: int = 3696, nnz: int = 92160, reps: int = 5,
                    seed: int = 0, sort: bool = False,
                    interpret: bool | None = None,
-                   sweeps: int = 1) -> dict:
+                   sweeps: int = 1,
+                   variants: tuple = ("xla", "pallas_take",
+                                      "pallas_loop")) -> dict:
     """Measure the XLA kernel vs both Pallas gather variants on ONE
     realistic (stratum, block) visit on the CURRENT device; returns
     ``{variant: ratings_per_s | "FAILED <err>"}``. Shared by
@@ -447,7 +449,7 @@ def probe_variants(rank: int = 128, mb: int = 2048, rpb_u: int = 10160,
         return jax.jit(lambda: jax.lax.fori_loop(
             0, sweeps, lambda _, uv: body(*uv), (Ud, Vd)))
 
-    variants = {
+    all_variants = {
         "xla": loop(lambda u, v: sgd_ops.sgd_block_sweep(
             u, v, urd, ird, valsd, wd, oud, ovd, upd, 1, mb, "mean",
             icud, icvd)),
@@ -461,7 +463,8 @@ def probe_variants(rank: int = 128, mb: int = 2048, rpb_u: int = 10160,
             interpret=interpret)),
     }
     out: dict = {}
-    for label, fn in variants.items():
+    for label in variants:
+        fn = all_variants[label]
         try:
             jax.block_until_ready(fn())
         except Exception as ex:
