@@ -299,14 +299,18 @@ def run_child() -> None:
         extra["gen_wall_s"] = round(time.perf_counter() - t0, 1)
         train_nnz = int(du.shape[0])
 
-        # BENCH_AUTOTUNE=1 (opt-in): A/B the kernel minibatch against one
-        # 2× candidate on a single timed sweep from the SAME blocked layout
-        # (pad to the larger candidate; both divide it). Off by default:
-        # the probe sees throughput only, and mb 65536 measured faster per
-        # sweep yet missed the full-scale RMSE target (docs/PERF.md) — the
-        # validated default 32768 stays unless explicitly overridden.
+        # BENCH_AUTOTUNE=1 (opt-in): A/B the kernel minibatch against its
+        # 2× AND half candidates on a single timed sweep each from the
+        # SAME blocked layout (pad to the largest candidate; all divide
+        # it). The half candidate earned its slot on chip (r5): the
+        # amortized probe measured mb 1024 at 17.9M r/s vs 12.3M at
+        # mb 2048 (rank 128). Off by default: the probe sees throughput
+        # only, and mb 65536 measured faster per sweep yet missed the
+        # full-scale RMSE target (docs/PERF.md) — the validated default
+        # 32768 stays unless explicitly overridden.
         autotune = os.environ.get("BENCH_AUTOTUNE", "0") == "1"
-        mb_cands = sorted({mb, mb * 2}) if autotune else [mb]
+        mb_cands = (sorted({max(mb // 2, 1), mb, mb * 2}) if autotune
+                    else [mb])
         t0 = time.perf_counter()
         p = device_block_problem(du, di, dr, nu, ni, num_blocks=blocks,
                                  minibatch_multiple=max(mb_cands), seed=0,

@@ -369,7 +369,8 @@ def _bucket_entries(key, u, i, r, w, row_of_u, row_of_i,
             jnp.asarray(w, jnp.float32)[order])
 
 
-def _inv_counts_2d(rows: jax.Array, w: jax.Array) -> jax.Array:
+def _inv_counts_2d(rows: jax.Array, w: jax.Array,
+                   presorted: bool = False) -> jax.Array:
     """Per-entry 1/(weight-sum of its row within its minibatch).
 
     Device form of ``blocking.minibatch_inv_counts`` / the native
@@ -377,12 +378,21 @@ def _inv_counts_2d(rows: jax.Array, w: jax.Array) -> jax.Array:
     run's weighted size with two cummax passes + a cumsum difference, and
     un-sort. Padding (weight 0) contributes nothing; its own scale is
     irrelevant (its delta is zero regardless).
+
+    ``presorted``: the caller guarantees each minibatch row-vector is
+    already ascending (the ``minibatch_sort`` side in ``_layout``) — the
+    inner argsort and the final un-sort drop out, saving one full sort +
+    three gathers over the whole layout (run detection is identical on
+    sorted input, so the result is bit-equal).
     """
     mb = rows.shape[-1]
     j = jnp.arange(mb, dtype=jnp.int32)[None, :]
-    sidx = jnp.argsort(rows, axis=-1)
-    sr = jnp.take_along_axis(rows, sidx, axis=-1)
-    sw = jnp.take_along_axis(w, sidx, axis=-1)
+    if presorted:
+        sr, sw = rows, w
+    else:
+        sidx = jnp.argsort(rows, axis=-1)
+        sr = jnp.take_along_axis(rows, sidx, axis=-1)
+        sw = jnp.take_along_axis(w, sidx, axis=-1)
     diff = sr[:, 1:] != sr[:, :-1]
     ones = jnp.ones_like(sr[:, :1], bool)
     new = jnp.concatenate([ones, diff], axis=-1)  # run starts
@@ -396,6 +406,8 @@ def _inv_counts_2d(rows: jax.Array, w: jax.Array) -> jax.Array:
          - jnp.take_along_axis(cumw, start, axis=-1)
          + jnp.take_along_axis(sw, start, axis=-1))
     inv_sorted = 1.0 / jnp.maximum(W, 1.0)
+    if presorted:
+        return inv_sorted
     inv_back = jnp.argsort(sidx, axis=-1)
     return jnp.take_along_axis(inv_sorted, inv_back, axis=-1)
 
@@ -441,8 +453,10 @@ def _layout(flat_s, urow_s, irow_s, vals_s, w_s, sizes,
 
         su, si, sv, sw = apply(su), apply(si), apply(sv), apply(sw)
 
-    icu = _inv_counts_2d(two_d(su), two_d(sw)).reshape(total)
-    icv = _inv_counts_2d(two_d(si), two_d(sw)).reshape(total)
+    icu = _inv_counts_2d(two_d(su), two_d(sw),
+                         presorted=sort_side == "user").reshape(total)
+    icv = _inv_counts_2d(two_d(si), two_d(sw),
+                         presorted=sort_side == "item").reshape(total)
     shape = (k, k, bmax)
     return (su.reshape(shape), si.reshape(shape), sv.reshape(shape),
             sw.reshape(shape), icu.reshape(shape), icv.reshape(shape))

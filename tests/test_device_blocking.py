@@ -429,3 +429,21 @@ class TestDeviceBlocking:
         ids = np.asarray(p.id_of_user_row)
         np.testing.assert_allclose(np.asarray(U), np.asarray(init(ids)),
                                    rtol=1e-6)
+
+
+class TestInvCountsPresorted:
+    def test_presorted_path_is_bit_equal(self):
+        """The minibatch_sort side's collision scales skip the inner
+        argsort (r5 layout optimization) — identical runs on sorted
+        input, so the fast path must be bit-equal to the general one."""
+        from large_scale_recommendation_tpu.data.device_blocking import (
+            _inv_counts_2d,
+        )
+
+        rng = np.random.default_rng(0)
+        rows = np.sort(rng.integers(0, 30, (16, 64)), axis=-1)
+        w = (rng.random((16, 64)) > 0.2).astype(np.float32)
+        a = _inv_counts_2d(jnp.asarray(rows), jnp.asarray(w))
+        b = _inv_counts_2d(jnp.asarray(rows), jnp.asarray(w),
+                           presorted=True)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
