@@ -781,10 +781,14 @@ def _extra_lines(extra: dict, rank: int, jax, h2d_mbps: float,
     pgen = SyntheticMFGenerator(num_users=10_000, num_items=2_500, rank=16,
                                 noise=0.1, seed=3, skew_lam=2.0)
     ps_ratings = pgen.generate(ps_nnz)
+    # chunk_size 2048 (was 512): each pull chunk costs a round-trip
+    # through the PS queues and, on the tunneled bench device, the
+    # ~30-70 ms link — the same RTT-amortization lever as the adaptive
+    # line (on-chip r5 the 512 config measured 21.6K r/s, RTT-shaped)
     ps_cfg = PSOfflineMFConfig(num_factors=rank, iterations=2,
                                learning_rate=0.05, lr_schedule="inverse_sqrt",
                                worker_parallelism=4, ps_parallelism=4,
-                               pull_limit=4, chunk_size=512,
+                               pull_limit=4, chunk_size=2048,
                                minibatch_size=4096)
     # warm-up on a small run: the PS line measures the threads+queues
     # protocol + jitted chunk kernels, not one-time XLA compiles (every
@@ -958,6 +962,17 @@ def main() -> None:
     # delays the CPU fallback. Retry only quick transient FAILURES.
     if _looks_transient(tail) and not hung:
         time.sleep(15)
+        # Re-probe before burning the retry window: a helper/tunnel that
+        # died MID-attempt (observed r5: remote_compile "Connection
+        # refused", then the retry hung its entire window) makes the
+        # device probe hang too — skip straight to the fallback.
+        ok2, probe2 = _device_preprobe(
+            float(os.environ.get("BENCH_PROBE_TIMEOUT", 180)))
+        if not ok2:
+            print(f"# retry pre-probe failed: {probe2}", file=sys.stderr)
+            errors.append(f"retry pre-probe: {probe2}")
+            _cpu_fallback(per_attempt, errors)
+            return
         result, tail, _ = _attempt({}, per_attempt)
         if result is not None:
             print(json.dumps(result))

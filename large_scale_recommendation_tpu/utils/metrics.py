@@ -127,9 +127,12 @@ def _rank_kernel():
             # ever materializes (O(C·I) compares ride the VPU; the scores
             # ride the MXU)
             scores = U_rows @ V.T + item_w[None, :]
-            # train-seen exclusion: scatter a large negative onto seen
-            # slots; padded entries carry weight 0 and scatter harmlessly
-            scores = scores.at[excl_rows, excl_cols].add(excl_w)
+            # train-seen exclusion: scatter-MIN a large negative onto
+            # seen slots — idempotent under duplicate (user, item) train
+            # pairs (an additive scatter would stack, ranking a
+            # twice-excluded target below once-excluded items — caught by
+            # the fuzz oracle); padded entries carry +inf and are no-ops
+            scores = scores.at[excl_rows, excl_cols].min(excl_w)
             st = jnp.take_along_axis(scores, pos_items[:, None], axis=1)
             rank = jnp.sum((scores > st).astype(jnp.int32), axis=1)
             hit = rank < k
@@ -211,7 +214,7 @@ def ranking_metrics(U, V, eval_u, eval_i, k: int = 10,
         ep = pow2_pad(max(e, 1))
         excl_rows = np.zeros(ep, np.int32)
         excl_cols = np.zeros(ep, np.int32)
-        excl_w = np.zeros(ep, np.float32)
+        excl_w = np.full(ep, np.inf, np.float32)  # pads: min() no-ops
         excl_rows[:e], excl_cols[:e], excl_w[:e] = rows, cols, -1e30
         hit, nd = kern(U[np.asarray(cu)], V, ci, excl_rows, excl_cols,
                        excl_w, item_w, k=k)

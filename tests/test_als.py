@@ -616,3 +616,49 @@ class TestRankingQuality:
                                item_mask=mask)
         assert good["hr"] == 1.0, good
         assert bad["hr"] < 1.0  # the phantoms really would have won
+
+    def test_ranking_metrics_matches_numpy_oracle_fuzz(self):
+        """Property fuzz: chunked/bucketed device evaluator == a direct
+        numpy oracle on random models, eval sets, exclusions and masks."""
+        from hypothesis import given, settings, strategies as st
+
+        from large_scale_recommendation_tpu.utils.metrics import (
+            ranking_metrics,
+        )
+
+        @settings(max_examples=20, deadline=None)
+        @given(st.integers(0, 2**31 - 1), st.integers(5, 40),
+               st.integers(4, 30), st.integers(1, 10),
+               st.booleans(), st.booleans())
+        def run(seed, nu, ni, k, with_train, with_mask):
+            rng = np.random.default_rng(seed)
+            U = rng.normal(size=(nu, 6)).astype(np.float32)
+            V = rng.normal(size=(ni, 6)).astype(np.float32)
+            ne = int(rng.integers(1, 50))
+            eu = rng.integers(0, nu, ne)
+            ei = rng.integers(0, ni, ne).astype(np.int32)
+            tu = ti = None
+            if with_train:
+                nt = int(rng.integers(1, 80))
+                tu = rng.integers(0, nu, nt)
+                ti = rng.integers(0, ni, nt).astype(np.int32)
+            mask = (rng.random(ni) > 0.3) if with_mask else None
+            got = ranking_metrics(U, V, eu, ei, k=k, train_u=tu,
+                                  train_i=ti, chunk=8, item_mask=mask)
+
+            # oracle
+            S = U @ V.T
+            if mask is not None:
+                S[:, ~mask] = -1e30
+            if with_train:
+                S[tu, ti] = -1e30
+            hits = ndcg = 0.0
+            for u, i in zip(eu, ei):
+                r = int((S[u] > S[u, i]).sum())
+                if r < k:
+                    hits += 1.0
+                    ndcg += 1.0 / np.log2(r + 2.0)
+            assert abs(got["hr"] - hits / ne) < 1e-6, (seed, got)
+            assert abs(got["ndcg"] - ndcg / ne) < 1e-5, (seed, got)
+
+        run()
