@@ -966,9 +966,11 @@ def main() -> None:
         # died MID-attempt (observed r5: remote_compile "Connection
         # refused", then the retry hung its entire window) makes the
         # device probe hang too — skip straight to the fallback.
-        ok2, probe2 = _device_preprobe(
-            float(os.environ.get("BENCH_PROBE_TIMEOUT", 180)))
-        if not ok2:
+        ok2, probe2 = _device_preprobe(probe_timeout)
+        if not ok2 and "hung" in probe2:
+            # same policy as the first probe: only a HANG forfeits — a
+            # fast non-zero exit may be the same transient the retry
+            # exists to absorb
             print(f"# retry pre-probe failed: {probe2}", file=sys.stderr)
             errors.append(f"retry pre-probe: {probe2}")
             _cpu_fallback(per_attempt, errors)

@@ -46,6 +46,11 @@ class ALSConfig:
     # iALS (≙ MLlib ALS.trainImplicit; the BASELINE Criteo-implicit config):
     # treat ratings as interaction strengths with confidence 1 + α·r
     implicit_alpha: float | None = None
+    # "bf16" halves the bytes of the hot-path fixed-side row gather (the
+    # measured ALS bottleneck, docs/PERF.md) and feeds the gram einsums
+    # native-MXU bf16 inputs; accumulation + solve stay f32 (ops.als).
+    # None = full f32 (the default; exact MLlib-style numerics).
+    gram_dtype: str | None = None
 
 
 class ALS:
@@ -85,6 +90,7 @@ class ALS:
             iterations=cfg.iterations,
             reg_mode=cfg.reg_mode,
             implicit_alpha=cfg.implicit_alpha,
+            gram_dtype=self._gram_dtype(),
         )
         self.model = MFModel(U=U, V=V, users=users, items=items)
         return self.model
@@ -147,7 +153,8 @@ class ALS:
 
         U, V = als_ops.als_rounds(
             V, prep_u, prep_v, num_users, num_items, cfg.lambda_,
-            cfg.iterations, implicit=cfg.implicit_alpha is not None)
+            cfg.iterations, implicit=cfg.implicit_alpha is not None,
+            gram_dtype=self._gram_dtype())
 
         # dense-vocab IdIndex pair with host-path semantics (ids unseen in
         # training stay unknown → predict 0, dropped from risk)
@@ -164,6 +171,14 @@ class ALS:
         self.model = MFModel(U=U, V=V, users=index(omega_u, num_users),
                              items=index(omega_v, num_items))
         return self.model
+
+    def _gram_dtype(self):
+        d = self.config.gram_dtype
+        if d is None:
+            return None
+        if d in ("bf16", "bfloat16"):
+            return jnp.bfloat16
+        raise ValueError(f"gram_dtype must be None|'bf16', got {d!r}")
 
     def _init_factors(self, users: blocking.IdIndex, items: blocking.IdIndex):
         cfg = self.config

@@ -89,7 +89,13 @@ def build_solve_plan(
     kernel compiles a bounded number of shape variants.
     """
     out_rows = np.asarray(out_rows, dtype=np.int64)
-    order = np.argsort(out_rows, kind="stable")
+    # lexsort: row-contiguous segments with ASCENDING partner index inside
+    # each row. Within-row order is free (the gram is a sum over the
+    # segment), and sorted partners turn the hot-path gather
+    # ``factors[oidx]`` into clustered row reads — the same locality lever
+    # minibatch_sort measured ~3x on the latency-bound DSGD gathers
+    # (docs/PERF.md "Kernel facts").
+    order = np.lexsort((other_rows, out_rows))
     o_sorted = other_rows[order].astype(np.int32)
     v_sorted = values[order].astype(np.float32)
     counts = np.bincount(out_rows, minlength=num_out_rows)
@@ -150,9 +156,15 @@ def _gram_solve_chunk(factors, oi, va, wi, sc, lambda_, G=None):
     per-row grams (two MXU einsums), Cholesky-solve. Used by BOTH the
     single-chip (_solve_bucket) and mesh (solve_side_local) paths — the
     mesh==single-device parity tests depend on them staying one body.
-    ``G`` adds a shared [k, k] term to every row's gram (implicit VᵀV)."""
+    ``G`` adds a shared [k, k] term to every row's gram (implicit VᵀV).
+
+    The gather + einsums run in ``factors.dtype``: with a bf16 table
+    (``solve_side(dtype=...)``) the latency-bound row gather moves half
+    the bytes and the contractions are native-MXU bf16×bf16, while both
+    einsums still ACCUMULATE in f32 (``preferred_element_type``) and the
+    normal-equation solve itself stays f32 end to end."""
     g = factors[oi]
-    gw = g * wi[..., None]
+    gw = g * wi[..., None].astype(g.dtype)
     A = jnp.einsum("rpk,rpl->rkl", gw, g,
                    preferred_element_type=jnp.float32)
     if G is not None:
@@ -160,7 +172,8 @@ def _gram_solve_chunk(factors, oi, va, wi, sc, lambda_, G=None):
     # b uses the RAW gathered rows: ``va`` is the per-entry b-weight
     # (explicit: the already-masked rating, so Σ w·r·v as before;
     # implicit: the masked confidence c = 1+α·r)
-    b = jnp.einsum("rpk,rp->rk", g, va)
+    b = jnp.einsum("rpk,rp->rk", g, va.astype(g.dtype),
+                   preferred_element_type=jnp.float32)
     return solve_normal_eq(A, b, lambda_, sc)
 
 
@@ -233,7 +246,7 @@ def prepare_side(plan: SolvePlan, omega: np.ndarray | None, k: int,
 
 
 @partial(jax.jit, static_argnames=("num_out_rows", "n_pow2"))
-def _device_plan_keys(out_rows, num_out_rows: int, n_pow2: int):
+def _device_plan_keys(out_rows, other_rows, num_out_rows: int, n_pow2: int):
     """Per-row counts, pad classes, and the two sort orders the device plan
     build needs. Returns device arrays + the tiny per-class row-count vector
     that gets read back to fix static shapes."""
@@ -245,7 +258,12 @@ def _device_plan_keys(out_rows, num_out_rows: int, n_pow2: int):
     pclass = jnp.where(counts == 0, n_pow2, pclass)
     row_order = jnp.argsort(pclass, stable=True)  # rows grouped by class
     rows_per_class = jnp.zeros(n_pow2 + 1, jnp.int32).at[pclass].add(1)
-    entry_order = jnp.argsort(out_rows, stable=True)  # row-contiguous runs
+    # lexsort by (out_row, other_row) as two stable passes (no 64-bit
+    # composite keys — int64 is emulated on TPU): row-contiguous runs with
+    # ascending partner indices inside each run, the same gather-locality
+    # lever as the host plan's np.lexsort (see build_solve_plan).
+    o1 = jnp.argsort(other_rows, stable=True)
+    entry_order = o1[jnp.argsort(out_rows[o1], stable=True)]
     starts = jnp.cumsum(counts) - counts
     return counts, row_order, rows_per_class, entry_order, starts
 
@@ -295,7 +313,7 @@ def device_prepare_side(
     k = rank_for_chunking or 256
     n_pow2 = 31
     counts, row_order, rows_per_class, entry_order, starts = \
-        _device_plan_keys(out_rows, num_out_rows, n_pow2)
+        _device_plan_keys(out_rows, other_rows, num_out_rows, n_pow2)
     o_sorted = other_rows[entry_order]
     v_sorted = values[entry_order]
 
@@ -351,12 +369,21 @@ def solve_side(
     num_rows: int,
     lambda_: float,
     G: jax.Array | None = None,
+    dtype=None,
 ) -> jax.Array:
     """One ALS half-step over the prepared buckets. ≙ one orientation of
     ``ALS.train``'s normal-equation sweep (OnlineSpark.scala:125-131);
     with ``G`` (the fixed side's VᵀV) this is the iALS half-step
-    (≙ ``ALS.trainImplicit``)."""
+    (≙ ``ALS.trainImplicit``).
+
+    ``dtype`` (e.g. ``jnp.bfloat16``) casts the FIXED side's table once
+    per half-step before the bucketed gather/gram kernels — the gather is
+    the measured bottleneck (latency-bound row reads, docs/PERF.md), so
+    halving row bytes attacks it directly. Accumulation and the solve stay
+    f32 (see ``_gram_solve_chunk``); the solved side is always f32."""
     k = factors_other.shape[-1]
+    if dtype is not None:
+        factors_other = factors_other.astype(dtype)
     out = jnp.zeros((num_rows + 1, k), jnp.float32)
     lam = jnp.float32(lambda_)
     for chunked in prepared:
@@ -479,17 +506,18 @@ def _full_gram(F):
 
 
 def als_rounds(V, prep_u, prep_v, num_u: int, num_v: int, lambda_: float,
-               iterations: int, implicit: bool = False):
+               iterations: int, implicit: bool = False, gram_dtype=None):
     """``iterations`` × (user half-step; item half-step) over PREPARED
     buckets — the ONE training-loop body shared by ``als_train_planned``
     (host plans) and the model-level ``ALS.fit_device`` (device plans).
     With ``implicit`` each half-step adds the fixed side's whole VᵀV gram
-    (one [k, k] matmul)."""
+    (one [k, k] matmul). ``gram_dtype`` routes the gather/gram kernels
+    through a reduced-precision fixed-side table (see ``solve_side``)."""
     for _ in range(iterations):
         Gv = _full_gram(V) if implicit else None
-        U = solve_side(V, prep_u, num_u, lambda_, Gv)
+        U = solve_side(V, prep_u, num_u, lambda_, Gv, dtype=gram_dtype)
         Gu = _full_gram(U) if implicit else None
-        V = solve_side(U, prep_v, num_v, lambda_, Gu)
+        V = solve_side(U, prep_v, num_v, lambda_, Gu, dtype=gram_dtype)
     return U, V
 
 
@@ -505,6 +533,7 @@ def als_train_planned(
     iterations: int,
     reg_mode: str = "direct",
     implicit_alpha: float | None = None,
+    gram_dtype=None,
 ) -> tuple[jax.Array, jax.Array]:
     """Full ALS on the bucketed plans: ``iterations`` × (user half-step;
     item half-step). The Python round loop dispatches a few large jitted
@@ -522,7 +551,8 @@ def als_train_planned(
     prep_v = prepare_side(item_plan, omv, k, implicit_alpha)
     return als_rounds(V, prep_u, prep_v, user_plan.num_rows,
                       item_plan.num_rows, lambda_, iterations,
-                      implicit=implicit_alpha is not None)
+                      implicit=implicit_alpha is not None,
+                      gram_dtype=gram_dtype)
 
 
 def gram_stats(
