@@ -62,6 +62,7 @@ class ALS:
 
     def fit(self, ratings: Ratings) -> MFModel:
         cfg = self.config
+        self._gram_dtype()  # reject a bad gram_dtype BEFORE the plan build
         if ratings.n == 0:
             raise ValueError("cannot fit on an empty ratings set")
 
@@ -119,6 +120,10 @@ class ALS:
         )
 
         cfg = self.config
+        # config/input validation first: the device plan build is the
+        # 126-328 s wall on a tunneled chip (docs/PERF.md) — a typo'd
+        # gram_dtype must not cost minutes before raising
+        self._gram_dtype()
         if np.shape(u)[0] == 0:
             raise ValueError("cannot fit on an empty ratings set")
         validate_dense_ids(u, i, num_users, num_items, "ALS.fit_device")
