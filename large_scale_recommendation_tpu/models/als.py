@@ -62,7 +62,7 @@ class ALS:
 
     def fit(self, ratings: Ratings) -> MFModel:
         cfg = self.config
-        self._gram_dtype()  # reject a bad gram_dtype BEFORE the plan build
+        gram_dtype = self._gram_dtype()  # validate BEFORE the plan build
         if ratings.n == 0:
             raise ValueError("cannot fit on an empty ratings set")
 
@@ -91,7 +91,7 @@ class ALS:
             iterations=cfg.iterations,
             reg_mode=cfg.reg_mode,
             implicit_alpha=cfg.implicit_alpha,
-            gram_dtype=self._gram_dtype(),
+            gram_dtype=gram_dtype,
         )
         self.model = MFModel(U=U, V=V, users=users, items=items)
         return self.model
@@ -123,7 +123,7 @@ class ALS:
         # config/input validation first: the device plan build is the
         # 126-328 s wall on a tunneled chip (docs/PERF.md) — a typo'd
         # gram_dtype must not cost minutes before raising
-        self._gram_dtype()
+        gram_dtype = self._gram_dtype()
         if np.shape(u)[0] == 0:
             raise ValueError("cannot fit on an empty ratings set")
         validate_dense_ids(u, i, num_users, num_items, "ALS.fit_device")
@@ -159,7 +159,7 @@ class ALS:
         U, V = als_ops.als_rounds(
             V, prep_u, prep_v, num_users, num_items, cfg.lambda_,
             cfg.iterations, implicit=cfg.implicit_alpha is not None,
-            gram_dtype=self._gram_dtype())
+            gram_dtype=gram_dtype)
 
         # dense-vocab IdIndex pair with host-path semantics (ids unseen in
         # training stay unknown → predict 0, dropped from risk)

@@ -476,11 +476,17 @@ def solve_side_local(
     omega_local: jax.Array | None,
     varying_zeros_fn,
     G: jax.Array | None = None,  # [k, k] shared gram (implicit VᵀV)
+    dtype=None,
 ) -> jax.Array:
     """One shard's half-step inside shard_map: bucketed gram + solve + set
     on the local [rows_per_shard(+1), k] table. ``varying_zeros_fn(shape)``
-    supplies VMA-marked zero accumulators (parallel/als_mesh.py)."""
+    supplies VMA-marked zero accumulators (parallel/als_mesh.py).
+    ``dtype`` = the single-chip path's gram_dtype lever (see ``solve_side``):
+    the gathered fixed side is cast once per half-step, accumulation and
+    solve stay f32."""
     k = factors_full.shape[-1]
+    if dtype is not None:
+        factors_full = factors_full.astype(dtype)
     out = varying_zeros_fn((rows_per_shard + 1, k))
 
     if omega_local is None:

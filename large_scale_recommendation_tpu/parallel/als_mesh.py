@@ -49,6 +49,7 @@ def build_mesh_als_step(
     n_user_buckets: int,
     n_item_buckets: int,
     implicit: bool = False,
+    gram_dtype=None,
 ):
     """Jitted distributed ALS round loop over bucketed solve plans.
 
@@ -96,11 +97,13 @@ def build_mesh_als_step(
             V_full = jax.lax.all_gather(V_l, BLOCK_AXIS, tiled=True)
             Gv = full_gram(V_full) if implicit else None
             U_l = als_ops.solve_side_local(V_full, ub, nu_l, lam, scale_u,
-                                           varying_zeros, Gv)
+                                           varying_zeros, Gv,
+                                           dtype=gram_dtype)
             U_full = jax.lax.all_gather(U_l, BLOCK_AXIS, tiled=True)
             Gu = full_gram(U_full) if implicit else None
             V_l = als_ops.solve_side_local(U_full, ib, ni_l, lam, scale_v,
-                                           varying_zeros, Gu)
+                                           varying_zeros, Gu,
+                                           dtype=gram_dtype)
             return (U_l, V_l), None
 
         (U_l, V_l), _ = jax.lax.scan(round_, (U_l, V_l), None,
@@ -124,7 +127,11 @@ class MeshALS:
         return self.mesh.shape[BLOCK_AXIS]
 
     def fit(self, ratings: Ratings) -> MFModel:
+        from large_scale_recommendation_tpu.models.als import ALS
+
         cfg = self.config
+        solver = ALS(cfg)
+        gram_dtype = solver._gram_dtype()  # validate BEFORE the plan build
         if ratings.n == 0:
             raise ValueError("cannot fit on an empty ratings set")
         k = self.num_blocks
@@ -187,9 +194,7 @@ class MeshALS:
             min_pad=cfg.min_pad, implicit_alpha=cfg.implicit_alpha,
         )
 
-        from large_scale_recommendation_tpu.models.als import ALS
-
-        U, V = ALS(cfg)._init_factors(users, items)
+        U, V = solver._init_factors(users, items)
 
         # placement: single-process uses a device-side reshard (no host
         # round-trip — np.asarray on the device-resident U/V would pull
@@ -215,6 +220,7 @@ class MeshALS:
             self.mesh, cfg.lambda_, cfg.reg_mode, cfg.iterations,
             len(user_plan), len(item_plan),
             implicit=cfg.implicit_alpha is not None,
+            gram_dtype=gram_dtype,
         )
         U, V = step_fn(
             put(U), put(V), put(users.omega), put(items.omega),

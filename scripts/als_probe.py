@@ -53,9 +53,16 @@ def main() -> None:
     out: dict = {"device": str(dev.device_kind) + str(dev.id)}
 
     als_nnz = int(os.environ.get("BENCH_ALS_NNZ", 2_000_000))
+    # vocab overrides, same contract as bench.py: reduced-nnz runs MUST
+    # shrink the vocab too or the plans solve mostly-empty normal
+    # equations (the pathological regime bench.py's own comment flags)
+    num_users = (int(os.environ["BENCH_USERS"])
+                 if os.environ.get("BENCH_USERS") else None)
+    num_items = (int(os.environ["BENCH_ITEMS"])
+                 if os.environ.get("BENCH_ITEMS") else None)
     (au, ai, ar), _, (anu, ani) = synthetic_like_device(
         "ml-25m", nnz=int(als_nnz / 0.95) + 1, rank=16, noise=0.1, seed=1,
-        skew_lam=2.0)
+        skew_lam=2.0, num_users=num_users, num_items=num_items)
     t0 = time.perf_counter()
     prep_u = als_ops.device_prepare_side(au, ai, ar, anu,
                                          rank_for_chunking=256)
@@ -82,15 +89,18 @@ def main() -> None:
             wall = time.perf_counter() - t0
             out[f"als_rank{rank}_{label}_rows_per_s"] = round(
                 (anu + ani) * iters / wall, 1)
-        # quality guard: the two modes must land on the same model (bf16
-        # rounding only) — one round from the same init, holdout-free
-        # relative factor distance
-        U32, V32 = als_ops.als_rounds(V0, prep_u, prep_v, anu, ani, 0.01, 1)
-        U16, V16 = als_ops.als_rounds(V0, prep_u, prep_v, anu, ani, 0.01, 1,
-                                      gram_dtype=jnp.bfloat16)
-        num = float(jnp.abs(U16 - U32).max())
-        den = float(jnp.abs(U32).max())
-        out[f"als_rank{rank}_bf16_rel_err"] = round(num / max(den, 1e-9), 5)
+        # quality guard at the FIRST probed rank only: one extra round per
+        # mode suffices (tests/test_als.py pins f32/bf16 parity across the
+        # surface) and chip-window seconds are the binding resource
+        if rank == ranks[0]:
+            U32, _ = als_ops.als_rounds(V0, prep_u, prep_v, anu, ani,
+                                        0.01, 1)
+            U16, _ = als_ops.als_rounds(V0, prep_u, prep_v, anu, ani,
+                                        0.01, 1, gram_dtype=jnp.bfloat16)
+            num = float(jnp.abs(U16 - U32).max())
+            den = float(jnp.abs(U32).max())
+            out[f"als_rank{rank}_bf16_rel_err"] = round(
+                num / max(den, 1e-9), 5)
 
     print(json.dumps(out))
 

@@ -65,7 +65,14 @@ def main() -> None:
     mb = int(os.environ.get("NS_MB", 2048))
     target = float(os.environ.get("BENCH_RMSE_TARGET", 0.155))
     max_sweeps = int(os.environ.get("BENCH_ITERS", 12))
-    variants = os.environ.get("NS_VARIANTS", "pallas,xla").split(",")
+    variants = [v.strip() for v in
+                os.environ.get("NS_VARIANTS", "pallas,xla").split(",")]
+    bad = [v for v in variants if v not in ("pallas", "xla")]
+    if bad:
+        # fail LOUDLY before burning a tunnel window: a typo'd variant
+        # would otherwise run the XLA arm under the wrong label and emit
+        # a plausible-looking but wrong A/B
+        raise SystemExit(f"NS_VARIANTS must be pallas|xla, got {bad}")
     out: dict = {"device": str(dev.device_kind) + str(dev.id), "rank": rank,
                  "blocks": k, "minibatch": mb, "nnz": nnz,
                  "rmse_target": target}

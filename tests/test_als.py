@@ -768,3 +768,36 @@ class TestBF16Gram:
             ALS(ALSConfig(gram_dtype="fp8")).fit(
                 SyntheticMFGenerator(num_users=10, num_items=10, rank=2,
                                      seed=0).generate(100))
+
+    def test_mesh_bf16_matches_single_device(self):
+        """gram_dtype="bf16" threads through the shard_map path: the mesh
+        fit must land within bf16-rounding distance of the single-device
+        bf16 fit (same config, same seed)."""
+        from large_scale_recommendation_tpu.parallel.als_mesh import MeshALS
+        from large_scale_recommendation_tpu.parallel.mesh import (
+            make_block_mesh,
+        )
+
+        gen = SyntheticMFGenerator(num_users=90, num_items=60, rank=4,
+                                   noise=0.05, seed=12)
+        tr = gen.generate(8000)
+        te = gen.generate(2000)
+        cfg = ALSConfig(num_factors=6, lambda_=0.05, iterations=5,
+                        gram_dtype="bf16")
+        single = ALS(cfg).fit(tr)
+        mesh = MeshALS(cfg, mesh=make_block_mesh(4)).fit(tr)
+        rs, rm = single.rmse(te), mesh.rmse(te)
+        assert rs < 0.12 and rm < 0.12, (rs, rm)
+        assert abs(rs - rm) < 5e-3, (rs, rm)
+
+    def test_mesh_bad_gram_dtype_rejected_before_plans(self):
+        from large_scale_recommendation_tpu.parallel.als_mesh import MeshALS
+        from large_scale_recommendation_tpu.parallel.mesh import (
+            make_block_mesh,
+        )
+
+        gen = SyntheticMFGenerator(num_users=20, num_items=15, rank=2,
+                                   seed=0)
+        with pytest.raises(ValueError, match="gram_dtype"):
+            MeshALS(ALSConfig(gram_dtype="int8"),
+                    mesh=make_block_mesh(4)).fit(gen.generate(500))
