@@ -55,6 +55,7 @@ extras either way, so an extras overrun can never cost the measurement).
 
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import subprocess
@@ -115,10 +116,11 @@ def run_child() -> None:
     # Vocab overrides: reduced runs MUST shrink the user/item space with
     # nnz — below ~100 obs/row the planted structure is unrecoverable by
     # any solver (docs/PERF.md) and the RMSE curve carries no information.
-    num_users = (int(os.environ["BENCH_USERS"])
-                 if os.environ.get("BENCH_USERS") else None)
-    num_items = (int(os.environ["BENCH_ITEMS"])
-                 if os.environ.get("BENCH_ITEMS") else None)
+    from large_scale_recommendation_tpu.data.movielens import (
+        vocab_overrides_from_env,
+    )
+
+    num_users, num_items = vocab_overrides_from_env()
     # effective vocab for labels: ml-25m shape with any overrides applied
     eff_users = num_users if num_users is not None else 162_541
     eff_items = num_items if num_items is not None else 59_047
@@ -358,12 +360,24 @@ def run_child() -> None:
         sse = sgd_ops.sse_rows(U, V, hur_d, hir_d, hv_d, hmask)
         return float(np.sqrt(float(sse) / n_eval))
 
-    kw = dict(updater=solver.updater, minibatch=mb, num_blocks=blocks,
-              iterations=1, collision="mean")
+    # BENCH_KERNEL=pallas routes the headline through the VMEM-staged
+    # Pallas kernel via the model layer's own routing (DSGDConfig.kernel →
+    # DSGD._train_fn — the surface users flip). Opt-in: the wrapper
+    # enforces the Pallas VMEM/SMEM geometry (rank 128 needs
+    # BENCH_BLOCKS=16 and mb ≤ 4096) and raises loudly on violation.
+    # The minibatch autotune above stays an XLA-kernel A/B by design.
+    bench_kernel = os.environ.get("BENCH_KERNEL", "xla")
+    extra["kernel"] = bench_kernel
+    solver.config = dataclasses.replace(cfg, kernel=bench_kernel,
+                                        minibatch_size=mb)
+    sweep_fn = solver._train_fn(args)
+
+    def one_sweep(U, V, t):
+        return sweep_fn(U, V, iterations=1, t0=t, k=blocks)
 
     # warm-up: compile the per-sweep kernel
     t0 = time.perf_counter()
-    Uw, Vw = sgd_ops.dsgd_train(U, V, *args, **kw, t0=0)
+    Uw, Vw = one_sweep(U, V, 0)
     jax.block_until_ready((Uw, Vw))
     extra["compile_wall_s"] = round(time.perf_counter() - t0, 1)
 
@@ -374,7 +388,7 @@ def run_child() -> None:
         from large_scale_recommendation_tpu.utils.metrics import profile
 
         with profile(profile_dir):
-            Uw, Vw = sgd_ops.dsgd_train(U, V, *args, **kw, t0=0)
+            Uw, Vw = one_sweep(U, V, 0)
             jax.block_until_ready((Uw, Vw))
         extra["profile_trace_dir"] = profile_dir
     del Uw, Vw
@@ -387,7 +401,7 @@ def run_child() -> None:
     curve = [round(rmse_now, 4)]
     for it in range(max_iters):
         t0 = time.perf_counter()
-        U, V = sgd_ops.dsgd_train(U, V, *args, **kw, t0=it)
+        U, V = one_sweep(U, V, it)
         jax.block_until_ready((U, V))
         train_wall += time.perf_counter() - t0
         rmse_now = rmse(U, V)

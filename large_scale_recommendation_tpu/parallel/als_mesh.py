@@ -92,18 +92,27 @@ def build_mesh_als_step(
             return jnp.einsum("nk,nl->kl", F, F,
                               preferred_element_type=jnp.float32)
 
+        # explicit path: cast the LOCAL shard before the all_gather —
+        # elementwise cast commutes with gather, so this is the same bf16
+        # table solve_side_local would build, but both collectives move
+        # half the ICI bytes. The implicit path gathers f32 (full_gram's
+        # VᵀV term stays full precision) and casts inside the solve.
+        pre_cast = gram_dtype is not None and not implicit
+        cast = (lambda x: x.astype(gram_dtype)) if pre_cast else (lambda x: x)
+        local_dtype = None if pre_cast else gram_dtype
+
         def round_(carry, _):
             U_l, V_l = carry
-            V_full = jax.lax.all_gather(V_l, BLOCK_AXIS, tiled=True)
+            V_full = jax.lax.all_gather(cast(V_l), BLOCK_AXIS, tiled=True)
             Gv = full_gram(V_full) if implicit else None
             U_l = als_ops.solve_side_local(V_full, ub, nu_l, lam, scale_u,
                                            varying_zeros, Gv,
-                                           dtype=gram_dtype)
-            U_full = jax.lax.all_gather(U_l, BLOCK_AXIS, tiled=True)
+                                           dtype=local_dtype)
+            U_full = jax.lax.all_gather(cast(U_l), BLOCK_AXIS, tiled=True)
             Gu = full_gram(U_full) if implicit else None
             V_l = als_ops.solve_side_local(U_full, ib, ni_l, lam, scale_v,
                                            varying_zeros, Gu,
-                                           dtype=gram_dtype)
+                                           dtype=local_dtype)
             return (U_l, V_l), None
 
         (U_l, V_l), _ = jax.lax.scan(round_, (U_l, V_l), None,

@@ -52,14 +52,12 @@ def main() -> None:
     dev = jax.devices()[0]
     out: dict = {"device": str(dev.device_kind) + str(dev.id)}
 
+    from large_scale_recommendation_tpu.data.movielens import (
+        vocab_overrides_from_env,
+    )
+
     als_nnz = int(os.environ.get("BENCH_ALS_NNZ", 2_000_000))
-    # vocab overrides, same contract as bench.py: reduced-nnz runs MUST
-    # shrink the vocab too or the plans solve mostly-empty normal
-    # equations (the pathological regime bench.py's own comment flags)
-    num_users = (int(os.environ["BENCH_USERS"])
-                 if os.environ.get("BENCH_USERS") else None)
-    num_items = (int(os.environ["BENCH_ITEMS"])
-                 if os.environ.get("BENCH_ITEMS") else None)
+    num_users, num_items = vocab_overrides_from_env()
     (au, ai, ar), _, (anu, ani) = synthetic_like_device(
         "ml-25m", nnz=int(als_nnz / 0.95) + 1, rank=16, noise=0.1, seed=1,
         skew_lam=2.0, num_users=num_users, num_items=num_items)

@@ -77,8 +77,14 @@ def main() -> None:
                  "blocks": k, "minibatch": mb, "nnz": nnz,
                  "rmse_target": target}
 
+    from large_scale_recommendation_tpu.data.movielens import (
+        vocab_overrides_from_env,
+    )
+
+    num_users, num_items = vocab_overrides_from_env()
     (du, di, dr), (dhu, dhi, dhv), (nu, ni) = synthetic_like_device(
-        "ml-25m", nnz=nnz, rank=16, noise=0.1, seed=0, skew_lam=2.0)
+        "ml-25m", nnz=nnz, rank=16, noise=0.1, seed=0, skew_lam=2.0,
+        num_users=num_users, num_items=num_items)
     jax.block_until_ready(dr)
     t0 = time.perf_counter()
     p = device_block_problem(du, di, dr, nu, ni, num_blocks=k,
