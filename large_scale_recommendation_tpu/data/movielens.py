@@ -120,8 +120,6 @@ def vocab_overrides_from_env() -> tuple[int | None, int | None]:
     vocab along with nnz, or the workload degenerates (DSGD: obs/row below
     the recoverable regime; ALS: mostly-empty normal equations). Used by
     bench.py and the scripts/ probes so the parse cannot drift."""
-    import os
-
     nu = os.environ.get("BENCH_USERS")
     ni = os.environ.get("BENCH_ITEMS")
     return (int(nu) if nu else None, int(ni) if ni else None)

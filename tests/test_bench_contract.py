@@ -63,3 +63,36 @@ def test_cpu_fallback_config_is_in_recoverable_regime():
     # target must sit between the noise floor (0.1) and the start RMSE
     # (~0.27 = planted-signal std) or time-to-target is unreachable/trivial
     assert 0.1 < float(cfg["BENCH_RMSE_TARGET"]) < 0.27
+
+
+@pytest.mark.slow
+def test_bench_kernel_knob_routes_pallas():
+    """BENCH_KERNEL=pallas drives the headline through the model layer's
+    kernel routing (interpret mode on CPU) and records the choice in the
+    JSON — the driver-form twin of scripts/pallas_northstar.py."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "BENCH_FORCE_CPU": "1",
+        "BENCH_NNZ": "60000",
+        "BENCH_USERS": "600",
+        "BENCH_ITEMS": "300",
+        "BENCH_RANK": "16",
+        "BENCH_ITERS": "1",
+        "BENCH_MB": "512",
+        "BENCH_BLOCKS": "4",
+        "BENCH_SKIP_EXTRAS": "1",
+        "BENCH_KERNEL": "pallas",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--child"],
+        env=env, capture_output=True, text=True, timeout=600, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    d = json.loads(lines[-1])
+    assert d["extra"]["kernel"] == "pallas"
+    assert d["value"] > 0
+    # training actually descended (the Pallas path really trained)
+    curve = d["extra"]["rmse_curve"]
+    assert curve[-1] < curve[0], curve
