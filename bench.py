@@ -799,10 +799,16 @@ def _extra_lines(extra: dict, rank: int, jax, h2d_mbps: float,
     # through the PS queues and, on the tunneled bench device, the
     # ~30-70 ms link — the same RTT-amortization lever as the adaptive
     # line (on-chip r5 the 512 config measured 21.6K r/s, RTT-shaped)
+    # BENCH_PS_CHUNK: 2048 is the measured CPU optimum (coarser chunks
+    # lose worker-pipeline overlap — docs/PERF.md "PS pull-chunk
+    # granularity"); on a tunneled chip the RTT term may favor larger,
+    # a one-env-var experiment for the next live window.
     ps_cfg = PSOfflineMFConfig(num_factors=rank, iterations=2,
                                learning_rate=0.05, lr_schedule="inverse_sqrt",
                                worker_parallelism=4, ps_parallelism=4,
-                               pull_limit=4, chunk_size=2048,
+                               pull_limit=4,
+                               chunk_size=int(os.environ.get(
+                                   "BENCH_PS_CHUNK", 2048)),
                                minibatch_size=4096)
     # warm-up on a small run: the PS line measures the threads+queues
     # protocol + jitted chunk kernels, not one-time XLA compiles (every
@@ -838,11 +844,17 @@ def _extra_lines(extra: dict, rank: int, jax, h2d_mbps: float,
     # link (~10K ev/s ceiling; observed 5.3K on-chip r5). 4096 keeps the
     # same vectorized-update math (a real deployment tunes this to its
     # link, exactly like the reference's pullLimit window).
+    # chunk_size is the BATCH-REPLAY pull granularity — the same
+    # RTT-amortization lever as online_chunk_size: at 512 the on-chip
+    # replay paid a tunnel round-trip per 512-rating chunk (5.4K ev/s,
+    # r5). 4096 measured +36% on CPU (21.0K -> 28.5K ev/s at this
+    # config) and cuts the tunneled dispatch count 8x.
     ad_cfg = PSOnlineBatchConfig(
         num_factors=rank, iterations=2, learning_rate=0.05,
         lr_schedule="inverse_sqrt", worker_parallelism=4,
-        ps_parallelism=4, chunk_size=512, minibatch_size=4096,
-        online_chunk_size=4096)
+        ps_parallelism=4,
+        chunk_size=int(os.environ.get("BENCH_AD_CHUNK", 4096)),
+        minibatch_size=4096, online_chunk_size=4096)
     # warm-up (same policy as every line here): the SAME stream, so the
     # pow2 shape buckets of the chunked online path and the batch-replay
     # tables (history-sized — a smaller warm stream lands in different
