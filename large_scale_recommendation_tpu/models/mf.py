@@ -126,21 +126,73 @@ class MFModel:
         u_rows, u_mask = self.users.rows_for(np.asarray(eval_u))
         i_rows, i_mask = self.items.rows_for(np.asarray(eval_i))
         keep = (u_mask * i_mask) > 0
-        tu = ti = None
-        if train is not None:
-            if isinstance(train, tuple):
-                tru, tri = train
-            else:
-                tru, tri, _, _ = train.to_numpy()
-            tr_u, tr_um = self.users.rows_for(np.asarray(tru))
-            tr_i, tr_im = self.items.rows_for(np.asarray(tri))
-            tkeep = (tr_um * tr_im) > 0
-            tu, ti = tr_u[tkeep], tr_i[tkeep]
+        tu, ti = self._train_rows(train)
         # block-padded tables hold random-init rows with no item behind
         # them; mask them out of the catalog or they rank as phantoms
         return ranking_metrics(self.U, self.V, u_rows[keep], i_rows[keep],
                                k=k, train_u=tu, train_i=ti, chunk=chunk,
                                item_mask=np.asarray(self.items.ids) >= 0)
+
+    def _train_rows(self, train: "Ratings | tuple | None"):
+        """Map a ``Ratings`` / ``(user_ids, item_ids)`` exclusion set to
+        row space, dropping never-seen pairs — the ONE copy of the
+        train-exclusion contract shared by evaluation (ranking_quality)
+        and serving (recommend), so their semantics cannot drift."""
+        if train is None:
+            return None, None
+        if isinstance(train, tuple):
+            tru, tri = train
+        else:
+            tru, tri, _, _ = train.to_numpy()
+        tr_u, tr_um = self.users.rows_for(np.asarray(tru))
+        tr_i, tr_im = self.items.rows_for(np.asarray(tri))
+        tkeep = (tr_um * tr_im) > 0
+        return tr_u[tkeep], tr_i[tkeep]
+
+    def recommend(self, user_ids, k: int = 10,
+                  train: "Ratings | tuple | None" = None,
+                  chunk: int = 2048, return_mask: bool = False):
+        """Top-K items per user by full-catalog score — ≙ MLlib
+        ``MatrixFactorizationModel.recommendProducts``, the serving
+        surface of the model the reference's ALS retrain branch returns
+        (OnlineSpark.scala:125-131). The scoring protocol is EXACTLY
+        ``ranking_quality``'s (one [chunk, n_items] MXU matmul per chunk,
+        phantom padding rows masked), so offline HR@K/NDCG@K evaluate the
+        same list this method serves.
+
+        ``train`` (a ``Ratings`` or ``(user_ids, item_ids)`` pair)
+        excludes each user's already-interacted items — the standard
+        serving contract (recommend only NEW items).
+
+        Returns ``(item_ids int64 [n, k], scores float32 [n, k])`` sorted
+        by descending score. Users never seen in training get item_ids
+        -1 and scores 0.0 (the ``predict`` no-information convention);
+        slots beyond the effective catalog (k > real items remaining
+        after exclusion) also carry -1/0.0. ``return_mask=True`` appends
+        the per-user seen mask, like ``predict``.
+        """
+        from large_scale_recommendation_tpu.utils.metrics import (
+            top_k_recommend,
+        )
+
+        u_rows, u_mask = self.users.rows_for(np.asarray(user_ids))
+        known = u_mask > 0
+        tu, ti = self._train_rows(train)
+        item_ids_of_row = np.asarray(self.items.ids)
+        top_rows, top_scores = top_k_recommend(
+            self.U, self.V, u_rows[known], k=k, train_u=tu, train_i=ti,
+            chunk=chunk, item_mask=item_ids_of_row >= 0)
+        n = len(u_rows)
+        ids = np.full((n, k), -1, np.int64)
+        scores = np.zeros((n, k), np.float32)
+        # kill below-catalog slots (excluded/masked rows surface with
+        # scores ≤ -1e30 when k exceeds the effective catalog)
+        real = top_scores > -1e29
+        ids[known] = np.where(real, item_ids_of_row[top_rows], -1)
+        scores[known] = np.where(real, top_scores, 0.0)
+        if return_mask:
+            return ids, scores, known
+        return ids, scores
 
     # -- export -------------------------------------------------------------
 

@@ -144,6 +144,54 @@ def _rank_kernel():
     return _RANK_KERNEL
 
 
+def _exclusion_builder(train_u, train_i, num_users: int):
+    """Per-chunk train-seen exclusion lists, pow2-bucketed.
+
+    Returns ``build(cu, c) -> (excl_rows, excl_cols, excl_w)`` mapping a
+    (padded) chunk of user rows to the scatter-min exclusion triple the
+    ranked-score kernels consume; shared by ``ranking_metrics`` (rank of
+    a held-out positive) and ``top_k_recommend`` (serving) so the
+    exclusion semantics cannot drift between evaluation and serving."""
+    import numpy as np
+
+    from large_scale_recommendation_tpu.utils.shapes import pow2_pad
+
+    if train_u is None:
+        # same pow2-bucketed shape as the with-train e=0 case, so the
+        # jitted kernels compile ONE empty-exclusion variant either way
+        ep = pow2_pad(1)
+
+        def build_empty(cu, c):
+            z = np.zeros(ep, np.int32)
+            return z, z, np.full(ep, np.inf, np.float32)
+
+        return build_empty
+
+    train_u = np.asarray(train_u)
+    order = np.argsort(train_u, kind="stable")
+    tu = train_u[order]
+    ti = np.asarray(train_i, dtype=np.int32)[order]
+    starts = np.searchsorted(tu, np.arange(num_users + 1))
+
+    def build(cu, c):
+        counts = (starts[cu + 1] - starts[cu])[:c]
+        e = int(counts.sum())
+        rows = np.repeat(np.arange(c, dtype=np.int32), counts)
+        # absolute positions of each user's train slice, vectorized
+        offs = np.repeat(
+            starts[cu[:c]].astype(np.int64)
+            - np.concatenate([[0], np.cumsum(counts)[:-1]]), counts)
+        cols = ti[(np.arange(e) + offs)] if e else np.zeros(0, np.int32)
+        ep = pow2_pad(max(e, 1))
+        excl_rows = np.zeros(ep, np.int32)
+        excl_cols = np.zeros(ep, np.int32)
+        excl_w = np.full(ep, np.inf, np.float32)  # pads: min() no-ops
+        excl_rows[:e], excl_cols[:e], excl_w[:e] = rows, cols, -1e30
+        return excl_rows, excl_cols, excl_w
+
+    return build
+
+
 def ranking_metrics(U, V, eval_u, eval_i, k: int = 10,
                     train_u=None, train_i=None, chunk: int = 2048,
                     item_mask=None) -> dict:
@@ -181,12 +229,7 @@ def ranking_metrics(U, V, eval_u, eval_i, k: int = 10,
         return {"hr": float("nan"), "ndcg": float("nan"), "n": 0}
     num_users = int(U.shape[0])
 
-    if train_u is not None:
-        train_u = np.asarray(train_u)
-        order = np.argsort(train_u, kind="stable")
-        tu = train_u[order]
-        ti = np.asarray(train_i, dtype=np.int32)[order]
-        starts = np.searchsorted(tu, np.arange(num_users + 1))
+    build_excl = _exclusion_builder(train_u, train_i, num_users)
     kern = _rank_kernel()
     item_w = np.zeros(int(V.shape[0]), np.float32)
     if item_mask is not None:
@@ -200,27 +243,83 @@ def ranking_metrics(U, V, eval_u, eval_i, k: int = 10,
         if c < chunk:  # pad the tail chunk to the fixed shape
             cu = np.concatenate([cu, np.zeros(chunk - c, cu.dtype)])
             ci = np.concatenate([ci, np.zeros(chunk - c, ci.dtype)])
-        if train_u is not None:
-            counts = (starts[cu + 1] - starts[cu])[:c]
-            e = int(counts.sum())
-            rows = np.repeat(np.arange(c, dtype=np.int32), counts)
-            # absolute positions of each user's train slice, vectorized
-            offs = np.repeat(
-                starts[cu[:c]].astype(np.int64)
-                - np.concatenate([[0], np.cumsum(counts)[:-1]]), counts)
-            cols = ti[(np.arange(e) + offs)] if e else np.zeros(0, np.int32)
-        else:
-            e, rows, cols = 0, np.zeros(0, np.int32), np.zeros(0, np.int32)
-        ep = pow2_pad(max(e, 1))
-        excl_rows = np.zeros(ep, np.int32)
-        excl_cols = np.zeros(ep, np.int32)
-        excl_w = np.full(ep, np.inf, np.float32)  # pads: min() no-ops
-        excl_rows[:e], excl_cols[:e], excl_w[:e] = rows, cols, -1e30
+        excl_rows, excl_cols, excl_w = build_excl(cu, c)
         hit, nd = kern(U[np.asarray(cu)], V, ci, excl_rows, excl_cols,
                        excl_w, item_w, k=k)
         hits += float(np.asarray(hit[:c]).sum())
         ndcg += float(np.asarray(nd[:c]).sum())
     return {"hr": hits / n, "ndcg": ndcg / n, "n": n}
+
+
+_TOPK_KERNEL = None
+
+
+def _topk_kernel():
+    global _TOPK_KERNEL
+    if _TOPK_KERNEL is None:
+        from functools import partial
+
+        import jax
+
+        @partial(jax.jit, static_argnames=("k",))
+        def kern(U_rows, V, excl_rows, excl_cols, excl_w, item_w, *, k):
+            # same score surface as _rank_kernel: one [C, n_items] MXU
+            # matmul + scatter-min exclusions + phantom-row mask — then
+            # lax.top_k instead of compare-and-count
+            scores = U_rows @ V.T + item_w[None, :]
+            scores = scores.at[excl_rows, excl_cols].min(excl_w)
+            return jax.lax.top_k(scores, k)
+
+        _TOPK_KERNEL = kern
+    return _TOPK_KERNEL
+
+
+def top_k_recommend(U, V, user_rows, k: int = 10,
+                    train_u=None, train_i=None, chunk: int = 2048,
+                    item_mask=None):
+    """Top-K item rows per user by full-catalog score — the SERVING twin
+    of ``ranking_metrics`` (≙ MLlib ``MatrixFactorizationModel
+    .recommendProducts``, the consumer surface of the model the
+    reference's ALS branch returns). Same protocol: one
+    ``[chunk, n_items]`` MXU matmul per chunk, train-seen pairs
+    scatter-min-excluded, ``item_mask`` drops phantom padding rows.
+
+    Inputs are ROW indices into ``U``/``V``; returns
+    ``(top_rows int32 [n, k], top_scores float32 [n, k])`` sorted by
+    descending score. Excluded/masked slots that still surface (k larger
+    than the effective catalog) carry scores ≤ -1e30 — callers drop them
+    by score sign.
+    """
+    import numpy as np
+
+    from large_scale_recommendation_tpu.utils.shapes import pow2_pad
+
+    user_rows = np.asarray(user_rows)
+    n = len(user_rows)
+    if n == 0:
+        return (np.zeros((0, k), np.int32), np.zeros((0, k), np.float32))
+    build_excl = _exclusion_builder(train_u, train_i, int(U.shape[0]))
+    kern = _topk_kernel()
+    item_w = np.zeros(int(V.shape[0]), np.float32)
+    if item_mask is not None:
+        item_w[~np.asarray(item_mask)] = -1e30
+    chunk = min(chunk, pow2_pad(n))
+    # top_k demands k ≤ n_items; serve the clamped prefix and pad the
+    # remainder as below-catalog slots (score -inf → callers drop them)
+    kk = min(k, int(V.shape[0]))
+    out_rows = np.zeros((n, k), np.int32)
+    out_scores = np.full((n, k), -np.inf, np.float32)
+    for c0 in range(0, n, chunk):
+        cu = user_rows[c0:c0 + chunk]
+        c = len(cu)
+        if c < chunk:
+            cu = np.concatenate([cu, np.zeros(chunk - c, cu.dtype)])
+        excl_rows, excl_cols, excl_w = build_excl(cu, c)
+        sc, rows = kern(U[np.asarray(cu)], V, excl_rows, excl_cols,
+                        excl_w, item_w, k=kk)
+        out_rows[c0:c0 + c, :kk] = np.asarray(rows[:c])
+        out_scores[c0:c0 + c, :kk] = np.asarray(sc[:c])
+    return out_rows, out_scores
 
 
 @contextlib.contextmanager

@@ -801,3 +801,101 @@ class TestBF16Gram:
         with pytest.raises(ValueError, match="gram_dtype"):
             MeshALS(ALSConfig(gram_dtype="int8"),
                     mesh=make_block_mesh(4)).fit(gen.generate(500))
+
+
+class TestRecommend:
+    """MFModel.recommend — the MLlib recommendProducts serving twin of
+    ranking_quality: same chunked full-catalog scoring, top-K output in
+    EXTERNAL id space with the predict unknown-id conventions."""
+
+    def _model(self, seed=0, nu=40, ni=30):
+        gen = SyntheticMFGenerator(num_users=nu, num_items=ni, rank=4,
+                                   noise=0.05, seed=seed)
+        train = gen.generate(4000)
+        model = ALS(ALSConfig(num_factors=6, lambda_=0.05,
+                              iterations=5)).fit(train)
+        return model, train
+
+    def test_matches_numpy_oracle(self):
+        model, train = self._model()
+        uids = np.array([0, 3, 7, 11, 2])
+        k = 5
+        ids, scores = model.recommend(uids, k=k, train=train, chunk=2)
+
+        # oracle: dense score matrix in id space
+        U, V = np.asarray(model.U), np.asarray(model.V)
+        tru, tri, _, _ = train.to_numpy()
+        seen = set(zip(tru.tolist(), tri.tolist()))
+        for j, uid in enumerate(uids.tolist()):
+            ur, um = model.users.rows_for(np.array([uid]))
+            assert um[0] == 1.0
+            s = U[ur[0]] @ V.T
+            cand = []
+            for row in range(V.shape[0]):
+                iid = int(model.items.ids[row])
+                if iid < 0 or (uid, iid) in seen:
+                    continue
+                cand.append((float(s[row]), iid))
+            cand.sort(key=lambda t: (-t[0], t[1]))
+            want = [iid for _, iid in cand[:k]]
+            got = [i for i in ids[j].tolist() if i >= 0]
+            # ties are rare with real factors; compare score multisets to
+            # stay robust if two items tie exactly
+            want_scores = sorted(t[0] for t in cand[:k])
+            got_scores = sorted(scores[j][scores[j] != 0.0].tolist())
+            np.testing.assert_allclose(got_scores, want_scores, rtol=1e-5)
+            assert set(got) <= {iid for _, iid in cand}
+            assert len(got) == min(k, len(cand))
+            # excluded train items never appear
+            assert not any((uid, i) in seen for i in got)
+            # and with no near-ties the exact list matches
+            if len({round(t[0], 5) for t in cand[:k + 1]}) == k + 1:
+                assert got == want, (uid, got, want)
+
+    def test_unknown_users_get_minus_one(self):
+        model, train = self._model()
+        ids, scores, seen = model.recommend(
+            np.array([0, 99999]), k=3, return_mask=True)
+        assert seen.tolist() == [True, False]
+        assert (ids[1] == -1).all() and (scores[1] == 0.0).all()
+        assert (ids[0] >= 0).all()
+
+    def test_k_larger_than_catalog_pads_with_minus_one(self):
+        model, train = self._model(nu=15, ni=6)
+        ids, scores = model.recommend(np.array([1]), k=10)
+        real = ids[0] >= 0
+        # at most the full catalog can be real
+        assert real.sum() <= 6
+        assert (scores[0][~real] == 0.0).all()
+
+    def test_consistent_with_ranking_quality(self):
+        """A held-out positive that ranking_quality scores as a top-k hit
+        must appear in recommend's top-k list (same protocol pin)."""
+        model, train = self._model(seed=3)
+        # pick eval pairs = each user's argmax unseen item (guaranteed hit)
+        U, V = np.asarray(model.U), np.asarray(model.V)
+        tru, tri, _, _ = train.to_numpy()
+        seen = set(zip(tru.tolist(), tri.tolist()))
+        eu, ei = [], []
+        for uid in range(10):
+            ur, um = model.users.rows_for(np.array([uid]))
+            if um[0] == 0:
+                continue
+            s = U[ur[0]] @ V.T
+            best, best_iid = -1e30, None
+            for row in range(V.shape[0]):
+                iid = int(model.items.ids[row])
+                if iid < 0 or (uid, iid) in seen:
+                    continue
+                if s[row] > best:
+                    best, best_iid = s[row], iid
+            if best_iid is None:  # user has interacted with every item
+                continue
+            eu.append(uid)
+            ei.append(best_iid)
+        assert eu, "no user with an unseen item — workload too dense"
+        rq = model.ranking_quality(np.array(eu), np.array(ei), k=1,
+                                   train=train)
+        assert rq["hr"] == 1.0  # argmax unseen item ranks first
+        ids, _ = model.recommend(np.array(eu), k=1, train=train)
+        assert ids[:, 0].tolist() == ei
