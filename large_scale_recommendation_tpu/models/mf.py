@@ -32,6 +32,24 @@ def masked_scores(scores, u_mask, i_mask, return_mask: bool):
     return (out, seen) if return_mask else out
 
 
+def _assemble_topk(n: int, k: int, known, top_rows, top_scores,
+                   ids_of_row, return_mask: bool):
+    """Shared id-space output assembly for both serving directions.
+
+    Row-space top-K → external ids with the ``predict`` conventions:
+    unknown queries get -1/0.0 rows; below-catalog slots (the kernels
+    mark excluded/masked rows with scores ≤ -1e30 — one sentinel
+    contract with ``utils.metrics``) become -1/0.0 too."""
+    ids = np.full((n, k), -1, np.int64)
+    scores = np.zeros((n, k), np.float32)
+    real = top_scores > -1e29
+    ids[known] = np.where(real, ids_of_row[top_rows], -1)
+    scores[known] = np.where(real, top_scores, 0.0)
+    if return_mask:
+        return ids, scores, known
+    return ids, scores
+
+
 @dataclasses.dataclass
 class MFModel:
     """A trained (or in-training) factorization: U, V on device + id maps.
@@ -133,6 +151,29 @@ class MFModel:
                                k=k, train_u=tu, train_i=ti, chunk=chunk,
                                item_mask=np.asarray(self.items.ids) >= 0)
 
+    def recommend_users(self, item_ids, k: int = 10,
+                        train: "Ratings | tuple | None" = None,
+                        chunk: int = 2048, return_mask: bool = False):
+        """Top-K users per item — ≙ MLlib ``MatrixFactorizationModel
+        .recommendUsers``, the role-swapped twin of ``recommend`` (same
+        kernel with U and V exchanged; ``train`` pairs are (user, item)
+        as everywhere else). Returns ``(user_ids int64 [n, k], scores)``
+        with the same unknown-id / below-catalog conventions."""
+        from large_scale_recommendation_tpu.utils.metrics import (
+            top_k_recommend,
+        )
+
+        i_rows, i_mask = self.items.rows_for(np.asarray(item_ids))
+        known = i_mask > 0
+        tu, ti = self._train_rows(train)
+        user_ids_of_row = np.asarray(self.users.ids)
+        top_rows, top_scores = top_k_recommend(
+            self.V, self.U, i_rows[known], k=k,
+            train_u=ti, train_i=tu,  # exclusion pairs swap roles too
+            chunk=chunk, item_mask=user_ids_of_row >= 0)
+        return _assemble_topk(len(i_rows), k, known, top_rows, top_scores,
+                              user_ids_of_row, return_mask)
+
     def _train_rows(self, train: "Ratings | tuple | None"):
         """Map a ``Ratings`` / ``(user_ids, item_ids)`` exclusion set to
         row space, dropping never-seen pairs — the ONE copy of the
@@ -182,17 +223,8 @@ class MFModel:
         top_rows, top_scores = top_k_recommend(
             self.U, self.V, u_rows[known], k=k, train_u=tu, train_i=ti,
             chunk=chunk, item_mask=item_ids_of_row >= 0)
-        n = len(u_rows)
-        ids = np.full((n, k), -1, np.int64)
-        scores = np.zeros((n, k), np.float32)
-        # kill below-catalog slots (excluded/masked rows surface with
-        # scores ≤ -1e30 when k exceeds the effective catalog)
-        real = top_scores > -1e29
-        ids[known] = np.where(real, item_ids_of_row[top_rows], -1)
-        scores[known] = np.where(real, top_scores, 0.0)
-        if return_mask:
-            return ids, scores, known
-        return ids, scores
+        return _assemble_topk(len(u_rows), k, known, top_rows, top_scores,
+                              item_ids_of_row, return_mask)
 
     # -- export -------------------------------------------------------------
 

@@ -899,3 +899,36 @@ class TestRecommend:
         assert rq["hr"] == 1.0  # argmax unseen item ranks first
         ids, _ = model.recommend(np.array(eu), k=1, train=train)
         assert ids[:, 0].tolist() == ei
+
+    def test_recommend_users_matches_transposed_oracle(self):
+        """recommend_users == recommend on the transposed model (roles
+        swapped), modulo id spaces — plus the exclusion role swap."""
+        model, train = self._model(seed=5)
+        iids = np.array([0, 2, 9])
+        ids, scores = model.recommend_users(iids, k=4, train=train)
+        U, V = np.asarray(model.U), np.asarray(model.V)
+        tru, tri, _, _ = train.to_numpy()
+        seen = set(zip(tru.tolist(), tri.tolist()))
+        for j, iid in enumerate(iids.tolist()):
+            ir, im = model.items.rows_for(np.array([iid]))
+            assert im[0] == 1.0
+            s = V[ir[0]] @ U.T
+            cand = []
+            for row in range(U.shape[0]):
+                uid = int(model.users.ids[row])
+                if uid < 0 or (uid, iid) in seen:
+                    continue
+                cand.append((float(s[row]), uid))
+            cand.sort(key=lambda t: (-t[0], t[1]))
+            got = [u for u in ids[j].tolist() if u >= 0]
+            got_scores = sorted(scores[j][scores[j] != 0.0].tolist())
+            want_scores = sorted(t[0] for t in cand[:4])
+            np.testing.assert_allclose(got_scores, want_scores, rtol=1e-5)
+            assert not any((u, iid) in seen for u in got)
+
+    def test_recommend_users_unknown_item(self):
+        model, _ = self._model()
+        ids, scores, seen = model.recommend_users(
+            np.array([0, 424242]), k=3, return_mask=True)
+        assert seen.tolist() == [True, False]
+        assert (ids[1] == -1).all() and (ids[0] >= 0).all()
