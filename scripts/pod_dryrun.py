@@ -66,7 +66,13 @@ def main(n_devices: int = 16) -> dict:
 
     k = n_devices
     num_users, num_items = 10_240 * k, 1_024 * k
-    nnz, rank, mb = 6_000_000, 128, 4096
+    rank, mb = 128, 4096
+    # draws scale linearly past k=32: with k² buckets over fixed draws,
+    # the mean bucket at k=64 (~1.5K nnz) falls below the minibatch
+    # rounding unit and the pad ratio is dominated by that CI-size
+    # artifact instead of the serpentine deal this pass validates (the
+    # REAL pod config holds ~244K nnz/bucket — docs/PERF.md memory table)
+    nnz = 6_000_000 * max(1, k // 32)
     (u, i, r), _, _ = synthetic_like_device(
         "ml-25m", nnz=nnz, rank=16, noise=0.1, seed=1, skew_lam=2.0,
         num_users=num_users, num_items=num_items)
@@ -82,10 +88,17 @@ def main(n_devices: int = 16) -> dict:
     # per-shard minibatch divisibility at high k: the padded block size
     # must honor minibatch_multiple exactly
     assert p.sv.shape[2] % mb == 0, (p.sv.shape, mb)
-    # pad-ratio pin: measured 1.10 at k=16 (3M nnz, skew_lam=2, minibatch
-    # rounding included); 2.0 is the alarm line — a blowup here means the
-    # serpentine deal or bucket layout regressed at high k
-    assert p.max_pad_ratio < 2.0, p.max_pad_ratio
+    # pad-ratio pin: measured 1.10 at k=16 / 1.47 at k=32 (6M draws) and
+    # 1.47 at k=64 (12M draws — 1.05× its rounding floor).
+    # The unavoidable floor from minibatch rounding alone is k²·mb/nnz
+    # (every bucket pads to a multiple of mb); the alarm fires when the
+    # measured ratio exceeds 1.5× that floor AND the 2.0 absolute line —
+    # i.e. only for genuine serpentine-deal/bucket-layout regressions,
+    # at every k, not for the CI-size rounding artifact.
+    rounding_floor = k * k * mb / nnz
+    out["pad_rounding_floor"] = round(rounding_floor, 3)
+    assert p.max_pad_ratio < max(2.0, 1.5 * rounding_floor), \
+        (p.max_pad_ratio, rounding_floor)
 
     mesh = make_block_mesh(k)
     cfg = MeshDSGDConfig(num_factors=rank, lambda_=0.1, iterations=4,

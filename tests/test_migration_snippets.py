@@ -1,0 +1,64 @@
+"""Every python code fence in docs/MIGRATION.md executes for real.
+
+The README vouches that the migration guide's snippets run against the
+actual APIs; this test makes that claim CI-enforced — a rename that
+breaks a snippet fails here, not in a migrating user's editor. Fences
+execute in order in one shared namespace seeded with the free variables
+the guide's prose assumes (train, users, items, a stream, events).
+"""
+
+import os
+import re
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_migration_guide_snippets_execute():
+    with open(os.path.join(REPO, "docs", "MIGRATION.md")) as f:
+        doc = f.read()
+    blocks = re.findall(r"```python\n(.*?)```", doc, re.DOTALL)
+    assert len(blocks) >= 4, "guide lost its snippets?"
+
+    from large_scale_recommendation_tpu.core.generators import (
+        SyntheticMFGenerator,
+    )
+    from large_scale_recommendation_tpu.core.types import Ratings
+
+    gen = SyntheticMFGenerator(num_users=300, num_items=150, rank=4,
+                               noise=0.1, seed=1)
+    train = gen.generate(20000)
+    ru, ri, rv, _ = train.to_numpy()
+    users = np.array([0, 3, 7])
+    items = np.array([1, 4, 9])
+    stream_of_rating_batches = [
+        Ratings.from_arrays(ru[j:j + 2000], ri[j:j + 2000], rv[j:j + 2000])
+        for j in range(0, 8000, 2000)
+    ]
+    ev = list(zip(ru[:2000].tolist(), ri[:2000].tolist(),
+                  rv[:2000].tolist()))
+    ns = {
+        "train": train,
+        "users": users,
+        "items": items,
+        "stream_of_rating_batches": stream_of_rating_batches,
+        "early_events": ev[:1000],
+        "later_events": ev[1000:],
+        "handle": lambda u: None,
+    }
+    for j, block in enumerate(blocks):
+        # the guide's snippets use illustrative sizes; shrink the slow
+        # knobs so the whole guide runs in CI time
+        block = (block.replace("iterations=10", "iterations=3")
+                 .replace("iterations=5", "iterations=2")
+                 .replace("num_factors=32", "num_factors=8"))
+        try:
+            exec(compile(block, f"MIGRATION.md[block {j}]", "exec"), ns)
+        except Exception as e:  # pragma: no cover - failure reporting
+            raise AssertionError(
+                f"MIGRATION.md block {j} failed: {e}\n---\n{block}") from e
+    # the doc's flow actually produced artifacts
+    assert "model" in ns and ns["model"].rmse(gen.generate(1000)) < 1.0

@@ -90,13 +90,25 @@ class TestPSOnlineBatch:
                   chunk_size=8, minibatch_size=32, seed=0, init_scale=0.3,
                   online_chunk_size=16)
         events = _events(train, trigger_at=trigger)
-        per = PSOnlineBatchMF(PSOnlineBatchConfig(
-            **kw, online_mode="per_rating"))
-        per.run(events)
-        chk = PSOnlineBatchMF(PSOnlineBatchConfig(
-            **kw, online_mode="chunked"))
-        chk.run(events)
-        r_per, r_chk = per.rmse(test), chk.rmse(test)
+
+        # A single threaded run samples ONE worker interleaving, and the
+        # chunked mode's group sizes (hence collision damping) depend on
+        # it — measured spread of one-shot RMSE includes outliers past
+        # any honest parity bar (0.073-vs-0.207 observed on a loaded
+        # machine at the round-5 code AND at its parent). The claim under
+        # test is about the LEARNING PROBLEM, not one interleaving, so
+        # compare medians over 3 runs per mode.
+        def median_rmse(mode):
+            rs = []
+            for _ in range(3):
+                s = PSOnlineBatchMF(PSOnlineBatchConfig(
+                    **kw, online_mode=mode))
+                s.run(events)
+                rs.append(s.rmse(test))
+            return sorted(rs)[1]
+
+        r_per = median_rmse("per_rating")
+        r_chk = median_rmse("chunked")
         assert abs(r_per - r_chk) < 0.08, (r_per, r_chk)
         # absolute quality floor (the tight convergence bar lives in
         # test_midstream_trigger_retrains_and_converges): online-only on
