@@ -487,7 +487,8 @@ def run_child() -> None:
     if not skip_extras:
         if elapsed < extras_deadline:
             _extra_lines(extra, rank, jax, h2d_mbps,
-                         num_users=num_users, num_items=num_items)
+                         num_users=num_users, num_items=num_items,
+                         model_factors=(U, V))
         else:
             extra["extras_skipped"] = (
                 f"headline took {elapsed:.0f}s ≥ extras deadline "
@@ -499,7 +500,8 @@ def run_child() -> None:
 
 def _extra_lines(extra: dict, rank: int, jax, h2d_mbps: float,
                  num_users: int | None = None,
-                 num_items: int | None = None) -> None:
+                 num_items: int | None = None,
+                 model_factors=None) -> None:
     """ALS (rank 128 + 256 + implicit), online-stream, and PS-mode lines.
 
     The ALS inputs are generated AND plan-built on device
@@ -573,6 +575,35 @@ def _extra_lines(extra: dict, rank: int, jax, h2d_mbps: float,
                     extra[f"kernel64_{label}_ratings_per_s"] = val
         except Exception as ex:  # never let the experiment kill the extras
             extra["kernel_probe_error"] = f"{type(ex).__name__}: {ex}"
+
+    # ---- top-K serving throughput (the MXU-shaped consumer surface) ------
+    # recommend's scoring is [chunk, n_item_rows] dense matmuls at the
+    # model rank — unlike the latency-bound DSGD gather loop, this is
+    # the workload a TensorCore is FOR, so the serving line is where MFU
+    # belongs on this framework. Pure compute measurement: row-space,
+    # no exclusion lists (their construction is host metadata work, and
+    # shipping 23.7M train pairs back over a narrow link to build them
+    # would measure the link); only the tiny row-index chunks cross.
+    if model_factors is not None:
+        try:
+            from large_scale_recommendation_tpu.utils.metrics import (
+                top_k_recommend,
+            )
+
+            Um, Vm = model_factors  # the headline's trained tables
+            serve_users = int(os.environ.get("BENCH_SERVE_USERS", 16384))
+            srows = np.arange(serve_users, dtype=np.int32) % int(Um.shape[0])
+            top_k_recommend(Um, Vm, srows[:2048], k=10, chunk=2048)  # warm
+            t0 = time.perf_counter()
+            top_k_recommend(Um, Vm, srows, k=10, chunk=2048)
+            wall = time.perf_counter() - t0  # numpy outputs → synced
+            extra["serving_users_per_s"] = round(serve_users / wall, 1)
+            sflops = 2.0 * serve_users * int(Vm.shape[0]) * rank
+            extra["serving_tflops"] = round(sflops / wall / 1e12, 3)
+            extra["serving_pct_of_fp32_peak"] = round(
+                100.0 * sflops / wall / 1e12 / FP32_PEAK_TFLOPS, 2)
+        except Exception as ex:
+            extra["serving_error"] = f"{type(ex).__name__}: {ex}"
 
     # ---- ALS: bucketed-matmul normal equations, all on device ------------
     als_nnz = int(os.environ.get("BENCH_ALS_NNZ", 2_000_000))
