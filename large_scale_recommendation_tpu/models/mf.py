@@ -192,7 +192,8 @@ class MFModel:
 
     def recommend(self, user_ids, k: int = 10,
                   train: "Ratings | tuple | None" = None,
-                  chunk: int = 2048, return_mask: bool = False):
+                  chunk: int = 2048, return_mask: bool = False,
+                  mesh=None):
         """Top-K items per user by full-catalog score — ≙ MLlib
         ``MatrixFactorizationModel.recommendProducts``, the serving
         surface of the model the reference's ALS retrain branch returns
@@ -204,6 +205,11 @@ class MFModel:
         ``train`` (a ``Ratings`` or ``(user_ids, item_ids)`` pair)
         excludes each user's already-interacted items — the standard
         serving contract (recommend only NEW items).
+
+        ``mesh`` (a ``jax.sharding.Mesh``) serves over an item-sharded
+        catalog: per-shard MXU scoring + local top-k, then a candidate
+        all_gather and exact merge (``parallel.serving``) — for catalogs
+        too large for one chip, or to parallelize the scoring FLOPs.
 
         Returns ``(item_ids int64 [n, k], scores float32 [n, k])`` sorted
         by descending score. Users never seen in training get item_ids
@@ -220,9 +226,19 @@ class MFModel:
         known = u_mask > 0
         tu, ti = self._train_rows(train)
         item_ids_of_row = np.asarray(self.items.ids)
-        top_rows, top_scores = top_k_recommend(
-            self.U, self.V, u_rows[known], k=k, train_u=tu, train_i=ti,
-            chunk=chunk, item_mask=item_ids_of_row >= 0)
+        if mesh is not None:
+            from large_scale_recommendation_tpu.parallel.serving import (
+                mesh_top_k_recommend,
+            )
+
+            top_rows, top_scores = mesh_top_k_recommend(
+                self.U, self.V, u_rows[known], k=k, train_u=tu,
+                train_i=ti, chunk=chunk,
+                item_mask=item_ids_of_row >= 0, mesh=mesh)
+        else:
+            top_rows, top_scores = top_k_recommend(
+                self.U, self.V, u_rows[known], k=k, train_u=tu,
+                train_i=ti, chunk=chunk, item_mask=item_ids_of_row >= 0)
         return _assemble_topk(len(u_rows), k, known, top_rows, top_scores,
                               item_ids_of_row, return_mask)
 

@@ -1,0 +1,150 @@
+"""Mesh-sharded top-K serving: recommend over an item-sharded catalog.
+
+Pod-scale serving twin of ``utils.metrics.top_k_recommend`` (which is
+itself ≙ MLlib ``MatrixFactorizationModel.recommendProducts`` — a
+DRIVER-side loop in MLlib; the reference has no distributed serving at
+all). Here the catalog side V is row-sharded over the device mesh and
+each query chunk runs
+
+    per shard:  scores [chunk, rows_per_shard] = U_chunk @ V_shardᵀ
+                (one MXU matmul per shard, in parallel)
+                + in-range exclusion scatter-min + local top-k
+    collective: all_gather of the [chunk, k] candidate (value, row)
+                pairs — k·n_dev candidates per query, a few KB riding
+                ICI instead of the full score row
+    merge:      top-k over the gathered candidates (exact: the global
+                top-k is a subset of the per-shard top-ks)
+
+Exact-equivalence contract: the merged result equals the single-device
+``lax.top_k`` over the full catalog wherever scores are tie-free
+(float ties can order differently across shard boundaries — same
+caveat as any distributed top-k; pinned by tests against the
+single-device path on tie-free workloads).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache, partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from large_scale_recommendation_tpu.parallel.mesh import (
+    BLOCK_AXIS,
+    make_block_mesh,
+)
+
+
+@lru_cache(maxsize=32)
+def _mesh_topk_step(mesh: Mesh, k_local: int, k_out: int,
+                    rows_per_shard: int):
+    """Jitted sharded scoring + local top-k + candidate merge.
+
+    ``k_local`` candidates per shard (≤ rows_per_shard), ``k_out``
+    merged results (≤ n_dev·k_local)."""
+
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(), P(BLOCK_AXIS), P(BLOCK_AXIS), P(), P(), P()),
+        out_specs=(P(), P()),
+        # outputs are replicated BY the trailing all_gather+top_k merge;
+        # the static VMA checker can't see through the axis_index-derived
+        # shard offsets to infer it (the mesh==single parity tests pin
+        # the actual equivalence)
+        check_vma=False,
+    )
+    def step(U_chunk, V_l, item_w_l, excl_rows, excl_cols, excl_w):
+        # locals arrive with the sharded axis already sliced away:
+        # V_l [rpb, r], item_w_l [rpb]
+        scores = U_chunk @ V_l.T + item_w_l[None, :]
+        # exclusions carry GLOBAL item rows; this shard applies the ones
+        # in its range (out-of-range → clamped index, +inf weight: no-op)
+        base = jax.lax.axis_index(BLOCK_AXIS) * rows_per_shard
+        local = excl_cols - base
+        in_range = (local >= 0) & (local < rows_per_shard)
+        local = jnp.clip(local, 0, rows_per_shard - 1)
+        w = jnp.where(in_range, excl_w, jnp.inf)
+        scores = scores.at[excl_rows, local].min(w)
+        v_loc, r_loc = jax.lax.top_k(scores, k_local)
+        r_glob = r_loc + base
+        # candidates ride the ICI: [chunk, n_dev·k_local] after the gather
+        v_all = jax.lax.all_gather(v_loc, BLOCK_AXIS, axis=1, tiled=True)
+        r_all = jax.lax.all_gather(r_glob, BLOCK_AXIS, axis=1, tiled=True)
+        v_top, pos = jax.lax.top_k(v_all, k_out)
+        return v_top, jnp.take_along_axis(r_all, pos, axis=1)
+
+    return jax.jit(step)
+
+
+def mesh_top_k_recommend(U, V, user_rows, k: int = 10,
+                         train_u=None, train_i=None, chunk: int = 2048,
+                         item_mask=None, mesh: Mesh | None = None):
+    """Row-space mesh serving — same contract as
+    ``utils.metrics.top_k_recommend`` (inputs are row indices, returns
+    ``(top_rows int32 [n, k], top_scores f32 [n, k])``), with the
+    catalog sharded over ``mesh`` and scored in parallel.
+
+    V's rows are padded to a mesh-divisible count on the way in (pad
+    rows are masked with -1e30, exactly like phantom catalog rows), so
+    any table height serves on any mesh size.
+    """
+    from large_scale_recommendation_tpu.utils.metrics import (
+        _exclusion_builder,
+    )
+    from large_scale_recommendation_tpu.utils.shapes import pow2_pad
+
+    mesh = mesh or make_block_mesh()
+    n_dev = mesh.shape[BLOCK_AXIS]
+    user_rows = np.asarray(user_rows)
+    n = len(user_rows)
+    if n == 0:
+        return (np.zeros((0, k), np.int32), np.zeros((0, k), np.float32))
+
+    n_rows = int(V.shape[0])
+    rpb = -(-n_rows // n_dev)
+    item_w = np.zeros(n_dev * rpb, np.float32)
+    if item_mask is not None:
+        item_w[:n_rows][~np.asarray(item_mask)] = -1e30
+    # mesh-padding rows score -inf (below even excluded/-1e30 slots):
+    # they can still surface when k exceeds the real candidate supply,
+    # so their indices are clamped to row 0 after the merge (below) —
+    # the single-device contract (rows are always valid table indices,
+    # dead slots identified by score) must hold on the mesh path too
+    item_w[n_rows:] = -np.inf
+    V_pad = jnp.concatenate(
+        [jnp.asarray(V),
+         jnp.zeros((n_dev * rpb - n_rows, V.shape[1]), jnp.float32)]
+    ) if n_dev * rpb != n_rows else jnp.asarray(V)
+    shard = NamedSharding(mesh, P(BLOCK_AXIS))
+    V_sh = jax.device_put(V_pad, shard)
+    w_sh = jax.device_put(jnp.asarray(item_w), shard)
+
+    k_local = min(k, rpb)  # per-shard top_k bound
+    k_out = min(k, n_dev * k_local)  # merged width
+    build_excl = _exclusion_builder(train_u, train_i, int(U.shape[0]))
+    step = _mesh_topk_step(mesh, k_local, k_out, rpb)
+    U_dev = jnp.asarray(U)  # row gathers stay on device per chunk
+
+    chunk = min(chunk, pow2_pad(n))
+    out_rows = np.zeros((n, k), np.int32)
+    out_scores = np.full((n, k), -np.inf, np.float32)
+    for c0 in range(0, n, chunk):
+        cu = user_rows[c0:c0 + chunk]
+        c = len(cu)
+        if c < chunk:
+            cu = np.concatenate([cu, np.zeros(chunk - c, cu.dtype)])
+        excl_rows, excl_cols, excl_w = build_excl(cu, c)
+        v_top, r_top = step(U_dev[jnp.asarray(cu)], V_sh, w_sh,
+                            jnp.asarray(excl_rows), jnp.asarray(excl_cols),
+                            jnp.asarray(excl_w))
+        out_rows[c0:c0 + c, :k_out] = np.asarray(r_top[:c])
+        out_scores[c0:c0 + c, :k_out] = np.asarray(v_top[:c])
+    pad_hits = out_rows >= n_rows  # surfaced mesh-padding rows
+    out_rows[pad_hits] = 0
+    out_scores[pad_hits] = -np.inf
+    return out_rows, out_scores
