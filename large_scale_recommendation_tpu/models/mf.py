@@ -229,12 +229,19 @@ class MFModel:
         if mesh is not None:
             from large_scale_recommendation_tpu.parallel.serving import (
                 mesh_top_k_recommend,
+                shard_catalog,
             )
 
+            # the sharded catalog is per-(model, mesh) state — build it
+            # once and reuse across requests (a serving loop's whole
+            # point); the factors are fit-time-frozen on this surface
+            cache = self.__dict__.setdefault("_serving_catalogs", {})
+            if mesh not in cache:
+                cache[mesh] = shard_catalog(
+                    self.V, mesh, item_mask=item_ids_of_row >= 0)
             top_rows, top_scores = mesh_top_k_recommend(
-                self.U, self.V, u_rows[known], k=k, train_u=tu,
-                train_i=ti, chunk=chunk,
-                item_mask=item_ids_of_row >= 0, mesh=mesh)
+                self.U, None, u_rows[known], k=k, train_u=tu,
+                train_i=ti, chunk=chunk, catalog=cache[mesh])
         else:
             top_rows, top_scores = top_k_recommend(
                 self.U, self.V, u_rows[known], k=k, train_u=tu,

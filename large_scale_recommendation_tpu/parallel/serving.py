@@ -30,13 +30,58 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 from jax import shard_map
+
+import dataclasses
 
 from large_scale_recommendation_tpu.parallel.mesh import (
     BLOCK_AXIS,
+    block_sharding,
     make_block_mesh,
 )
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardedCatalog:
+    """A catalog prepared for mesh serving: the padded factor table and
+    phantom/pad mask resident ON the mesh. Build once per (V, mesh,
+    item_mask) via ``shard_catalog`` and reuse across requests — the
+    per-call work then is one tiny query-chunk transfer + the candidate
+    merge, not a full-catalog reshard."""
+
+    V_sh: jax.Array  # [n_dev·rpb, r] block-sharded
+    w_sh: jax.Array  # [n_dev·rpb] -inf on mesh-pad rows, -1e30 on masked
+    n_rows: int  # real catalog height
+    rows_per_shard: int
+    mesh: Mesh
+
+
+def shard_catalog(V, mesh: Mesh | None = None,
+                  item_mask=None) -> ShardedCatalog:
+    """Pad ``V`` to a mesh-divisible height and place it block-sharded."""
+    mesh = mesh or make_block_mesh()
+    n_dev = mesh.shape[BLOCK_AXIS]
+    n_rows = int(V.shape[0])
+    rpb = -(-n_rows // n_dev)
+    item_w = np.zeros(n_dev * rpb, np.float32)
+    if item_mask is not None:
+        item_w[:n_rows][~np.asarray(item_mask)] = -1e30
+    # mesh-padding rows score -inf (below even excluded/-1e30 slots):
+    # they can still surface when k exceeds the real candidate supply,
+    # so their indices are clamped to row 0 after the merge — the
+    # single-device contract (rows are always valid table indices, dead
+    # slots identified by score) must hold on the mesh path too
+    item_w[n_rows:] = -np.inf
+    V_pad = jnp.concatenate(
+        [jnp.asarray(V),
+         jnp.zeros((n_dev * rpb - n_rows, V.shape[1]), jnp.float32)]
+    ) if n_dev * rpb != n_rows else jnp.asarray(V)
+    shard = block_sharding(mesh)
+    return ShardedCatalog(
+        V_sh=jax.device_put(V_pad, shard),
+        w_sh=jax.device_put(jnp.asarray(item_w), shard),
+        n_rows=n_rows, rows_per_shard=rpb, mesh=mesh)
 
 
 @lru_cache(maxsize=32)
@@ -83,46 +128,33 @@ def _mesh_topk_step(mesh: Mesh, k_local: int, k_out: int,
 
 def mesh_top_k_recommend(U, V, user_rows, k: int = 10,
                          train_u=None, train_i=None, chunk: int = 2048,
-                         item_mask=None, mesh: Mesh | None = None):
+                         item_mask=None, mesh: Mesh | None = None,
+                         catalog: ShardedCatalog | None = None):
     """Row-space mesh serving — same contract as
     ``utils.metrics.top_k_recommend`` (inputs are row indices, returns
     ``(top_rows int32 [n, k], top_scores f32 [n, k])``), with the
     catalog sharded over ``mesh`` and scored in parallel.
 
-    V's rows are padded to a mesh-divisible count on the way in (pad
-    rows are masked with -1e30, exactly like phantom catalog rows), so
-    any table height serves on any mesh size.
+    Pass a prebuilt ``catalog`` (``shard_catalog``) to amortize the
+    full-catalog reshard across requests — a serving loop should; with
+    only ``V``/``mesh``/``item_mask`` the catalog is built per call
+    (``V`` may then be padded to a mesh-divisible height internally).
     """
     from large_scale_recommendation_tpu.utils.metrics import (
         _exclusion_builder,
     )
     from large_scale_recommendation_tpu.utils.shapes import pow2_pad
 
-    mesh = mesh or make_block_mesh()
+    if catalog is None:
+        catalog = shard_catalog(V, mesh, item_mask)
+    mesh = catalog.mesh
     n_dev = mesh.shape[BLOCK_AXIS]
+    n_rows, rpb = catalog.n_rows, catalog.rows_per_shard
+    V_sh, w_sh = catalog.V_sh, catalog.w_sh
     user_rows = np.asarray(user_rows)
     n = len(user_rows)
     if n == 0:
         return (np.zeros((0, k), np.int32), np.zeros((0, k), np.float32))
-
-    n_rows = int(V.shape[0])
-    rpb = -(-n_rows // n_dev)
-    item_w = np.zeros(n_dev * rpb, np.float32)
-    if item_mask is not None:
-        item_w[:n_rows][~np.asarray(item_mask)] = -1e30
-    # mesh-padding rows score -inf (below even excluded/-1e30 slots):
-    # they can still surface when k exceeds the real candidate supply,
-    # so their indices are clamped to row 0 after the merge (below) —
-    # the single-device contract (rows are always valid table indices,
-    # dead slots identified by score) must hold on the mesh path too
-    item_w[n_rows:] = -np.inf
-    V_pad = jnp.concatenate(
-        [jnp.asarray(V),
-         jnp.zeros((n_dev * rpb - n_rows, V.shape[1]), jnp.float32)]
-    ) if n_dev * rpb != n_rows else jnp.asarray(V)
-    shard = NamedSharding(mesh, P(BLOCK_AXIS))
-    V_sh = jax.device_put(V_pad, shard)
-    w_sh = jax.device_put(jnp.asarray(item_w), shard)
 
     k_local = min(k, rpb)  # per-shard top_k bound
     k_out = min(k, n_dev * k_local)  # merged width

@@ -121,3 +121,39 @@ def test_pad_rows_with_k_past_catalog_stay_valid():
     real = ids0 >= 0
     np.testing.assert_array_equal(ids == -1, ~real)
     np.testing.assert_allclose(scores[real], scores0[real], rtol=1e-6)
+
+
+def test_prepared_catalog_reused_across_requests():
+    """shard_catalog amortization: the model path builds the sharded
+    catalog once per mesh and reuses it; the prepared-handle call path
+    gives identical results to the build-per-call path."""
+    from large_scale_recommendation_tpu.core.generators import (
+        SyntheticMFGenerator,
+    )
+    from large_scale_recommendation_tpu.models.als import ALS, ALSConfig
+    from large_scale_recommendation_tpu.parallel.serving import (
+        shard_catalog,
+    )
+
+    U, V, tu, ti = _problem(seed=10)
+    mesh = make_block_mesh(4)
+    cat = shard_catalog(V, mesh)
+    r1, s1 = mesh_top_k_recommend(U, V, np.arange(8, dtype=np.int32),
+                                  k=5, chunk=8, mesh=mesh)
+    r2, s2 = mesh_top_k_recommend(U, None, np.arange(8, dtype=np.int32),
+                                  k=5, chunk=8, catalog=cat)
+    np.testing.assert_array_equal(r1, r2)
+    np.testing.assert_allclose(s1, s2)
+
+    gen = SyntheticMFGenerator(num_users=40, num_items=25, rank=3,
+                               noise=0.05, seed=11)
+    train = gen.generate(2000)
+    model = ALS(ALSConfig(num_factors=4, lambda_=0.05,
+                          iterations=3)).fit(train)
+    i1, _ = model.recommend(np.arange(5), k=4, mesh=mesh)
+    cache = model.__dict__["_serving_catalogs"]
+    assert mesh in cache
+    first = cache[mesh]
+    i2, _ = model.recommend(np.arange(5), k=4, mesh=mesh)
+    assert cache[mesh] is first  # reused, not rebuilt
+    np.testing.assert_array_equal(i1, i2)
