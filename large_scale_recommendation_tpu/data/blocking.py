@@ -117,6 +117,40 @@ class BlockedProblem:
     ratings: BlockedRatings
 
 
+def flat_index(ids, omega=None, sorted_pair=None) -> IdIndex:
+    """A row-ordered id vector as a 1-block ``IdIndex`` — the ONE builder
+    for flat (unblocked) vocabularies, shared by the pipeline compactor
+    and streaming snapshots so the 1-block invariants live in one place.
+
+    ``ids[j]`` is row j's external id; ``omega`` defaults to 1 per row
+    (seen-at-least-once); ``sorted_pair`` supplies a precomputed
+    (sorted_ids, sorted_rows) to skip the argsort (growable tables keep
+    it incrementally). An EMPTY vocabulary yields the same shape every
+    other IdIndex producer guarantees: one -1/omega-0 padding row, so
+    downstream factor gathers (predict on a just-constructed model)
+    stay in-bounds and score 0 instead of crashing.
+    """
+    ids = np.asarray(ids, np.int64)
+    n = len(ids)
+    if n == 0:
+        return IdIndex(
+            ids=np.full(1, -1, np.int64), num_blocks=1, rows_per_block=1,
+            omega=np.zeros(1, np.float32),
+            sorted_ids=np.empty(0, np.int64),
+            sorted_rows=np.empty(0, np.int64),
+        )
+    if sorted_pair is None:
+        order = np.argsort(ids).astype(np.int64)
+        sorted_pair = (ids[order], order)
+    return IdIndex(
+        ids=ids, num_blocks=1, rows_per_block=n,
+        omega=(np.ones(n, np.float32) if omega is None
+               else np.asarray(omega, np.float32)),
+        sorted_ids=np.asarray(sorted_pair[0], np.int64),
+        sorted_rows=np.asarray(sorted_pair[1], np.int64),
+    )
+
+
 def build_id_index(
     ids: np.ndarray,
     num_blocks: int,

@@ -209,6 +209,17 @@ class GrowableFactorTable:
             self._sorted_cache = (all_ids[order], order)
         return self._sorted_cache
 
+    def id_array(self) -> np.ndarray:
+        """Registered ids in row order (int64 copy) — the array form of
+        ``ids()``; row j holds ``id_array()[j]``."""
+        return self._ids_buf[:self._n].copy()
+
+    def sorted_index(self) -> tuple[np.ndarray, np.ndarray]:
+        """The (sorted_ids, sorted_rows) pair, from the incrementally
+        maintained cache — snapshot consumers (``OnlineMF.to_model``)
+        reuse it instead of re-sorting the vocabulary."""
+        return self._sorted_index()
+
     def _grow(self, need: int) -> None:
         new_cap = _next_pow2(need)
         pad = jnp.zeros((new_cap - self.capacity, self.rank), jnp.float32)

@@ -292,6 +292,38 @@ class OnlineMF:
 
     # -- export ------------------------------------------------------------
 
+    def to_model(self):
+        """Snapshot the live stream state as a standard ``MFModel``.
+
+        Gives streaming models the full batch-model surface — top-K
+        serving (``recommend``/``recommend_users``, incl. the mesh
+        path), ``ranking_quality``, ``save_mf_model`` persistence — at
+        the documented ``.array`` snapshot-consistency point (the tables
+        are only mutated between ``partial_fit`` calls, so a snapshot
+        between batches is a consistent model; ≙ the reference's
+        factor-RDD materialization, OnlineSpark.scala:205-212).
+
+        Only rows seen so far are exported; predictions for both the
+        snapshot and the live model agree at the snapshot instant
+        (test-pinned). Rows ingested later do not appear — take a new
+        snapshot for a fresher model.
+        """
+        from large_scale_recommendation_tpu.data.blocking import flat_index
+        from large_scale_recommendation_tpu.models.mf import MFModel
+
+        def side(table):
+            n = table.num_rows
+            idx = flat_index(table.id_array(),
+                             sorted_pair=table.sorted_index())
+            F = jnp.asarray(table.array[:n])
+            if n == 0:  # flat_index's 1-row empty-vocab shape needs a
+                F = jnp.zeros((1, table.rank), jnp.float32)  # factor row
+            return F, idx
+
+        U, users = side(self.users)
+        V, items = side(self.items)
+        return MFModel(U=U, V=V, users=users, items=items)
+
     def user_factors(self) -> dict[int, np.ndarray]:
         return self.users.as_dict()
 

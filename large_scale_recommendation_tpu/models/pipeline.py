@@ -60,16 +60,11 @@ class IdCompactor:
 
 def _flat_index(vocab, _inverse, counts) -> "IdIndex":
     """A ``compact_ids`` vocabulary as a 1-block IdIndex: dense id of raw
-    id x = its first-seen position. Reuses IdIndex's guarded vectorized
-    lookup instead of growing a third hand-rolled searchsorted copy."""
-    from large_scale_recommendation_tpu.data.blocking import IdIndex
+    id x = its first-seen position (``blocking.flat_index`` — the one
+    shared builder for flat vocabularies)."""
+    from large_scale_recommendation_tpu.data.blocking import flat_index
 
-    vocab = np.asarray(vocab, np.int64)
-    order = np.argsort(vocab)
-    return IdIndex(ids=vocab, num_blocks=1, rows_per_block=len(vocab),
-                   omega=np.asarray(counts, np.float32),
-                   sorted_ids=vocab[order],
-                   sorted_rows=order.astype(np.int64))
+    return flat_index(vocab, omega=counts)
 
 
 class FittedIdCompactor:

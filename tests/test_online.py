@@ -283,3 +283,71 @@ class TestOnlineMF:
         np.testing.assert_allclose(
             out.user_updates[0].vector.factors,
             np.asarray(init(jnp.asarray([1])))[0], rtol=1e-6)
+
+
+class TestToModel:
+    """OnlineMF.to_model: the streaming state as a standard MFModel —
+    serving/evaluation/persistence for stream-trained factors."""
+
+    def _stream(self, seed=0):
+        gen = SyntheticMFGenerator(num_users=80, num_items=50, rank=4,
+                                   noise=0.05, seed=seed)
+        m = OnlineMF(OnlineMFConfig(num_factors=6, learning_rate=0.1,
+                                    minibatch_size=64))
+        for _ in range(5):
+            m.partial_fit(gen.generate(3000), emit_updates=False)
+        return gen, m
+
+    def test_snapshot_predictions_match_live(self):
+        gen, m = self._stream()
+        model = m.to_model()
+        te = gen.generate(1000)
+        ru, ri, _, _ = te.to_numpy()
+        s_live, seen_live = m.predict(ru, ri, return_mask=True)
+        s_snap, seen_snap = model.predict(ru, ri, return_mask=True)
+        np.testing.assert_array_equal(np.asarray(seen_live),
+                                      np.asarray(seen_snap))
+        np.testing.assert_allclose(np.asarray(s_snap),
+                                   np.asarray(s_live), rtol=1e-6)
+        assert abs(m.rmse(te) - model.rmse(te)) < 1e-6
+
+    def test_snapshot_serves_and_persists(self):
+        import tempfile
+
+        from large_scale_recommendation_tpu.utils.checkpoint import (
+            CheckpointManager,
+            restore_mf_model,
+            save_mf_model,
+        )
+
+        gen, m = self._stream(seed=2)
+        model = m.to_model()
+        # top-K serving from stream-trained factors
+        known_users = np.asarray(sorted(model.users.sorted_ids[:5]))
+        ids, scores = model.recommend(known_users, k=5)
+        assert (ids >= 0).all()
+        assert (np.diff(scores, axis=1) <= 1e-6).all()
+        # persistence round-trip
+        mgr = CheckpointManager(tempfile.mkdtemp())
+        save_mf_model(mgr, model, 1)
+        loaded, _ = restore_mf_model(mgr)
+        te = gen.generate(500)
+        assert abs(loaded.rmse(te) - model.rmse(te)) < 1e-6
+
+    def test_snapshot_is_immutable_under_further_ingest(self):
+        gen, m = self._stream(seed=3)
+        model = m.to_model()
+        U_before = np.asarray(model.U).copy()
+        m.partial_fit(gen.generate(3000), emit_updates=False)
+        np.testing.assert_array_equal(np.asarray(model.U), U_before)
+
+    def test_empty_snapshot_predicts_zero(self):
+        """to_model() before any ingest: the snapshot must score 0 with
+        a false seen-mask, like the live model — not crash on a 0-row
+        factor gather (review-found regression)."""
+        m = OnlineMF(OnlineMFConfig(num_factors=4))
+        model = m.to_model()
+        s, seen = model.predict(np.array([1, 7]), np.array([2, 9]),
+                                return_mask=True)
+        np.testing.assert_array_equal(np.asarray(s), 0.0)
+        assert not np.asarray(seen).any()
