@@ -117,7 +117,8 @@ class BlockedProblem:
     ratings: BlockedRatings
 
 
-def flat_index(ids, omega=None, sorted_pair=None) -> IdIndex:
+def flat_index(ids, omega=None, sorted_pair=None,
+               pad_empty: bool = True) -> IdIndex:
     """A row-ordered id vector as a 1-block ``IdIndex`` — the ONE builder
     for flat (unblocked) vocabularies, shared by the pipeline compactor
     and streaming snapshots so the 1-block invariants live in one place.
@@ -125,17 +126,24 @@ def flat_index(ids, omega=None, sorted_pair=None) -> IdIndex:
     ``ids[j]`` is row j's external id; ``omega`` defaults to 1 per row
     (seen-at-least-once); ``sorted_pair`` supplies a precomputed
     (sorted_ids, sorted_rows) to skip the argsort (growable tables keep
-    it incrementally). An EMPTY vocabulary yields the same shape every
-    other IdIndex producer guarantees: one -1/omega-0 padding row, so
-    downstream factor gathers (predict on a just-constructed model)
-    stay in-bounds and score 0 instead of crashing.
+    it incrementally).
+
+    ``pad_empty`` (default True): an EMPTY vocabulary yields the shape
+    every factor-table producer guarantees — one -1/omega-0 padding row
+    — so downstream factor gathers (predict on a just-constructed model
+    snapshot) stay in-bounds and score 0 instead of crashing. Callers
+    with no factor table behind the index (the pipeline compactor, whose
+    ``num_users`` must honestly read 0 on degenerate input) pass False
+    for a true 0-row index.
     """
     ids = np.asarray(ids, np.int64)
     n = len(ids)
     if n == 0:
+        pad = 1 if pad_empty else 0
         return IdIndex(
-            ids=np.full(1, -1, np.int64), num_blocks=1, rows_per_block=1,
-            omega=np.zeros(1, np.float32),
+            ids=np.full(pad, -1, np.int64), num_blocks=1,
+            rows_per_block=pad,
+            omega=np.zeros(pad, np.float32),
             sorted_ids=np.empty(0, np.int64),
             sorted_rows=np.empty(0, np.int64),
         )

@@ -312,3 +312,10 @@ class AdaptiveMF:
 
     def rmse(self, data: Ratings) -> float:
         return self.online.rmse(data)
+
+    def to_model(self) -> MFModel:
+        """Snapshot the CURRENT serving state (the online tables, which
+        absorb each retrain's wholesale swap) as a standard ``MFModel``
+        — top-K serving / ranking / persistence for the adaptive combo,
+        same contract as ``OnlineMF.to_model``."""
+        return self.online.to_model()

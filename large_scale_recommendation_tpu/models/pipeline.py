@@ -64,7 +64,9 @@ def _flat_index(vocab, _inverse, counts) -> "IdIndex":
     shared builder for flat vocabularies)."""
     from large_scale_recommendation_tpu.data.blocking import flat_index
 
-    return flat_index(vocab, omega=counts)
+    # pad_empty=False: no factor table behind this index, and
+    # num_users/num_items must honestly read 0 on degenerate input
+    return flat_index(vocab, omega=counts, pad_empty=False)
 
 
 class FittedIdCompactor:

@@ -151,3 +151,34 @@ def test_flush_outside_batch_returns_empty_updates():
     assert out.user_updates == [] and out.item_updates == []
     ids, vecs = out.user_arrays
     assert vecs.shape == (0, 4)
+
+
+def test_to_model_snapshot_after_retrain():
+    """AdaptiveMF.to_model: the snapshot serves the post-retrain state
+    (predictions agree with the live combo) through the full MFModel
+    surface."""
+    from large_scale_recommendation_tpu.core.generators import (
+        SyntheticMFGenerator,
+    )
+    from large_scale_recommendation_tpu.models.adaptive import (
+        AdaptiveMF,
+        AdaptiveMFConfig,
+    )
+
+    gen = SyntheticMFGenerator(num_users=60, num_items=40, rank=3,
+                               noise=0.05, seed=15)
+    combo = AdaptiveMF(AdaptiveMFConfig(
+        num_factors=4, learning_rate=0.1, minibatch_size=64,
+        offline_every=3, offline_iterations=3, background=False))
+    for _ in range(5):  # crosses one retrain boundary
+        combo.process(gen.generate(2000))
+    assert combo.retrain_count >= 1
+    snap = combo.to_model()
+    te = gen.generate(800)
+    ru, ri, _, _ = te.to_numpy()
+    s_live = np.asarray(combo.predict(ru, ri))
+    s_snap = np.asarray(snap.predict(ru, ri))
+    np.testing.assert_allclose(s_snap, s_live, rtol=1e-6)
+    ids, _ = snap.recommend(np.asarray(sorted(snap.users.sorted_ids[:4])),
+                            k=5)
+    assert (ids >= 0).all()

@@ -311,9 +311,7 @@ class TestToModel:
                                    np.asarray(s_live), rtol=1e-6)
         assert abs(m.rmse(te) - model.rmse(te)) < 1e-6
 
-    def test_snapshot_serves_and_persists(self):
-        import tempfile
-
+    def test_snapshot_serves_and_persists(self, tmp_path):
         from large_scale_recommendation_tpu.utils.checkpoint import (
             CheckpointManager,
             restore_mf_model,
@@ -328,7 +326,7 @@ class TestToModel:
         assert (ids >= 0).all()
         assert (np.diff(scores, axis=1) <= 1e-6).all()
         # persistence round-trip
-        mgr = CheckpointManager(tempfile.mkdtemp())
+        mgr = CheckpointManager(str(tmp_path))
         save_mf_model(mgr, model, 1)
         loaded, _ = restore_mf_model(mgr)
         te = gen.generate(500)
