@@ -605,6 +605,43 @@ def _extra_lines(extra: dict, rank: int, jax, h2d_mbps: float,
         except Exception as ex:
             extra["serving_error"] = f"{type(ex).__name__}: {ex}"
 
+    # ---- sustained serving: the engine vs the per-call path --------------
+    # The request-stream twin of the line above: many small mixed-size
+    # recommend requests through serving.engine's micro-batcher vs one
+    # mesh_top_k_recommend call per request over the same prebuilt
+    # catalog (scripts/serving_bench.py is the standalone CPU form). The
+    # engine's whole claim — sustained users/s, O(#buckets) compiles —
+    # is measured here on the bench device.
+    if (model_factors is not None
+            and os.environ.get("BENCH_SERVE_ENGINE", "1") == "1"):
+        try:
+            repo = os.path.dirname(os.path.abspath(__file__))
+            if repo not in sys.path:  # scripts/ is a namespace package
+                sys.path.insert(0, repo)
+            from scripts.serving_bench import run as serving_engine_run
+
+            # capped shape: the engine bench measures serving MACHINERY
+            # (dispatch, bucketing, recompiles), and it builds its own
+            # tables — uncapped it would allocate a second headline-size
+            # model (plus catalog + bf16 copies) next to the resident one
+            sr = serving_engine_run(
+                num_users=min(int(model_factors[0].shape[0]), 100_000),
+                num_items=min(int(model_factors[1].shape[0]), 65_536),
+                rank=rank,
+                n_requests=int(os.environ.get("BENCH_SERVE_REQUESTS", 256)),
+                req_max=int(os.environ.get("BENCH_SERVE_REQ_MAX", 64)),
+                n_dev=1)
+            se = sr["extra"]
+            extra["serving_engine_users_per_s"] = se["engine_users_per_s"]
+            extra["serving_engine_bf16_users_per_s"] = (
+                se["engine_bf16_users_per_s"])
+            extra["serving_percall_users_per_s"] = se["percall_users_per_s"]
+            extra["serving_engine_vs_percall"] = sr["vs_baseline"]
+            extra["serving_engine_executable_variants"] = (
+                se["engine_executable_variants"])
+        except Exception as ex:
+            extra["serving_engine_error"] = f"{type(ex).__name__}: {ex}"
+
     # ---- ALS: bucketed-matmul normal equations, all on device ------------
     als_nnz = int(os.environ.get("BENCH_ALS_NNZ", 2_000_000))
     # vocab overrides flow through (the fallback runs THESE extras at its

@@ -31,6 +31,9 @@ Packages:
               PS-mode)
     parallel  device-mesh utilities, shard_map DSGD, collectives,
               multi-host bring-up + on-mesh global blocking
+    serving   the request-facing engine layer: micro-batched top-K over
+              versioned sharded catalogs (serving.ServingEngine;
+              docs/SERVING.md)
     data      blocking/ingest — host path (arbitrary ids, native kernels)
               AND the on-device pipeline (data.device_blocking: blocking
               as XLA sort/scan/scatter; DSGD.fit_device / MeshDSGD

@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import weakref
 from typing import Iterable, Iterator, Literal
 
 import numpy as np
@@ -101,6 +102,12 @@ class AdaptiveMF:
         self._thread: threading.Thread | None = None
         self._retrained: MFModel | None = None
         self._buffer: list[Ratings] = []
+        self._engines: "weakref.WeakSet" = weakref.WeakSet()
+        # guards snapshot+register vs. a swap landing in between — an
+        # engine built from a pre-swap snapshot but registered after the
+        # swap's refresh sweep would serve stale factors until the NEXT
+        # swap
+        self._engines_lock = threading.Lock()
         self._manager = None
         if cfg.checkpoint_dir is not None:
             from large_scale_recommendation_tpu.utils.checkpoint import (
@@ -280,6 +287,41 @@ class AdaptiveMF:
             table.array = table.array.at[jnp.asarray(rows)].set(
                 jnp.asarray(T[real])
             )
+        # the swap is only COMPLETE once the serving layer sees it:
+        # every live engine rebinds to a fresh snapshot (new catalog
+        # version, O(1), no recompile — serving.engine.refresh). The
+        # registry lock covers only the membership read: refresh()
+        # acquires each engine's own lock, and holding the registry
+        # lock across that would deadlock against an engine mid-serve
+        # whose creator thread is waiting to register a sibling
+        with self._engines_lock:
+            engines = tuple(self._engines)
+        snapshot = self.to_model() if engines else None
+        for engine in engines:
+            engine.refresh(snapshot)
+
+    def serving_engine(self, k: int = 10, **kwargs):
+        """A ``ServingEngine`` bound to the CURRENT serving snapshot
+        (``to_model``) that stays bound: every retrain swap
+        (``_install``) refreshes it in place, so the engine's catalog
+        version tracks the adaptive model's swaps automatically —
+        serving a stream while the model retrains needs no manual
+        refresh choreography. ``kwargs`` pass through to the engine
+        (``mesh``, ``dtype``, ``train``, ``max_batch`` ...).
+
+        Note: only the periodic *swap* auto-refreshes; per-micro-batch
+        online updates are folded in at the next swap or by calling
+        ``engine.refresh(adaptive.to_model())`` yourself.
+        """
+        from large_scale_recommendation_tpu.serving.engine import (
+            ServingEngine,
+        )
+
+        with self._engines_lock:  # snapshot+register atomically vs. a
+            # concurrent swap's refresh sweep
+            engine = ServingEngine(self.to_model(), k=k, **kwargs)
+            self._engines.add(engine)
+        return engine
 
     # -- history ------------------------------------------------------------
 

@@ -20,6 +20,7 @@ import numpy as np
 from large_scale_recommendation_tpu.core.types import FactorVector, Ratings
 from large_scale_recommendation_tpu.data.blocking import IdIndex
 from large_scale_recommendation_tpu.ops import sgd as sgd_ops
+from large_scale_recommendation_tpu.utils.metrics import DEAD_SLOT_THRESHOLD
 
 
 def masked_scores(scores, u_mask, i_mask, return_mask: bool):
@@ -38,11 +39,11 @@ def _assemble_topk(n: int, k: int, known, top_rows, top_scores,
 
     Row-space top-K → external ids with the ``predict`` conventions:
     unknown queries get -1/0.0 rows; below-catalog slots (the kernels
-    mark excluded/masked rows with scores ≤ -1e30 — one sentinel
-    contract with ``utils.metrics``) become -1/0.0 too."""
+    push excluded/masked rows below ``DEAD_SLOT_THRESHOLD`` — one
+    sentinel contract with ``utils.metrics``) become -1/0.0 too."""
     ids = np.full((n, k), -1, np.int64)
     scores = np.zeros((n, k), np.float32)
-    real = top_scores > -1e29
+    real = top_scores > DEAD_SLOT_THRESHOLD
     ids[known] = np.where(real, ids_of_row[top_rows], -1)
     scores[known] = np.where(real, top_scores, 0.0)
     if return_mask:
@@ -228,20 +229,25 @@ class MFModel:
         item_ids_of_row = np.asarray(self.items.ids)
         if mesh is not None:
             from large_scale_recommendation_tpu.parallel.serving import (
+                catalog_version,
                 mesh_top_k_recommend,
                 shard_catalog,
             )
 
             # the sharded catalog is per-(model, mesh) state — build it
             # once and reuse across requests (a serving loop's whole
-            # point); the factors are fit-time-frozen on this surface
+            # point). The cached build is version-checked against the
+            # LIVE V: reassigning model.V (a retrain swap) invalidates
+            # it, so this surface can never serve stale factors while
+            # recommend() serves fresh ones.
             cache = self.__dict__.setdefault("_serving_catalogs", {})
-            if mesh not in cache:
-                cache[mesh] = shard_catalog(
+            cat = cache.get(mesh)
+            if cat is None or cat.version != catalog_version(self.V):
+                cat = cache[mesh] = shard_catalog(
                     self.V, mesh, item_mask=item_ids_of_row >= 0)
             top_rows, top_scores = mesh_top_k_recommend(
                 self.U, None, u_rows[known], k=k, train_u=tu,
-                train_i=ti, chunk=chunk, catalog=cache[mesh])
+                train_i=ti, chunk=chunk, catalog=cat)
         else:
             top_rows, top_scores = top_k_recommend(
                 self.U, self.V, u_rows[known], k=k, train_u=tu,

@@ -353,8 +353,11 @@ def pallas_block_sweep(
     # composes with shard_map under check_vma (the mesh kernel="pallas"
     # route); outside shard_map this is the empty set
     def out(a):
-        return jax.ShapeDtypeStruct(
-            a.shape, jnp.float32, vma=getattr(jax.typeof(a), "vma", None))
+        typeof = getattr(jax, "typeof", None)  # jax < 0.6 has no typeof
+        vma = getattr(typeof(a), "vma", None) if typeof else None
+        if vma is None:  # older jax: ShapeDtypeStruct has no vma kwarg
+            return jax.ShapeDtypeStruct(a.shape, jnp.float32)
+        return jax.ShapeDtypeStruct(a.shape, jnp.float32, vma=vma)
 
     return pl.pallas_call(
         kernel,

@@ -27,7 +27,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
 
 from large_scale_recommendation_tpu.core.types import Ratings
 from large_scale_recommendation_tpu.data import blocking
@@ -37,6 +36,7 @@ from large_scale_recommendation_tpu.ops import als as als_ops
 from large_scale_recommendation_tpu.parallel.mesh import (
     BLOCK_AXIS,
     make_block_mesh,
+    shard_map,
 )
 
 
@@ -82,9 +82,11 @@ def build_mesh_als_step(
 
         def varying_zeros(shape):
             # fresh accumulators marked device-varying so the VMA check can
-            # verify the per-shard writes into them
-            return jax.lax.pcast(jnp.zeros(shape, jnp.float32),
-                                 BLOCK_AXIS, to="varying")
+            # verify the per-shard writes into them (older jax has no VMA
+            # type system — nothing to annotate, the zeros pass through)
+            z = jnp.zeros(shape, jnp.float32)
+            pcast = getattr(jax.lax, "pcast", None)
+            return pcast(z, BLOCK_AXIS, to="varying") if pcast else z
 
         def full_gram(F):
             # the shared iALS VᵀV term — the gathered table is replicated,

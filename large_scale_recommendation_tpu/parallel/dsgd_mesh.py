@@ -36,7 +36,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
 
 from large_scale_recommendation_tpu.core.types import Ratings
 from large_scale_recommendation_tpu.data import blocking
@@ -47,6 +46,7 @@ from large_scale_recommendation_tpu.parallel.mesh import (
     block_sharding,
     make_block_mesh,
     ring_backward,
+    shard_map,
 )
 
 

@@ -15,6 +15,24 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+try:  # jax ≥ 0.6 exports shard_map at the top level (check_vma kwarg)
+    from jax import shard_map
+except ImportError:  # older jax: the experimental home, check_rep kwarg
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, /, *args, check_vma=None, **kwargs):
+        """Compat wrapper: the experimental shard_map spells the
+        replication-check knob ``check_rep``; translate the modern name
+        so call sites are written once against the current API."""
+        if check_vma is not None:
+            kwargs.setdefault("check_rep", check_vma)
+        return _shard_map(f, *args, **kwargs)
+
+__all__ = [
+    "BLOCK_AXIS", "shard_map", "make_block_mesh", "block_sharding",
+    "replicated", "ring_backward",
+]
+
 BLOCK_AXIS = "blocks"
 
 

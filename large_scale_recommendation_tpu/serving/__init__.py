@@ -1,0 +1,12 @@
+"""High-throughput serving: the request-facing engine layer.
+
+``parallel.serving`` is the *mechanism* — one mesh-sharded scoring step
+over a prepared catalog. This package is the *engine* around it: request
+micro-batching into pow2 buckets (bounded executable family), versioned
+catalog refresh after retrains, opt-in bf16 catalogs, and sustained-
+throughput accounting. See ``serving.engine.ServingEngine``.
+"""
+
+from large_scale_recommendation_tpu.serving.engine import ServingEngine
+
+__all__ = ["ServingEngine"]

@@ -26,6 +26,18 @@ from typing import Any, Iterator
 
 logger = logging.getLogger("large_scale_recommendation_tpu")
 
+# The top-K dead-slot sentinel contract, shared by every scoring surface
+# (``top_k_recommend`` / ``ranking_metrics`` here, the mesh path in
+# ``parallel.serving``, and id-space assembly in ``models.mf``):
+# excluded/masked catalog slots have ``DEAD_SLOT_OFFSET`` scatter-min'ed
+# onto their scores, so a surfaced dead slot carries ``dot + OFFSET``
+# — not exactly the offset. Consumers therefore classify by
+# ``score > DEAD_SLOT_THRESHOLD`` (one decade above the offset), which is
+# exact for any model with |U·V| < 9e29. ONE definition, imported
+# everywhere, so the contract cannot drift between surfaces.
+DEAD_SLOT_OFFSET = -1e30
+DEAD_SLOT_THRESHOLD = -1e29
+
 
 def block(x: Any) -> Any:
     """Block until device work producing ``x`` (array or pytree) finishes."""
@@ -186,7 +198,8 @@ def _exclusion_builder(train_u, train_i, num_users: int):
         excl_rows = np.zeros(ep, np.int32)
         excl_cols = np.zeros(ep, np.int32)
         excl_w = np.full(ep, np.inf, np.float32)  # pads: min() no-ops
-        excl_rows[:e], excl_cols[:e], excl_w[:e] = rows, cols, -1e30
+        excl_rows[:e], excl_cols[:e], excl_w[:e] = (
+            rows, cols, DEAD_SLOT_OFFSET)
         return excl_rows, excl_cols, excl_w
 
     return build
@@ -233,7 +246,7 @@ def ranking_metrics(U, V, eval_u, eval_i, k: int = 10,
     kern = _rank_kernel()
     item_w = np.zeros(int(V.shape[0]), np.float32)
     if item_mask is not None:
-        item_w[~np.asarray(item_mask)] = -1e30
+        item_w[~np.asarray(item_mask)] = DEAD_SLOT_OFFSET
     chunk = min(chunk, pow2_pad(n))
     hits = ndcg = 0.0
     for c0 in range(0, n, chunk):
@@ -287,8 +300,8 @@ def top_k_recommend(U, V, user_rows, k: int = 10,
     Inputs are ROW indices into ``U``/``V``; returns
     ``(top_rows int32 [n, k], top_scores float32 [n, k])`` sorted by
     descending score. Excluded/masked slots that still surface (k larger
-    than the effective catalog) carry scores ≤ -1e30 — callers drop them
-    by score sign.
+    than the effective catalog) carry scores below ``DEAD_SLOT_THRESHOLD``
+    — callers drop them by score.
     """
     import numpy as np
 
@@ -302,7 +315,7 @@ def top_k_recommend(U, V, user_rows, k: int = 10,
     kern = _topk_kernel()
     item_w = np.zeros(int(V.shape[0]), np.float32)
     if item_mask is not None:
-        item_w[~np.asarray(item_mask)] = -1e30
+        item_w[~np.asarray(item_mask)] = DEAD_SLOT_OFFSET
     chunk = min(chunk, pow2_pad(n))
     # top_k demands k ≤ n_items; serve the clamped prefix and pad the
     # remainder as below-catalog slots (score -inf → callers drop them)

@@ -25,6 +25,18 @@ def pow2_pad(n: int, floor: int = 8) -> int:
     return max(floor, next_pow2(n))
 
 
+def pow2_buckets(floor: int = 8, cap: int = 1024) -> tuple[int, ...]:
+    """The full bucket family a [floor, cap] pow2 policy can produce —
+    the static shape set a serving loop compiles against (its size, not
+    the request count, bounds the number of compiled executables)."""
+    out = []
+    b = pow2_pad(floor, floor)  # the caller's floor, rounded up to pow2
+    while b <= cap:
+        out.append(b)
+        b <<= 1
+    return tuple(out)
+
+
 def pad_axis0_pow2(a, floor: int = 8):
     """Zero-pad a numpy array's leading axis to its pow2 bucket — the
     allocate/copy-prefix idiom every host→jit seam repeats, centralized

@@ -1,0 +1,169 @@
+"""Sustained-serving micro-bench: ServingEngine vs the per-call mesh path.
+
+The serving acceptance pin, CPU-measurable and repeatable: a stream of
+mixed-size recommend requests (the "millions of users" shape — many
+small queries, not one big batch) served two ways over the SAME prebuilt
+sharded catalog:
+
+- **per-call**: one ``mesh_top_k_recommend`` invocation per request —
+  what a naive service loop around ``MFModel.recommend(mesh=...)`` does.
+  Each request pays its own dispatch, exclusion build, and a
+  request-sized (pow2-padded) kernel call that leaves the matmul units
+  mostly idle.
+- **engine**: ``ServingEngine.serve`` — requests coalesce into
+  ``max_batch``-row micro-batches from a bounded pow2 bucket family, so
+  the dispatch count collapses and every kernel call runs at a
+  throughput-shaped batch size. A bf16-catalog pass rides along.
+
+Contract: the LAST stdout line is one JSON object
+``{"metric", "value", "unit", "vs_baseline", "extra"}`` — ``value`` is
+engine users/s, ``vs_baseline`` is the engine/per-call speedup
+(the acceptance bar is ≥ 1.5). ``extra`` carries both raw rates, the
+compiled-executable count (O(#buckets) evidence), and the workload knobs.
+
+Env knobs: SERVE_USERS, SERVE_ITEMS, SERVE_RANK, SERVE_REQUESTS,
+SERVE_REQ_MAX (request sizes are uniform in [1, SERVE_REQ_MAX]),
+SERVE_K, SERVE_MAX_BATCH, SERVE_DEVICES (virtual CPU mesh width),
+SERVE_FORCE_CPU (=0 to use the default jax backend).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_model(num_users: int, num_items: int, rank: int, seed: int = 0):
+    """A seeded random-factor MFModel with identity id maps — serving
+    cost does not depend on how the factors were fit."""
+    import jax.numpy as jnp
+
+    from large_scale_recommendation_tpu.data.blocking import flat_index
+    from large_scale_recommendation_tpu.models.mf import MFModel
+
+    rng = np.random.default_rng(seed)
+    return MFModel(
+        U=jnp.asarray(rng.normal(size=(num_users, rank)).astype(np.float32)),
+        V=jnp.asarray(rng.normal(size=(num_items, rank)).astype(np.float32)),
+        users=flat_index(np.arange(num_users, dtype=np.int64)),
+        items=flat_index(np.arange(num_items, dtype=np.int64)),
+    )
+
+
+def run(num_users=20_000, num_items=8_192, rank=64, n_requests=400,
+        req_max=64, k=10, max_batch=1024, n_dev=None, seed=0) -> dict:
+    import jax
+
+    from large_scale_recommendation_tpu.models.mf import MFModel  # noqa: F401
+    from large_scale_recommendation_tpu.parallel.mesh import make_block_mesh
+    from large_scale_recommendation_tpu.parallel.serving import (
+        mesh_top_k_recommend,
+        shard_catalog,
+    )
+    from large_scale_recommendation_tpu.serving.engine import ServingEngine
+
+    model = build_model(num_users, num_items, rank, seed)
+    mesh = make_block_mesh(n_dev)
+    rng = np.random.default_rng(seed + 1)
+    requests = [
+        rng.integers(0, num_users, int(sz)).astype(np.int64)
+        for sz in rng.integers(1, req_max + 1, n_requests)
+    ]
+    total_rows = sum(len(r) for r in requests)
+    extra = {
+        "device": str(jax.devices()[0]), "mesh_devices": len(mesh.devices),
+        "catalog_rows": num_items, "num_users": num_users, "rank": rank,
+        "requests": n_requests, "request_rows": total_rows,
+        "req_size_max": req_max, "k": k, "max_batch": max_batch,
+    }
+
+    # ---- engine path FIRST: its executable-variant count must be its
+    # own (the per-call baseline shares the per-mesh step cache, so
+    # running it first would misattribute baseline compiles to the
+    # engine) — and any shape the engine leaves warm only HELPS the
+    # baseline below, keeping the reported speedup conservative
+    engine = ServingEngine(model, k=k, mesh=mesh, max_batch=max_batch)
+    engine.serve(requests[:4])  # warm the bucket family's hot entries
+    # the published micro-batch/bucket evidence must describe the TIMED
+    # run only — clear the warm-up's counters
+    engine.stats.update(requests=0, rows=0, microbatches=0, buckets={})
+    t0 = time.perf_counter()
+    engine.serve(requests)
+    engine_wall = time.perf_counter() - t0
+    extra["engine_users_per_s"] = round(total_rows / engine_wall, 1)
+    extra["engine_wall_s"] = round(engine_wall, 3)
+    extra["engine_executable_variants"] = engine.executable_variants
+    extra["engine_bucket_family_size"] = len(engine.bucket_family)
+    extra["engine_microbatches"] = engine.stats["microbatches"]
+    extra["engine_bucket_histogram"] = {
+        str(b): c for b, c in sorted(engine.stats["buckets"].items())}
+
+    # ---- per-call path: one mesh_top_k_recommend per request ----------
+    # over a PREBUILT catalog and a device-RESIDENT U (what
+    # model.recommend(mesh=...) holds), with every request-size bucket
+    # pre-warmed — the strongest per-call baseline: its remaining cost
+    # is per-request dispatch + undersized kernel calls, which is
+    # exactly the overhead the engine claims to remove
+    import jax.numpy as jnp
+
+    catalog = shard_catalog(np.asarray(model.V), mesh)
+    U = jnp.asarray(model.U)
+    from large_scale_recommendation_tpu.utils.shapes import pow2_pad
+
+    warm_sizes = sorted({min(pow2_pad(len(r)), 2048) for r in requests})
+    for ws in warm_sizes:
+        mesh_top_k_recommend(U, None, np.zeros(ws, np.int64), k=k,
+                             catalog=catalog)
+    t0 = time.perf_counter()
+    for r in requests:
+        mesh_top_k_recommend(U, None, r, k=k, catalog=catalog)
+    percall_wall = time.perf_counter() - t0
+    extra["percall_users_per_s"] = round(total_rows / percall_wall, 1)
+    extra["percall_wall_s"] = round(percall_wall, 3)
+
+    # ---- bf16 catalog rides along -------------------------------------
+    bf16 = ServingEngine(model, k=k, mesh=mesh, max_batch=max_batch,
+                         dtype="bfloat16")
+    bf16.serve(requests[:4])
+    t0 = time.perf_counter()
+    bf16.serve(requests)
+    extra["engine_bf16_users_per_s"] = round(
+        total_rows / (time.perf_counter() - t0), 1)
+
+    speedup = percall_wall / engine_wall
+    return {
+        "metric": (f"sustained serving users/s (engine vs per-call mesh "
+                   f"path, {num_users}x{num_items} rank={rank}, "
+                   f"{n_requests} requests ≤{req_max} users)"),
+        "value": extra["engine_users_per_s"],
+        "unit": "users/s",
+        "vs_baseline": round(speedup, 2),
+        "extra": extra,
+    }
+
+
+def main() -> None:
+    if os.environ.get("SERVE_FORCE_CPU", "1") == "1":
+        from large_scale_recommendation_tpu.utils.platform import force_cpu
+
+        force_cpu(n_devices=int(os.environ.get("SERVE_DEVICES", 8)))
+    result = run(
+        num_users=int(os.environ.get("SERVE_USERS", 20_000)),
+        num_items=int(os.environ.get("SERVE_ITEMS", 8_192)),
+        rank=int(os.environ.get("SERVE_RANK", 64)),
+        n_requests=int(os.environ.get("SERVE_REQUESTS", 400)),
+        req_max=int(os.environ.get("SERVE_REQ_MAX", 64)),
+        k=int(os.environ.get("SERVE_K", 10)),
+        max_batch=int(os.environ.get("SERVE_MAX_BATCH", 1024)),
+    )
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

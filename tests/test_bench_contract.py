@@ -65,6 +65,45 @@ def test_cpu_fallback_config_is_in_recoverable_regime():
     assert 0.1 < float(cfg["BENCH_RMSE_TARGET"]) < 0.27
 
 
+def test_serving_bench_emits_contract_json():
+    """The sustained-serving line's contract: scripts/serving_bench.py
+    emits one JSON line with the standard fields, users/s unit, the
+    engine-vs-per-call speedup as vs_baseline, and the engine evidence
+    keys (rates, bf16 rate, executable-variant count) in extra — the
+    same keys bench.py's serving_engine_* extras are built from."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "SERVE_USERS": "2000",
+        "SERVE_ITEMS": "1024",
+        "SERVE_RANK": "16",
+        "SERVE_REQUESTS": "40",
+        "SERVE_DEVICES": "4",
+        "SERVE_MAX_BATCH": "256",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "serving_bench.py")],
+        env=env, capture_output=True, text=True, timeout=600, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    d = json.loads(lines[-1])
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in d, f"missing {key}"
+    assert d["unit"] == "users/s"
+    assert d["value"] > 0
+    e = d["extra"]
+    for key in ("engine_users_per_s", "percall_users_per_s",
+                "engine_bf16_users_per_s", "engine_executable_variants",
+                "engine_microbatches", "engine_bucket_histogram",
+                "mesh_devices", "request_rows"):
+        assert key in e, f"missing extra.{key}"
+    # the compile-count contract: the executable family is the pow2
+    # bucket family (here ≤ {8..256} = 6 shapes), not the request count
+    assert 0 < e["engine_executable_variants"] <= 6
+    assert e["engine_microbatches"] < int(env["SERVE_REQUESTS"])
+
+
 @pytest.mark.slow
 def test_bench_kernel_knob_routes_pallas():
     """BENCH_KERNEL=pallas drives the headline through the model layer's
