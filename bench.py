@@ -853,6 +853,37 @@ def _extra_lines(extra: dict, rank: int, jax, h2d_mbps: float,
     extra["online_updates_ratings_per_s"] = round(up_bs / wall, 1)
     extra["online_updates_rows_emitted"] = n_up
 
+    # ---- durable streaming ingest: log→queue→online_train ----------------
+    # The streams/ runtime's number: the SAME online micro-batch stream as
+    # above, but through the durable path (event-log appends, offset-
+    # stamped tail reads, bounded queue, per-batch WAL-offset checkpoints
+    # — scripts/streams_bench.py is the standalone form). vs_bare is the
+    # throughput retention of durability; lag 0 at exit means the driver
+    # kept up with the log end-to-end.
+    if os.environ.get("BENCH_STREAMS", "1") == "1":
+        try:
+            repo = os.path.dirname(os.path.abspath(__file__))
+            if repo not in sys.path:  # scripts/ is a namespace package
+                sys.path.insert(0, repo)
+            from scripts.streams_bench import run as streams_bench_run
+
+            st = streams_bench_run(
+                num_users=20_000, num_items=5_000, rank=rank,
+                n_batches=int(os.environ.get("BENCH_STREAMS_BATCHES", 8)),
+                batch_records=int(os.environ.get("BENCH_STREAMS_BATCH",
+                                                 50_000)))
+            se = st["extra"]
+            extra["streams_ingest_ratings_per_s"] = (
+                se["ingest_ratings_per_s"])
+            extra["streams_ingest_vs_bare"] = st["vs_baseline"]
+            extra["streams_log_append_ratings_per_s"] = (
+                se["log_append_ratings_per_s"])
+            extra["streams_ingest_lag_records"] = se["ingest_lag_records"]
+            extra["streams_ingest_checkpoints"] = (
+                se["checkpoints_written"])
+        except Exception as ex:
+            extra["streams_ingest_error"] = f"{type(ex).__name__}: {ex}"
+
     # ---- PS-mode offline throughput --------------------------------------
     from large_scale_recommendation_tpu.ps.mf import (
         PSOfflineMF,

@@ -1,0 +1,98 @@
+"""Runnable demo: durable ingest → crash → resume → retrain → serve.
+
+A synthetic rating stream is made durable through the partitioned event
+log, driven into an ``AdaptiveMF`` by the ``StreamingDriver``, killed
+mid-stream, and restarted from the checkpointed WAL offset — watch the
+resume pick up exactly where the crash left off, the replayed tail stay
+bounded to one micro-batch, and the post-restart retrain land in the
+serving engine as a fresh catalog version. docs/STREAMING.md is the
+narrative version.
+
+Run: python examples/streaming_demo.py
+"""
+
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from large_scale_recommendation_tpu.core.generators import (  # noqa: E402
+    SyntheticMFGenerator,
+)
+from large_scale_recommendation_tpu.models.adaptive import (  # noqa: E402
+    AdaptiveMF,
+    AdaptiveMFConfig,
+)
+from large_scale_recommendation_tpu.streams import (  # noqa: E402
+    EventLog,
+    GeneratorSource,
+    StreamingDriver,
+    StreamingDriverConfig,
+    pump_to_log,
+)
+
+
+def make_model():
+    return AdaptiveMF(AdaptiveMFConfig(
+        num_factors=8, minibatch_size=256, offline_every=4,
+        offline_iterations=3))
+
+
+class SimulatedCrash(RuntimeError):
+    pass
+
+
+def main():
+    root = tempfile.mkdtemp(prefix="streaming_demo_")
+    log_dir, ckpt_dir = os.path.join(root, "log"), os.path.join(root, "ckpt")
+
+    # ---- produce: make the stream durable first ------------------------
+    log = EventLog(log_dir, segment_records=4096)
+    gen = SyntheticMFGenerator(num_users=800, num_items=300, rank=8,
+                               noise=0.1, seed=0, skew_lam=2.0)
+    n = pump_to_log(GeneratorSource(gen, batch_records=1000,
+                                    num_batches=12), log)
+    print(f"log: {n} ratings appended, end offset {log.end_offset(0)}")
+
+    # ---- drive, and kill the driver mid-stream -------------------------
+    cfg = StreamingDriverConfig(batch_records=1000)
+
+    def crash_at_5(batch):
+        if batch.end_offset >= 5000:
+            raise SimulatedCrash(f"killed after batch ending at "
+                                 f"{batch.end_offset}")
+
+    d1 = StreamingDriver(make_model(), log, ckpt_dir, config=cfg,
+                         on_batch=crash_at_5)
+    try:
+        d1.run()
+    except SimulatedCrash as ex:
+        print(f"crash: {ex} — its checkpoint never landed, so the "
+              "restart below replays that one batch (and nothing more)")
+
+    # ---- restart: a fresh process would do exactly this ----------------
+    model = make_model()
+    d2 = StreamingDriver(model, log, ckpt_dir, config=cfg)
+    resumed = d2.resume()
+    print(f"resume: restored={resumed}, replay from offset "
+          f"{d2.consumed_offset} "
+          f"(lag {log.lag({0: d2.consumed_offset})} records)")
+
+    engine = d2.serving_engine(k=5)
+    v0 = engine.version
+    d2.run()  # replays the unacked batch + the tail; retrains en route
+    tele = d2.telemetry()
+    print(f"caught up: offset {tele['consumed_offset']}, lag "
+          f"{tele['lag_records']}, {tele['checkpoints_written']} "
+          f"checkpoints, {model.retrain_count} retrains")
+    print(f"serving: catalog v{v0} -> v{engine.version} "
+          f"(swaps observed: {tele['catalog_versions']})")
+
+    ids, scores = engine.recommend([1, 2, 3])
+    print(f"user 1 top-5 items: {ids[0].tolist()}")
+    log.close()
+
+
+if __name__ == "__main__":
+    main()

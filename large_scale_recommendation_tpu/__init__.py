@@ -34,6 +34,10 @@ Packages:
     serving   the request-facing engine layer: micro-batched top-K over
               versioned sharded catalogs (serving.ServingEngine;
               docs/SERVING.md)
+    streams   durable ingest runtime: partitioned event-log WAL,
+              backpressure sources with dead-letter/poison quarantine,
+              crash-recovering StreamingDriver with WAL-offset
+              checkpoints (streams.StreamingDriver; docs/STREAMING.md)
     data      blocking/ingest — host path (arbitrary ids, native kernels)
               AND the on-device pipeline (data.device_blocking: blocking
               as XLA sort/scan/scatter; DSGD.fit_device / MeshDSGD

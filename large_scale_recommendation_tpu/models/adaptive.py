@@ -101,7 +101,9 @@ class AdaptiveMF:
         self._state = "Online"  # "Online" | "Batch"
         self._thread: threading.Thread | None = None
         self._retrained: MFModel | None = None
-        self._buffer: list[Ratings] = []
+        # (batch, offset-stamp) pairs queued while a background retrain
+        # runs (≙ onlinePullQueue)
+        self._buffer: list[tuple[Ratings, tuple[int, int] | None]] = []
         self._engines: "weakref.WeakSet" = weakref.WeakSet()
         # guards snapshot+register vs. a swap landing in between — an
         # engine built from a pre-swap snapshot but registered after the
@@ -125,11 +127,17 @@ class AdaptiveMF:
 
     # -- ingest ------------------------------------------------------------
 
-    def process(self, batch: Ratings) -> BatchUpdates:
+    def process(self, batch: Ratings,
+                offset: tuple[int, int] | None = None) -> BatchUpdates:
         """One micro-batch through the adaptive pipeline.
 
         ≙ one ``transform`` body (OnlineSpark.scala:55-158): history ∪= batch,
         online update, counters; retrain + swap when due.
+
+        ``offset=(partition, end_offset)`` is the stream-position stamp
+        (``OnlineMF.partial_fit``); batches buffered during a background
+        retrain keep their stamps and apply them in replay order, so the
+        checkpointed offset never claims a buffered-but-unapplied batch.
         """
         cfg = self.config
         self._append_history(batch)
@@ -137,16 +145,16 @@ class AdaptiveMF:
         if self._state == "Batch":
             if self._thread is not None and self._thread.is_alive():
                 # ≙ enqueue to onlinePullQueue (PSOfflineOnlineMF.scala:142)
-                self._buffer.append(batch)
+                self._buffer.append((batch, offset))
                 return BatchUpdates([], [], rank=cfg.num_factors)
             # retrain finished: swap + replay the queue
             updates = self._finish_batch()
-            more = self.online.partial_fit(batch)
+            more = self.online.partial_fit(batch, offset=offset)
             return BatchUpdates(updates.user_updates + more.user_updates,
                                 updates.item_updates + more.item_updates,
                                 rank=cfg.num_factors)
 
-        out = self.online.partial_fit(batch)
+        out = self.online.partial_fit(batch, offset=offset)
         self._batches_since_retrain += 1
         self._maybe_checkpoint()
         if (cfg.offline_every is not None
@@ -262,8 +270,8 @@ class AdaptiveMF:
         buffered, self._buffer = self._buffer, []
         users: list = []
         items: list = []
-        for b in buffered:  # ≙ fold onlinePullQueue into rs and resume
-            out = self.online.partial_fit(b)
+        for b, off in buffered:  # ≙ fold onlinePullQueue into rs and resume
+            out = self.online.partial_fit(b, offset=off)
             users.extend(out.user_updates)
             items.extend(out.item_updates)
         return BatchUpdates(users, items, rank=self.config.num_factors)

@@ -169,6 +169,13 @@ class OnlineMF:
         self.users = GrowableFactorTable(init_u, capacity=cfg.init_capacity)
         self.items = GrowableFactorTable(init_v, capacity=cfg.init_capacity)
         self.step = 0
+        # WAL position of the stream this model has consumed, per
+        # partition: {partition: next_unconsumed_offset}. Stamped by
+        # ``partial_fit(offset=...)`` (the streams/driver.py ingest
+        # path) and persisted WITH (U, V, step) by
+        # ``utils.checkpoint.save_online_state`` — the pair is what
+        # makes a restart replay exactly the unconsumed log tail.
+        self.consumed_offsets: dict[int, int] = {}
         # reusable padding buffers keyed by padded length (bounded: padded
         # lengths are pow2 buckets of the minibatch)
         self._pad_buffers: dict[int, tuple] = {}
@@ -177,7 +184,9 @@ class OnlineMF:
 
     def partial_fit(self, batch: Ratings,
                     iterations: int | None = None,
-                    emit_updates: bool = True) -> BatchUpdates | None:
+                    emit_updates: bool = True,
+                    offset: tuple[int, int] | None = None,
+                    ) -> BatchUpdates | None:
         """Apply one micro-batch; return the touched vectors (updates-only).
 
         ≙ one ``transform`` body of ``buildModelWithMap``
@@ -189,12 +198,20 @@ class OnlineMF:
         instead (``self.users.array`` / ``self.items.array`` snapshots).
         The per-batch device→host row pull is the dominant cost of a
         high-rate stream on narrow host links; polling amortizes it.
+
+        ``offset=(partition, end_offset)`` stamps the batch's stream
+        position into ``consumed_offsets`` — the hook the durable ingest
+        driver (``streams/driver.py``) checkpoints through. Recorded
+        even for an all-padding batch: the stream position advanced
+        regardless of how many real ratings the slice held.
         """
         cfg = self.config
         ru, ri, rv, rw = batch.to_numpy()
         real = rw > 0
         ru, ri, rv = ru[real], ri[real], rv[real]
         if len(ru) == 0:
+            if offset is not None:  # position advanced even when empty
+                self.consumed_offsets[int(offset[0])] = int(offset[1])
             return (BatchUpdates([], [], rank=cfg.num_factors)
                     if emit_updates else None)
 
@@ -219,6 +236,11 @@ class OnlineMF:
         self.users.array = U
         self.items.array = V
         self.step += 1
+        if offset is not None:
+            # stamped only now, with the update APPLIED: an offset in
+            # consumed_offsets always means "this slice is in the
+            # tables", the invariant the checkpoint contract rests on
+            self.consumed_offsets[int(offset[0])] = int(offset[1])
         if not emit_updates:
             return None
 

@@ -109,6 +109,12 @@ class ServingEngine:
         self.stats = {"requests": 0, "rows": 0, "microbatches": 0,
                       "refreshes": 0, "buckets": {}}
         self.meter = ThroughputMeter()
+        # swap-observation hook: called as ``on_refresh(version)`` after
+        # every successful refresh, OUTSIDE the engine lock (a hook that
+        # re-enters the engine must not deadlock). The seam the
+        # streaming driver hangs its catalog-swap telemetry on — how an
+        # ingest tier *observes* that a retrain actually reached serving.
+        self.on_refresh = None
         self.refresh(model)
 
     # -- catalog lifecycle ---------------------------------------------------
@@ -120,10 +126,15 @@ class ServingEngine:
         (one ``device_put`` each), restamps the version, and rebinds the
         scoring step. No recompilation happens unless the table
         *geometry* changed (vocab growth) — the executable cache is
-        keyed on shapes, not versions. Returns the new catalog version.
+        keyed on shapes, not versions. Returns the new catalog version
+        (and reports it to ``on_refresh``, if set).
         """
         with self._lock:
-            return self._refresh(model)
+            version = self._refresh(model)
+        hook = self.on_refresh
+        if hook is not None:
+            hook(version)
+        return version
 
     def _refresh(self, model: MFModel | None) -> int:
         if model is not None:

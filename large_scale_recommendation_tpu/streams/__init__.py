@@ -1,0 +1,51 @@
+"""Durable streaming ingest runtime: partitioned event log, backpressure
+sources, crash-recovering online→serve driver.
+
+The storage/runtime half the reference inherited from Flink/Spark and the
+TPU port was missing (docs/STREAMING.md is the narrative):
+
+    log      partitioned append-only WAL — fixed-size segments, fsync'd
+             acked appends, offset-range reads, retention
+    sources  offset-stamped micro-batches through a bounded
+             backpressure-aware queue (block/drop/dead-letter), poison
+             quarantine
+    driver   StreamingDriver: log → OnlineMF/AdaptiveMF micro-batches →
+             ServingEngine catalog swaps, with the consumed WAL offset
+             checkpointed atomically alongside (U, V, step)
+"""
+
+from large_scale_recommendation_tpu.streams.driver import (
+    StreamingDriver,
+    StreamingDriverConfig,
+)
+from large_scale_recommendation_tpu.streams.log import (
+    EventLog,
+    LogTruncatedError,
+)
+from large_scale_recommendation_tpu.streams.sources import (
+    CSVSource,
+    DeadLetterBuffer,
+    GeneratorSource,
+    IngestQueue,
+    LogTailSource,
+    QueuedSource,
+    StreamBatch,
+    pump_to_log,
+    split_poison,
+)
+
+__all__ = [
+    "CSVSource",
+    "DeadLetterBuffer",
+    "EventLog",
+    "GeneratorSource",
+    "IngestQueue",
+    "LogTailSource",
+    "LogTruncatedError",
+    "QueuedSource",
+    "StreamBatch",
+    "StreamingDriver",
+    "StreamingDriverConfig",
+    "pump_to_log",
+    "split_poison",
+]

@@ -1,0 +1,274 @@
+"""StreamingDriver: crash-recovering online→serve ingest loop.
+
+The runtime the reference got for free from its engines, rebuilt around
+the durable pieces of this package: an ``EventLog`` partition is tailed
+(``LogTailSource``) through a bounded backpressure queue
+(``QueuedSource``) into ``OnlineMF``/``AdaptiveMF`` micro-batch updates,
+with the consumed WAL offset checkpointed ATOMICALLY alongside the
+factor tables (``utils.checkpoint.save_online_state``) — and each
+adaptive retrain swap pushed into live ``ServingEngine``s through the
+versioned-catalog path (PR 1), observed here via ``engine.on_refresh``.
+
+Recovery contract (pinned by ``tests/test_streams_driver.py``):
+
+- **at-least-once, zero loss**: a batch's offset stamp is recorded only
+  when the update has been applied (``partial_fit(offset=...)``), and
+  checkpoints persist factors+offset as one atomic snapshot. A crashed
+  driver restarted via ``resume()`` re-tails the log from the
+  checkpointed offset: every rating after it is replayed, nothing is
+  skipped.
+- **bounded duplication**: what IS replayed twice is at most the
+  micro-batches applied since the last checkpoint — ≤
+  ``checkpoint_every`` of them, i.e. ≤ ONE micro-batch at the default
+  ``checkpoint_every=1``. SGD-style updates absorb a duplicated
+  micro-batch as one extra (identical) gradient step — the same
+  tolerance the reference's at-least-once Flink sources relied on.
+- **serve visibility**: after restart, the next retrain swap refreshes
+  every attached engine to a fresh catalog version — the ingest→serve
+  handoff survives the crash.
+
+Telemetry (``telemetry()``): lag-in-records against the log head, queue
+depth/high-water, drop/dead-letter/poison counters
+(``utils.metrics.IngestStats``), checkpoint count, and the catalog
+versions each swap published.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Callable
+
+from large_scale_recommendation_tpu.streams.log import EventLog
+from large_scale_recommendation_tpu.streams.sources import (
+    LogTailSource,
+    QueuedSource,
+    StreamBatch,
+)
+from large_scale_recommendation_tpu.utils.checkpoint import (
+    CheckpointManager,
+    restore_online_state,
+    save_online_state,
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamingDriverConfig:
+    """Ingest-loop knobs.
+
+    ``checkpoint_every`` is the duplication bound: a crash replays at
+    most that many micro-batches (default 1 → ≤ one duplicated
+    micro-batch; raise it to trade recovery duplication for checkpoint
+    I/O on very fast streams). ``truncate_log`` opts into retention:
+    after each checkpoint the log retires segments wholly below the
+    checkpointed offset — never beyond it, so the replay tail always
+    exists.
+    """
+
+    batch_records: int = 4096
+    checkpoint_every: int = 1
+    checkpoint_keep: int = 3
+    queue_capacity: int = 16
+    queue_policy: str = "block"
+    poll_interval_s: float = 0.01
+    truncate_log: bool = False
+    emit_updates: bool = False  # pure-ingest by default (poll the model)
+
+
+class StreamingDriver:
+    """Wire one ``EventLog`` partition into an online model and its
+    serving engines.
+
+    ``model`` is an ``OnlineMF`` (pure streaming) or ``AdaptiveMF``
+    (streaming + periodic retrain; its retrain swaps auto-refresh the
+    engines created via ``serving_engine``). ``checkpoint_dir`` holds
+    the atomic (factors, step, WAL offset) snapshots this driver's
+    ``resume``/crash-recovery contract is built on.
+    """
+
+    def __init__(self, model: Any, log: EventLog, checkpoint_dir: str,
+                 partition: int = 0,
+                 config: StreamingDriverConfig | None = None,
+                 on_batch: Callable[[StreamBatch], None] | None = None):
+        from large_scale_recommendation_tpu.models.adaptive import AdaptiveMF
+
+        self.model = model
+        self.log = log
+        self.partition = partition
+        self.config = config or StreamingDriverConfig()
+        self.manager = CheckpointManager(checkpoint_dir,
+                                         keep=self.config.checkpoint_keep)
+        self.on_batch = on_batch
+        self._adaptive = isinstance(model, AdaptiveMF)
+        self._online = model.online if self._adaptive else model
+        self._stop = threading.Event()
+        self._source: QueuedSource | None = None
+        self._last_stats: dict = {}
+        self.batches_processed = 0
+        self.records_processed = 0
+        self.checkpoints_written = 0
+        self._since_checkpoint = 0
+        # catalog versions observed via engine.on_refresh — the proof a
+        # retrain swap actually reached serving
+        self.catalog_versions: list[int] = []
+        self._engines: list = []
+
+    # -- recovery ------------------------------------------------------------
+
+    def resume(self) -> bool:
+        """Restore the latest (factors, step, WAL offset) snapshot, if
+        any — the restart half of the recovery contract. Returns whether
+        a snapshot was loaded. The next ``run`` tails the log from the
+        restored offset, replaying everything after it."""
+        if self.manager.latest_step() is None:
+            return False
+        restore_online_state(self.manager, self._online)
+        return True
+
+    @property
+    def consumed_offset(self) -> int:
+        """Next unconsumed log offset for this driver's partition:
+        restored by ``resume``, advanced by each applied micro-batch,
+        floored at the log's retention floor for a fresh model."""
+        return self._online.consumed_offsets.get(
+            self.partition, self.log.start_offset(self.partition))
+
+    def checkpoint(self) -> str:
+        """Write one atomic (factors, step, WAL offset) snapshot now."""
+        path = save_online_state(self.manager, self._online,
+                                 self._online.step)
+        self.checkpoints_written += 1
+        self._since_checkpoint = 0
+        if self.config.truncate_log:
+            # retention chases the CHECKPOINTED offset (what this very
+            # snapshot guarantees is applied), never the live one — the
+            # replay tail of any older surviving checkpoint may die, but
+            # the latest one (the one resume() uses) always replays
+            self.log.truncate_before(self.partition, self.consumed_offset)
+        return path
+
+    # -- ingest loop ---------------------------------------------------------
+
+    def run(self, max_batches: int | None = None,
+            follow: bool = False) -> int:
+        """Tail the log from ``consumed_offset`` and apply micro-batches
+        until caught up (``follow=False``), ``max_batches`` applied, or
+        ``stop()``. Returns the number of batches applied this call.
+
+        Each batch goes through ``AdaptiveMF.process`` (which may
+        trigger/absorb retrains and refresh attached engines) or
+        ``OnlineMF.partial_fit`` in pure-ingest mode, with its offset
+        stamp; every ``checkpoint_every`` batches the atomic snapshot is
+        written. A final checkpoint lands when the loop exits with
+        unsnapshotted progress, so a clean catch-up run needs no replay
+        at all on restart.
+        """
+        cfg = self.config
+        self._stop.clear()
+        tail = LogTailSource(
+            self.log, self.partition, start_offset=self.consumed_offset,
+            batch_records=cfg.batch_records, follow=follow,
+            poll_interval_s=cfg.poll_interval_s)
+        self._source = QueuedSource(tail, capacity=cfg.queue_capacity,
+                                    policy=cfg.queue_policy)
+        applied = 0
+        try:
+            for batch in self._source:
+                self._apply(batch)
+                applied += 1
+                if (max_batches is not None and applied >= max_batches) \
+                        or self._stop.is_set():
+                    self._source.stop()
+                    break
+        finally:
+            # on ANY exit — including a mid-apply crash — wind the feeder
+            # down and keep its counters readable; the final checkpoint
+            # below is deliberately NOT in this block: a crash must not
+            # checkpoint (the failed batch's offset may already be
+            # stamped, and persisting it would turn at-least-once into
+            # maybe-lost)
+            self._source.stop()
+            self._last_stats = self._source.stats.snapshot()
+            self._last_stats["dead_letter_buffered"] = len(
+                self._source.dead_letters)
+        if self._since_checkpoint:
+            self.checkpoint()
+        return applied
+
+    def _apply(self, batch: StreamBatch) -> None:
+        offset = (batch.partition, batch.end_offset)
+        if self._adaptive:
+            self.model.process(batch.ratings, offset=offset)
+        else:
+            self.model.partial_fit(
+                batch.ratings, offset=offset,
+                emit_updates=self.config.emit_updates)
+        self.batches_processed += 1
+        self.records_processed += batch.n
+        self._since_checkpoint += 1
+        if self.on_batch is not None:
+            self.on_batch(batch)
+        if self._since_checkpoint >= self.config.checkpoint_every:
+            self.checkpoint()
+
+    def stop(self) -> None:
+        """Ask a running ``run(follow=True)`` loop to wind down (it
+        still checkpoints its progress on the way out)."""
+        self._stop.set()
+        if self._source is not None:
+            self._source.stop()
+
+    # -- serving -------------------------------------------------------------
+
+    def serving_engine(self, k: int = 10, **kwargs):
+        """A ``ServingEngine`` over the live model, wired for swap
+        observation: every refresh (adaptive retrain swaps arrive
+        automatically via the PR-1 versioned-catalog path; online models
+        refresh via ``refresh_serving``) appends its catalog version to
+        ``catalog_versions``."""
+        if self._adaptive:
+            engine = self.model.serving_engine(k=k, **kwargs)
+        else:
+            from large_scale_recommendation_tpu.serving.engine import (
+                ServingEngine,
+            )
+
+            engine = ServingEngine(self.model.to_model(), k=k, **kwargs)
+        engine.on_refresh = self.catalog_versions.append
+        self.catalog_versions.append(engine.version)  # the bind itself
+        self._engines.append(engine)
+        return engine
+
+    def refresh_serving(self) -> None:
+        """Re-snapshot the live model into every attached engine — the
+        manual analogue of the adaptive swap auto-refresh, for pure
+        ``OnlineMF`` streams that want periodic serve visibility."""
+        if not self._engines:
+            return
+        snapshot = self.model.to_model()
+        for engine in self._engines:
+            engine.refresh(snapshot)
+
+    # -- telemetry -----------------------------------------------------------
+
+    def telemetry(self) -> dict:
+        """One structured snapshot of the ingest tier: progress, lag
+        against the log head, queue/drop/dead-letter counters from the
+        current (or last) run, checkpoint count, and observed catalog
+        versions."""
+        queue = dict(self._last_stats)
+        if self._source is not None and self._source.queue is not None:
+            queue = self._source.stats.snapshot()
+            queue["dead_letter_buffered"] = len(self._source.dead_letters)
+        return {
+            "partition": self.partition,
+            "batches_processed": self.batches_processed,
+            "records_processed": self.records_processed,
+            "consumed_offset": self.consumed_offset,
+            "log_end_offset": self.log.end_offset(self.partition),
+            "lag_records": self.log.lag(
+                {self.partition: self.consumed_offset}),
+            "checkpoints_written": self.checkpoints_written,
+            "catalog_versions": list(self.catalog_versions),
+            "queue": queue,
+        }

@@ -486,24 +486,40 @@ def restore_mf_model(manager: CheckpointManager, step: int | None = None):
     return model, ck
 
 
-def save_online_state(manager: CheckpointManager, online, step: int) -> str:
+def save_online_state(manager: CheckpointManager, online, step: int,
+                      extra_meta: dict | None = None) -> str:
     """Snapshot an ``OnlineMF``'s growable tables (ids + factors) —
     ≙ the lineage-truncation snapshot of the factor RDDs
-    (OnlineSpark.scala:205-212)."""
+    (OnlineSpark.scala:205-212).
+
+    The model's consumed WAL offsets (``OnlineMF.consumed_offsets``,
+    stamped by ``partial_fit(offset=...)``) ride in the meta: factors
+    and stream position are ONE atomic snapshot, which is the entire
+    recovery contract — a restart that restored factors without the
+    offset they correspond to would either lose or double-apply the
+    tail (docs/STREAMING.md). JSON round-trips dict keys as strings;
+    restore converts back.
+    """
     u_ids = np.asarray(online.users.ids(), dtype=np.int64)
     i_ids = np.asarray(online.items.ids(), dtype=np.int64)
+    meta = {"kind": "online_state", "step": online.step,
+            "offsets": {str(k): int(v)
+                        for k, v in online.consumed_offsets.items()}}
+    meta.update(extra_meta or {})
     return manager.save(step, {
         "user_ids": u_ids,
         "item_ids": i_ids,
         "U": np.asarray(online.users.array)[: len(u_ids)],
         "V": np.asarray(online.items.array)[: len(i_ids)],
-    }, {"kind": "online_state", "step": online.step})
+    }, meta)
 
 
 def restore_online_state(manager: CheckpointManager, online,
-                         step: int | None = None) -> None:
+                         step: int | None = None) -> Checkpoint:
     """Load a snapshot back into an ``OnlineMF`` (tables are re-registered
-    in saved order, so row assignment is reproduced exactly)."""
+    in saved order, so row assignment is reproduced exactly), including
+    the consumed WAL offsets. Returns the ``Checkpoint`` so drivers can
+    read the restored meta (offsets, step) without re-opening it."""
     import jax.numpy as jnp
 
     ck = manager.restore(step)
@@ -517,3 +533,6 @@ def restore_online_state(manager: CheckpointManager, online,
             jnp.asarray(ck[key_arr])
         )
     online.step = int(ck.meta.get("step", 0))
+    online.consumed_offsets = {
+        int(k): int(v) for k, v in ck.meta.get("offsets", {}).items()}
+    return ck

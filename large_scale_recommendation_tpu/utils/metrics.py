@@ -91,6 +91,34 @@ class ThroughputMeter:
         return self.total_elements / self.total_s if self.total_s else 0.0
 
 
+@dataclasses.dataclass
+class IngestStats:
+    """Ingest-side counters for the streaming runtime (``streams/``) —
+    the structured twin of the reference's pull-window/buffer-depth log
+    lines (PSOfflineMF.scala:122,163, FlinkOnlineMF.scala:76-81), plus
+    the durability counters those engines kept internal: queue depth and
+    high-water mark, block/drop/dead-letter outcomes, and poison-record
+    quarantines. Mutated under the owning queue's lock; ``snapshot()``
+    returns a plain dict for telemetry consumers (the driver merges it
+    with lag-in-records from the log)."""
+
+    enqueued_batches: int = 0
+    enqueued_records: int = 0
+    dequeued_batches: int = 0
+    dequeued_records: int = 0
+    dropped_batches: int = 0
+    dropped_records: int = 0
+    dead_letter_batches: int = 0
+    dead_letter_records: int = 0
+    poison_records: int = 0
+    blocked_puts: int = 0
+    depth: int = 0
+    depth_high_water: int = 0
+
+    def snapshot(self) -> dict:
+        return dataclasses.asdict(self)
+
+
 class MetricsLog:
     """Append-only structured metric records.
 

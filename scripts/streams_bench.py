@@ -1,0 +1,158 @@
+"""Durable-ingest micro-bench: ratings/s through log→queue→online_train.
+
+The streaming acceptance number for the ingest runtime (``streams/``):
+the SAME micro-batch stream driven two ways —
+
+- **bare**: ``OnlineMF.partial_fit`` straight off in-memory batches —
+  the demo loop the repo had before the durable tier existed. Fast, and
+  a crash loses everything since the last factor snapshot.
+- **durable**: the full ``StreamingDriver`` path — fsync-less event-log
+  appends (fsync is a knob; CI machines' fsync latency would measure
+  the disk, not the runtime), ``LogTailSource`` offset-stamped reads
+  through the bounded backpressure queue, per-batch (U, V, offset)
+  checkpoints, crash-recoverable by contract.
+
+``value`` is the durable path's ratings/s; ``vs_baseline`` is
+durable/bare — the *throughput retention* of durability (1.0 = free;
+~1.0 measured on CPU at default sizes, where the queue overlaps host
+batch prep with device compute). tests/test_bench_contract.py pins the
+JSON contract structurally; the retention number itself is bench-round
+evidence (``streams_ingest_vs_bare``), not a CI gate. The log-append
+leg is also timed alone (``log_append_ratings_per_s``).
+
+Contract: the LAST stdout line is one JSON object
+``{"metric", "value", "unit", "vs_baseline", "extra"}``.
+
+Env knobs: STREAMS_USERS, STREAMS_ITEMS, STREAMS_RANK, STREAMS_BATCHES,
+STREAMS_BATCH (records per micro-batch), STREAMS_CHECKPOINT_EVERY,
+STREAMS_FSYNC (=1 to fsync appends), STREAMS_FORCE_CPU (=0 for the
+default jax backend).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run(num_users=20_000, num_items=5_000, rank=32, n_batches=10,
+        batch_records=50_000, checkpoint_every=1, fsync=False,
+        seed=0) -> dict:
+    import jax
+
+    from large_scale_recommendation_tpu.core.generators import (
+        SyntheticMFGenerator,
+    )
+    from large_scale_recommendation_tpu.models.online import (
+        OnlineMF,
+        OnlineMFConfig,
+    )
+    from large_scale_recommendation_tpu.streams import (
+        EventLog,
+        StreamingDriver,
+        StreamingDriverConfig,
+    )
+
+    gen = SyntheticMFGenerator(num_users=num_users, num_items=num_items,
+                               rank=16, noise=0.1, seed=seed, skew_lam=2.0)
+    batches = [gen.generate(batch_records) for _ in range(n_batches)]
+    warm = gen.generate(batch_records)
+    total = n_batches * batch_records
+
+    def make_model():
+        return OnlineMF(OnlineMFConfig(
+            num_factors=rank, learning_rate=0.05,
+            minibatch_size=min(16384, batch_records),
+            init_capacity=1 << 15))
+
+    extra = {
+        "device": str(jax.devices()[0]), "num_users": num_users,
+        "num_items": num_items, "rank": rank, "n_batches": n_batches,
+        "batch_records": batch_records,
+        "checkpoint_every": checkpoint_every, "fsync": fsync,
+    }
+
+    with tempfile.TemporaryDirectory() as tmp:
+        # ---- log append leg (host-only) -------------------------------
+        log = EventLog(os.path.join(tmp, "log"), fsync=fsync)
+        log.append(0, warm)  # file creation / first-segment cost
+        t0 = time.perf_counter()
+        for b in batches:
+            log.append(0, b)
+        append_wall = time.perf_counter() - t0
+        extra["log_append_ratings_per_s"] = round(total / append_wall, 1)
+
+        # ---- bare baseline: partial_fit off in-memory batches ---------
+        bare = make_model()
+        bare.partial_fit(warm, emit_updates=False)  # compile+grow warm-up
+        t0 = time.perf_counter()
+        for b in batches:
+            bare.partial_fit(b, emit_updates=False)
+        jax.block_until_ready(bare.users.array)
+        bare_wall = time.perf_counter() - t0
+        extra["bare_ratings_per_s"] = round(total / bare_wall, 1)
+
+        # ---- durable path: log → queue → online_train -----------------
+        model = make_model()
+        model.partial_fit(warm, emit_updates=False)  # same warm-up
+        drv = StreamingDriver(
+            model, log, os.path.join(tmp, "ckpt"),
+            config=StreamingDriverConfig(
+                batch_records=batch_records,
+                checkpoint_every=checkpoint_every))
+        # the warm batch occupies [0, batch_records) of the log; skip it
+        # so both timed paths train the identical stream
+        model.consumed_offsets[0] = batch_records
+        t0 = time.perf_counter()
+        applied = drv.run()
+        jax.block_until_ready(model.users.array)
+        durable_wall = time.perf_counter() - t0
+        tele = drv.telemetry()
+        extra["ingest_ratings_per_s"] = round(total / durable_wall, 1)
+        extra["ingest_wall_s"] = round(durable_wall, 3)
+        extra["ingest_batches"] = applied
+        extra["ingest_lag_records"] = tele["lag_records"]
+        extra["checkpoints_written"] = tele["checkpoints_written"]
+        extra["queue_depth_high_water"] = (
+            tele["queue"].get("depth_high_water", 0))
+        log.close()
+
+    retention = (total / durable_wall) / (total / bare_wall)
+    return {
+        "metric": (f"durable ingest ratings/s (log→queue→online_train, "
+                   f"{num_users}x{num_items} rank={rank}, "
+                   f"{n_batches}x{batch_records} micro-batches, "
+                   f"ckpt every {checkpoint_every})"),
+        "value": extra["ingest_ratings_per_s"],
+        "unit": "ratings/s",
+        "vs_baseline": round(retention, 3),
+        "extra": extra,
+    }
+
+
+def main() -> None:
+    if os.environ.get("STREAMS_FORCE_CPU", "1") == "1":
+        from large_scale_recommendation_tpu.utils.platform import force_cpu
+
+        force_cpu()
+    result = run(
+        num_users=int(os.environ.get("STREAMS_USERS", 20_000)),
+        num_items=int(os.environ.get("STREAMS_ITEMS", 5_000)),
+        rank=int(os.environ.get("STREAMS_RANK", 32)),
+        n_batches=int(os.environ.get("STREAMS_BATCHES", 10)),
+        batch_records=int(os.environ.get("STREAMS_BATCH", 50_000)),
+        checkpoint_every=int(os.environ.get("STREAMS_CHECKPOINT_EVERY", 1)),
+        fsync=os.environ.get("STREAMS_FSYNC") == "1",
+    )
+    print(json.dumps(result), flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,182 @@
+"""EventLog: offsets, segment roll, torn-tail recovery, retention.
+
+The durability invariants the streaming recovery contract
+(docs/STREAMING.md) rests on: acked offsets survive reopen, a torn tail
+from a crash mid-write is truncated (never renumbered), and retention
+refuses to lie about what is replayable.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from large_scale_recommendation_tpu.core.types import Ratings
+from large_scale_recommendation_tpu.streams.log import (
+    HEADER_SIZE,
+    RECORD_SIZE,
+    EventLog,
+    LogTruncatedError,
+)
+
+
+def _batch(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return Ratings.from_arrays(rng.integers(0, 100, n),
+                               rng.integers(0, 50, n),
+                               rng.random(n).astype(np.float32))
+
+
+class TestAppendRead:
+    def test_roundtrip_offsets(self, tmp_path):
+        log = EventLog(str(tmp_path), fsync=False)
+        b = _batch(100)
+        assert log.append(0, b) == (0, 100)
+        assert log.append(0, _batch(50, seed=1)) == (100, 150)
+        out, nxt = log.read(0, 0, 100)
+        assert nxt == 100
+        np.testing.assert_array_equal(out.users, np.asarray(b.users))
+        np.testing.assert_array_equal(out.ratings, np.asarray(b.ratings))
+        # mid-stream read honors the requested range exactly
+        out2, nxt2 = log.read(0, 90, 20)
+        assert (nxt2, out2.n) == (110, 20)
+        np.testing.assert_array_equal(out2.users[:10],
+                                      np.asarray(b.users)[90:])
+
+    def test_padding_rows_are_dropped(self, tmp_path):
+        log = EventLog(str(tmp_path), fsync=False)
+        padded = _batch(10).pad_to(32)  # 22 weight-0 padding rows
+        assert log.append(0, padded) == (0, 10)
+
+    def test_read_at_end_is_empty(self, tmp_path):
+        log = EventLog(str(tmp_path), fsync=False)
+        log.append(0, _batch(5))
+        out, nxt = log.read(0, 5, 100)
+        assert (out.n, nxt) == (0, 5)
+
+    def test_multi_partition_independent_offsets(self, tmp_path):
+        log = EventLog(str(tmp_path), num_partitions=3, fsync=False)
+        assert log.append(1, _batch(10)) == (0, 10)
+        assert log.append(2, _batch(20, seed=1)) == (0, 20)
+        assert log.append(1, _batch(5, seed=2)) == (10, 15)
+        assert log.end_offset(0) == 0
+        assert log.lag({1: 10}) == 25  # 5 on p1 + 20 on p2 (p0 empty)
+
+
+class TestSegments:
+    def test_roll_and_cross_segment_read(self, tmp_path):
+        log = EventLog(str(tmp_path), segment_records=64, fsync=False)
+        b = _batch(300)
+        log.append(0, b)
+        part = log._parts[0]
+        assert [s[0] for s in part.segments] == [0, 64, 128, 192, 256]
+        out, nxt = log.read(0, 50, 200)  # spans 4 segments
+        assert nxt == 250
+        np.testing.assert_array_equal(out.users,
+                                      np.asarray(b.users)[50:250])
+
+    def test_reopen_resumes_offsets(self, tmp_path):
+        log = EventLog(str(tmp_path), segment_records=64, fsync=False)
+        log.append(0, _batch(100))
+        log.close()
+        log2 = EventLog(str(tmp_path), segment_records=64, fsync=False)
+        assert log2.end_offset(0) == 100
+        assert log2.append(0, _batch(10, seed=3)) == (100, 110)
+        out, _ = log2.read(0, 0, 110)
+        assert out.n == 110
+
+    def test_geometry_mismatch_refused(self, tmp_path):
+        EventLog(str(tmp_path), num_partitions=2, fsync=False).close()
+        with pytest.raises(ValueError, match="renumber"):
+            EventLog(str(tmp_path), num_partitions=4, fsync=False)
+
+
+class TestCrashRecovery:
+    def test_torn_tail_truncated_on_reopen(self, tmp_path):
+        log = EventLog(str(tmp_path), fsync=False)
+        log.append(0, _batch(20))
+        log.close()
+        seg = os.path.join(str(tmp_path), "p0", f"seg_{0:020d}.log")
+        with open(seg, "ab") as f:  # crash mid-append: 7 stray bytes
+            f.write(b"\x01" * 7)
+        log2 = EventLog(str(tmp_path), fsync=False)
+        assert log2.end_offset(0) == 20  # unacked tail discarded
+        assert log2.append(0, _batch(5, seed=1)) == (20, 25)
+        out, _ = log2.read(0, 0, 25)
+        assert out.n == 25
+
+    def test_torn_whole_records_survive(self, tmp_path):
+        # a torn tail is only the PARTIAL trailing record; complete
+        # records before it are intact bytes and must survive
+        log = EventLog(str(tmp_path), fsync=False)
+        log.append(0, _batch(20))
+        log.close()
+        seg = os.path.join(str(tmp_path), "p0", f"seg_{0:020d}.log")
+        assert os.path.getsize(seg) == HEADER_SIZE + 20 * RECORD_SIZE
+        log2 = EventLog(str(tmp_path), fsync=False)
+        out, _ = log2.read(0, 0, 20)
+        assert out.n == 20
+
+    def test_headerless_shell_segment_recovers(self, tmp_path):
+        # crash between segment create and header write leaves a short
+        # file; reopen must rewrite it as an empty segment, not die
+        log = EventLog(str(tmp_path), segment_records=8, fsync=False)
+        log.append(0, _batch(8))  # fills segment 0
+        log.close()
+        shell = os.path.join(str(tmp_path), "p0", f"seg_{8:020d}.log")
+        with open(shell, "wb") as f:
+            f.write(b"LS")  # truncated header
+        log2 = EventLog(str(tmp_path), segment_records=8, fsync=False)
+        assert log2.end_offset(0) == 8
+        assert log2.append(0, _batch(3, seed=2)) == (8, 11)
+
+
+class TestCrossInstance:
+    def test_reader_instance_sees_writer_appends(self, tmp_path):
+        # the multi-process topology: a tailer's EventLog instance must
+        # observe appends made through a DIFFERENT instance (regression:
+        # segment state was only scanned at open, so a separate-instance
+        # tailer froze at its open-time end while reporting lag 0)
+        writer = EventLog(str(tmp_path), segment_records=16, fsync=False)
+        writer.append(0, _batch(4))
+        reader = EventLog(str(tmp_path), segment_records=16, fsync=False)
+        assert reader.end_offset(0) == 4
+        writer.append(0, _batch(40, seed=1))  # grows tail AND rolls
+        assert reader.end_offset(0) == 44
+        assert reader.lag({0: 4}) == 40
+        out, nxt = reader.read(0, 4, 100)
+        assert (out.n, nxt) == (40, 44)
+
+    def test_reader_instance_sees_foreign_retention(self, tmp_path):
+        writer = EventLog(str(tmp_path), segment_records=16, fsync=False)
+        writer.append(0, _batch(40))
+        reader = EventLog(str(tmp_path), segment_records=16, fsync=False)
+        writer.truncate_before(0, 32)
+        with pytest.raises(LogTruncatedError):  # not FileNotFoundError
+            reader.read(0, 0, 8)
+        assert reader.start_offset(0) == 32
+
+
+class TestRetention:
+    def test_truncate_before_frees_segments(self, tmp_path):
+        log = EventLog(str(tmp_path), segment_records=32, fsync=False)
+        log.append(0, _batch(100))
+        floor = log.truncate_before(0, 70)  # segments [0,32),[32,64) go
+        assert floor == 64
+        assert log.start_offset(0) == 64
+        out, nxt = log.read(0, 64, 100)
+        assert (out.n, nxt) == (36, 100)
+
+    def test_read_below_floor_raises(self, tmp_path):
+        log = EventLog(str(tmp_path), segment_records=32, fsync=False)
+        log.append(0, _batch(100))
+        log.truncate_before(0, 64)
+        with pytest.raises(LogTruncatedError):
+            log.read(0, 10, 5)
+
+    def test_active_segment_survives(self, tmp_path):
+        log = EventLog(str(tmp_path), segment_records=32, fsync=False)
+        log.append(0, _batch(40))  # 32 sealed + 8 active
+        log.truncate_before(0, 10 ** 9)  # beyond the end
+        assert log.start_offset(0) == 32  # active tail never deleted
+        assert log.append(0, _batch(4, seed=1)) == (40, 44)

@@ -1,0 +1,216 @@
+"""Sources + ingest queue: offset stamping, backpressure policies,
+poison quarantine, feeder-fault surfacing."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from large_scale_recommendation_tpu.core.generators import (
+    SyntheticMFGenerator,
+)
+from large_scale_recommendation_tpu.core.types import Ratings
+from large_scale_recommendation_tpu.streams import (
+    CSVSource,
+    EventLog,
+    GeneratorSource,
+    IngestQueue,
+    LogTailSource,
+    LogTruncatedError,
+    QueuedSource,
+    StreamBatch,
+    pump_to_log,
+    split_poison,
+)
+
+
+def _sbatch(n, start=0, partition=0, seed=0):
+    rng = np.random.default_rng(seed)
+    return StreamBatch(
+        ratings=Ratings.from_arrays(rng.integers(0, 40, n),
+                                    rng.integers(0, 30, n),
+                                    rng.random(n).astype(np.float32)),
+        partition=partition, start_offset=start, end_offset=start + n)
+
+
+class TestSources:
+    def test_generator_source_offset_stamps(self):
+        gen = SyntheticMFGenerator(num_users=30, num_items=20, rank=3,
+                                   seed=0)
+        batches = list(GeneratorSource(gen, batch_records=100,
+                                       num_batches=4))
+        assert [(b.start_offset, b.end_offset) for b in batches] == [
+            (0, 100), (100, 200), (200, 300), (300, 400)]
+        assert all(b.n == 100 for b in batches)
+
+    def test_log_tail_source_stamps_log_offsets(self, tmp_path):
+        log = EventLog(str(tmp_path), fsync=False)
+        gen = SyntheticMFGenerator(num_users=30, num_items=20, rank=3,
+                                   seed=1)
+        n = pump_to_log(GeneratorSource(gen, 128, num_batches=3), log)
+        assert n == 384
+        batches = list(LogTailSource(log, batch_records=150))
+        assert [(b.start_offset, b.end_offset) for b in batches] == [
+            (0, 150), (150, 300), (300, 384)]
+        # mid-stream start offset: the resume path
+        tail = list(LogTailSource(log, start_offset=300,
+                                  batch_records=150))
+        assert [(b.start_offset, b.end_offset) for b in tail] == [
+            (300, 384)]
+
+    def test_log_tail_follow_sees_late_appends(self, tmp_path):
+        log = EventLog(str(tmp_path), fsync=False)
+        log.append_arrays(0, [1], [2], [3.0])
+        src = LogTailSource(log, batch_records=10, follow=True,
+                            poll_interval_s=0.005)
+        got = []
+
+        def consume():
+            for b in src:
+                got.append(b)
+
+        t = threading.Thread(target=consume, daemon=True)
+        t.start()
+        deadline = time.monotonic() + 5
+        while not got and time.monotonic() < deadline:
+            time.sleep(0.005)
+        log.append_arrays(0, [4], [5], [6.0])  # lands AFTER the tail
+        while len(got) < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        src.stop()
+        t.join(timeout=5)
+        assert [b.end_offset for b in got] == [1, 2]
+
+    def test_csv_source(self, tmp_path):
+        path = tmp_path / "u.data"
+        rows = [(u, u % 7, float(u % 5) + 1) for u in range(25)]
+        path.write_text("".join(f"{u}\t{i}\t{r}\t0\n" for u, i, r in rows))
+        batches = list(CSVSource(str(path), batch_records=10))
+        assert [(b.start_offset, b.end_offset) for b in batches] == [
+            (0, 10), (10, 20), (20, 25)]
+        np.testing.assert_array_equal(
+            np.asarray(batches[0].ratings.users), np.arange(10))
+
+
+class TestIngestQueue:
+    def test_fifo_and_close_drain(self):
+        q = IngestQueue(capacity=4)
+        for k in range(3):
+            assert q.put(_sbatch(10, start=k * 10))
+        q.close()
+        got = []
+        while (b := q.get()) is not None:
+            got.append(b.start_offset)
+        assert got == [0, 10, 20]
+        assert q.get(timeout=0.01) is None
+
+    def test_block_policy_backpressures_without_loss(self):
+        q = IngestQueue(capacity=2, policy="block")
+        produced = 40
+        consumed = []
+
+        def producer():
+            for k in range(produced):
+                q.put(_sbatch(5, start=k * 5))
+            q.close()
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while (b := q.get(timeout=5)) is not None:
+            consumed.append(b.start_offset)
+            time.sleep(0.001)  # slower than the producer
+        t.join(timeout=5)
+        assert consumed == [k * 5 for k in range(produced)]  # zero loss
+        assert q.stats.depth_high_water <= 2  # bound held
+        assert q.stats.blocked_puts > 0  # backpressure engaged
+
+    def test_drop_policy_sheds_and_counts(self):
+        q = IngestQueue(capacity=2, policy="drop")
+        results = [q.put(_sbatch(10, start=k * 10)) for k in range(5)]
+        assert results == [True, True, False, False, False]
+        assert q.stats.dropped_batches == 3
+        assert q.stats.dropped_records == 30
+
+    def test_dead_letter_policy_is_recoverable(self):
+        q = IngestQueue(capacity=1, policy="dead_letter")
+        assert q.put(_sbatch(10, start=0))
+        assert not q.put(_sbatch(7, start=10, seed=1))
+        assert q.stats.dead_letter_batches == 1
+        assert q.stats.dead_letter_records == 7
+        assert q.stats.dropped_batches == 0  # quarantined ≠ lost
+        u, i, r = q.dead_letters.records()
+        assert len(u) == 7
+
+    def test_invalid_policy_refused(self):
+        with pytest.raises(ValueError, match="policy"):
+            IngestQueue(policy="explode")
+
+
+class TestPoisonQuarantine:
+    def test_split_poison_mask(self):
+        users = np.array([1, -1, 2, 3])
+        items = np.array([1, 2, -5, 3])
+        vals = np.array([1.0, 1.0, 1.0, np.nan], np.float32)
+        np.testing.assert_array_equal(
+            split_poison(users, items, vals), [True, False, False, False])
+
+    def test_quarantine_preserves_offsets_and_feeds_clean(self):
+        bad = StreamBatch(
+            ratings=Ratings.from_arrays(
+                [1, -1, 2, 3], [1, 2, 3, 4],
+                np.array([1.0, 1.0, np.nan, 1.0], np.float32)),
+            partition=0, start_offset=100, end_offset=104)
+        qs = QueuedSource([bad])
+        out = list(qs)
+        assert len(out) == 1
+        # the batch still covers its full range — poison rows are
+        # consumed into quarantine, not lost and not re-readable
+        assert (out[0].start_offset, out[0].end_offset) == (100, 104)
+        np.testing.assert_array_equal(np.asarray(out[0].ratings.users),
+                                      [1, 3])
+        assert qs.stats.poison_records == 2
+        u, i, r = qs.dead_letters.records()
+        assert sorted(u.tolist()) == [-1, 2]
+
+    def test_driver_survives_poison(self, tmp_path):
+        # end-to-end: a poisoned log region must not kill the driver OR
+        # corrupt the model (no NaN reaches the tables)
+        from large_scale_recommendation_tpu.models.online import (
+            OnlineMF,
+            OnlineMFConfig,
+        )
+        from large_scale_recommendation_tpu.streams import (
+            StreamingDriver,
+            StreamingDriverConfig,
+        )
+
+        log = EventLog(str(tmp_path / "log"), fsync=False)
+        gen = SyntheticMFGenerator(num_users=30, num_items=20, rank=3,
+                                   seed=2)
+        pump_to_log(GeneratorSource(gen, 100, num_batches=2), log)
+        log.append_arrays(0, [5, 6], [1, 2],
+                          [np.nan, np.inf])  # poison region
+        pump_to_log(GeneratorSource(gen, 100, num_batches=1), log)
+
+        m = OnlineMF(OnlineMFConfig(num_factors=3, minibatch_size=64))
+        drv = StreamingDriver(m, log, str(tmp_path / "ckpt"),
+                              config=StreamingDriverConfig(
+                                  batch_records=100))
+        drv.run()
+        assert drv.consumed_offset == 302  # poison counted as consumed
+        assert np.isfinite(np.asarray(m.users.array)).all()
+        assert drv.telemetry()["queue"]["poison_records"] == 2
+
+
+class TestFeederFaults:
+    def test_runtime_fault_surfaces_on_consumer(self, tmp_path):
+        log = EventLog(str(tmp_path), segment_records=16, fsync=False)
+        rng = np.random.default_rng(0)
+        log.append_arrays(0, rng.integers(0, 9, 64),
+                          rng.integers(0, 9, 64), rng.random(64))
+        log.truncate_before(0, 48)
+        qs = QueuedSource(LogTailSource(log, start_offset=0,
+                                        batch_records=16))
+        with pytest.raises(LogTruncatedError):
+            list(qs)
