@@ -348,6 +348,22 @@ class AdaptiveMF:
                 dropped = self._history.pop(0)
                 self._history_rows -= len(dropped[0])
 
+    def clear_history(self) -> None:
+        """Drop the retrain history — the crash-recovery refill resets
+        it before rebuilding from the log (``StreamingDriver.resume``),
+        so resuming a warm model never duplicates rows."""
+        self._history.clear()
+        self._history_rows = 0
+
+    def preload_history(self, batch: Ratings) -> None:
+        """Refill the retrain history WITHOUT a gradient step — the
+        crash-recovery path: factors come back from the checkpoint, but
+        the history a future retrain fits from lives only in host
+        memory and must be rebuilt from the durable log
+        (``StreamingDriver.resume``). ``history_limit`` applies as
+        usual."""
+        self._append_history(batch)
+
     def _history_ratings(self) -> Ratings:
         ru = np.concatenate([h[0] for h in self._history])
         ri = np.concatenate([h[1] for h in self._history])

@@ -110,10 +110,13 @@ class ServingEngine:
                       "refreshes": 0, "buckets": {}}
         self.meter = ThroughputMeter()
         # swap-observation hook: called as ``on_refresh(version)`` after
-        # every successful refresh, OUTSIDE the engine lock (a hook that
-        # re-enters the engine must not deadlock). The seam the
-        # streaming driver hangs its catalog-swap telemetry on — how an
-        # ingest tier *observes* that a retrain actually reached serving.
+        # every successful refresh, INSIDE the engine lock so concurrent
+        # refreshes report their versions in swap order (the lock is
+        # re-entrant, so a hook that re-enters the engine from the same
+        # thread cannot deadlock; a hook must not block on another
+        # thread that needs this engine). The seam the streaming driver
+        # hangs its catalog-swap telemetry on — how an ingest tier
+        # *observes* that a retrain actually reached serving.
         self.on_refresh = None
         self.refresh(model)
 
@@ -131,9 +134,9 @@ class ServingEngine:
         """
         with self._lock:
             version = self._refresh(model)
-        hook = self.on_refresh
-        if hook is not None:
-            hook(version)
+            hook = self.on_refresh
+            if hook is not None:
+                hook(version)
         return version
 
     def _refresh(self, model: MFModel | None) -> int:

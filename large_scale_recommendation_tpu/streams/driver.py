@@ -23,6 +23,20 @@ Recovery contract (pinned by ``tests/test_streams_driver.py``):
   ``checkpoint_every=1``. SGD-style updates absorb a duplicated
   micro-batch as one extra (identical) gradient step — the same
   tolerance the reference's at-least-once Flink sources relied on.
+  One widening: while an ``AdaptiveMF(background=True)`` retrain is in
+  flight, arriving batches are buffered with a frozen offset stamp, so
+  the checkpointable frontier cannot advance — a crash inside that
+  window additionally replays the buffered batches (bounded by the
+  retrain's duration). The driver holds checkpoints during the window
+  (they could only repeat the pre-retrain offset) and writes one as
+  soon as the swap flushes the buffer.
+- **retrain-history rebuild**: ``AdaptiveMF``'s retrain history lives
+  only in host memory (it is not part of the checkpoint); ``resume()``
+  refills it from the retained log below the restored offset (capped
+  at ``history_limit``), so the first post-restart retrain fits from
+  the same data an uncrashed run's would have. Retention bounds this:
+  records already retired by ``truncate_log`` cannot be refilled —
+  aggressive retention trades rebuildable history for disk.
 - **serve visibility**: after restart, the next retrain swap refreshes
   every attached engine to a fresh catalog version — the ingest→serve
   handoff survives the crash.
@@ -119,19 +133,54 @@ class StreamingDriver:
         """Restore the latest (factors, step, WAL offset) snapshot, if
         any — the restart half of the recovery contract. Returns whether
         a snapshot was loaded. The next ``run`` tails the log from the
-        restored offset, replaying everything after it."""
+        restored offset, replaying everything after it. For an
+        ``AdaptiveMF``, the retrain history (host memory only, not in
+        the checkpoint) is rebuilt from the retained log below the
+        restored offset, so the first post-restart retrain fits from
+        the same data an uncrashed run's would — up to retention:
+        records already retired by ``truncate_log`` are gone."""
         if self.manager.latest_step() is None:
             return False
         restore_online_state(self.manager, self._online)
+        if self._adaptive:
+            self._rebuild_history()
         return True
+
+    def _rebuild_history(self) -> None:
+        consumed = self._online.consumed_offsets.get(self.partition)
+        if consumed is None:
+            return
+        # resume() may be called on a warm model (or twice): reset
+        # before refilling so history rows are never duplicated
+        self.model.clear_history()
+        start = self.log.start_offset(self.partition)
+        limit = self.model.config.history_limit
+        if limit is not None:
+            # only the newest history_limit records survive the refill
+            # anyway — don't read what _append_history would evict
+            start = max(start, consumed - limit)
+        offset = start
+        while offset < consumed:
+            batch, nxt = self.log.read(
+                self.partition, offset,
+                min(self.config.batch_records, consumed - offset))
+            if nxt == offset:
+                break
+            self.model.preload_history(batch)
+            offset = nxt
 
     @property
     def consumed_offset(self) -> int:
         """Next unconsumed log offset for this driver's partition:
         restored by ``resume``, advanced by each applied micro-batch,
         floored at the log's retention floor for a fresh model."""
-        return self._online.consumed_offsets.get(
-            self.partition, self.log.start_offset(self.partition))
+        offsets = self._online.consumed_offsets
+        if self.partition in offsets:
+            return offsets[self.partition]
+        # fresh model only — start_offset refreshes from disk (listdir +
+        # per-segment stat), far too hot for the per-batch checkpoint
+        # and telemetry paths that land here once the stamp exists
+        return self.log.start_offset(self.partition)
 
     def checkpoint(self) -> str:
         """Write one atomic (factors, step, WAL offset) snapshot now."""
@@ -191,6 +240,11 @@ class StreamingDriver:
             self._last_stats = self._source.stats.snapshot()
             self._last_stats["dead_letter_buffered"] = len(
                 self._source.dead_letters)
+        # a feeder fault must surface even when the consume loop exited
+        # early (max_batches/stop) before draining to the end-of-stream
+        # re-raise inside batches() — and it must land BEFORE the final
+        # checkpoint, same as any other runtime fault
+        self._source.finish()
         if self._since_checkpoint:
             self.checkpoint()
         return applied
@@ -208,6 +262,15 @@ class StreamingDriver:
         self._since_checkpoint += 1
         if self.on_batch is not None:
             self.on_batch(batch)
+        stamped = self._online.consumed_offsets.get(batch.partition, 0)
+        if stamped < batch.end_offset:
+            # buffered during a background retrain: the model's offset
+            # stamp is frozen until the swap replays the buffer, so a
+            # checkpoint now would just re-persist the pre-retrain
+            # offset. Hold — _since_checkpoint keeps accumulating, and
+            # the first post-swap batch (stamp advanced past it) writes
+            # one checkpoint covering everything replayed.
+            return
         if self._since_checkpoint >= self.config.checkpoint_every:
             self.checkpoint()
 
@@ -260,14 +323,17 @@ class StreamingDriver:
         if self._source is not None and self._source.queue is not None:
             queue = self._source.stats.snapshot()
             queue["dead_letter_buffered"] = len(self._source.dead_letters)
+        # lag for THIS driver's partition only — EventLog.lag would also
+        # count every other partition's backlog (missing partitions are
+        # charged from their floor), which is not this driver's lag
+        end = self.log.end_offset(self.partition)
         return {
             "partition": self.partition,
             "batches_processed": self.batches_processed,
             "records_processed": self.records_processed,
             "consumed_offset": self.consumed_offset,
-            "log_end_offset": self.log.end_offset(self.partition),
-            "lag_records": self.log.lag(
-                {self.partition: self.consumed_offset}),
+            "log_end_offset": end,
+            "lag_records": max(0, end - self.consumed_offset),
             "checkpoints_written": self.checkpoints_written,
             "catalog_versions": list(self.catalog_versions),
             "queue": queue,

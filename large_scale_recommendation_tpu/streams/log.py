@@ -11,11 +11,14 @@ partitioned log with the few invariants recovery actually needs:
 
 - **fixed-size binary records** (``RECORD_DTYPE``: user int32, item
   int32, rating float32 — 12 bytes): offset→byte math is trivial, and a
-  torn tail from a crash mid-write is detectable as ``len % 12 != 0``
-  and truncated away on reopen (records are only *acked* — offsets
-  returned to the producer — after the bytes are flushed, and fsync'd
-  when ``fsync=True``, so truncation never discards an acked record on
-  an fsync'd log).
+  torn tail from a crash mid-write is detectable as ``len % 12 != 0``.
+  Opens and reads simply ignore the partial record (it could equally be
+  a live foreign producer's in-flight append, so scanning never
+  mutates); the next *append* — where the single-writer-per-partition
+  contract guarantees no other producer is alive — truncates it away.
+  Records are only *acked* (offsets returned to the producer) after the
+  bytes are flushed, and fsync'd when ``fsync=True``, so the truncated
+  tail is never an acked record on an fsync'd log.
 - **per-partition monotonic offsets**: record k of a partition lives in
   the segment whose base ≤ k, at byte ``HEADER + (k - base) * 12``.
   Offsets never renumber — retention deletes whole segments from the
@@ -40,6 +43,7 @@ import os
 import re
 import struct
 import tempfile
+import threading
 
 import numpy as np
 
@@ -73,6 +77,11 @@ class _Partition:
         # the active (appendable) segment
         self.segments: list[list[int]] = []
         self._fh = None  # append handle for the active segment
+        # guards self.segments against the reader/truncator race: the
+        # driver's consumer thread truncates on checkpoint while the
+        # QueuedSource feeder thread reads the tail (re-entrant: _read
+        # calls refresh)
+        self._lock = threading.RLock()
         self._scan()
 
     # -- recovery-on-open ---------------------------------------------------
@@ -87,24 +96,20 @@ class _Partition:
         for base in found:
             path = self._seg_path(base)
             size = os.path.getsize(path)
-            payload = size - HEADER_SIZE
             if size < HEADER_SIZE:
                 # crash between create and header flush: an empty shell
-                # with no acked records — rewrite the header in place
-                with open(path, "wb") as f:
-                    f.write(_HEADER.pack(_MAGIC, 1, RECORD_SIZE))
-                    f.flush()
-                    os.fsync(f.fileno())
+                # with no acked records
                 payload = 0
             else:
                 self._check_header(path)
-            torn = payload % RECORD_SIZE
-            if torn:
-                # crash mid-append: the tail record was never acked —
-                # truncate it so offset math stays exact
-                with open(path, "r+b") as f:
-                    f.truncate(size - torn)
-                payload -= torn
+                payload = size - HEADER_SIZE
+            # count WHOLE records only; a trailing partial record is
+            # either a crashed writer's torn tail (never acked) or a
+            # LIVE producer's in-flight append from another process —
+            # scanning cannot tell them apart, so it stays read-only
+            # and any repair is deferred to the append path
+            # (``_active_handle``), where the single-writer-per-
+            # partition contract says no other producer is alive
             self.segments.append([base, payload // RECORD_SIZE])
         for (b0, n0), (b1, _) in zip(self.segments, self.segments[1:]):
             if b0 + n0 != b1:
@@ -135,41 +140,43 @@ class _Partition:
         retired. Only whole records are trusted — a concurrent append's
         in-flight torn tail is not yet acked and is ignored — and a
         known count never shrinks (acked state is monotone)."""
-        on_disk: dict[int, int] = {}
-        for name in os.listdir(self.directory):
-            m = _SEG_FILE.match(name)
-            if m:
-                base = int(m.group(1))
-                size = os.path.getsize(os.path.join(self.directory, name))
-                on_disk[base] = max(0, size - HEADER_SIZE) // RECORD_SIZE
-        if not on_disk:
-            return
-        last_known = self.segments[-1][0]
-        self.segments = [s for s in self.segments if s[0] in on_disk]
-        if self.segments and self.segments[-1][0] == last_known:
-            self.segments[-1][1] = max(self.segments[-1][1],
-                                       on_disk[last_known])
-        for base in sorted(on_disk):
-            if base > last_known:
-                self.segments.append([base, on_disk[base]])
-        if not self.segments:  # every known segment retired underneath us
-            floor = min(on_disk)
-            self.segments = [[b, on_disk[b]]
-                             for b in sorted(on_disk) if b >= floor]
-        for (b0, n0), (b1, _) in zip(self.segments, self.segments[1:]):
-            if b0 + n0 != b1:
-                raise ValueError(
-                    f"offset gap in {self.directory}: segment {b0} holds "
-                    f"{n0} records but the next base is {b1}")
+        with self._lock:
+            on_disk: dict[int, int] = {}
+            for name in os.listdir(self.directory):
+                m = _SEG_FILE.match(name)
+                if m:
+                    base = int(m.group(1))
+                    size = os.path.getsize(
+                        os.path.join(self.directory, name))
+                    on_disk[base] = max(0, size - HEADER_SIZE) // RECORD_SIZE
+            if not on_disk:
+                return
+            last_known = self.segments[-1][0]
+            self.segments = [s for s in self.segments if s[0] in on_disk]
+            if self.segments and self.segments[-1][0] == last_known:
+                self.segments[-1][1] = max(self.segments[-1][1],
+                                           on_disk[last_known])
+            for base in sorted(on_disk):
+                if base > last_known:
+                    self.segments.append([base, on_disk[base]])
+            if not self.segments:  # every known segment retired underneath
+                self.segments = [[b, on_disk[b]] for b in sorted(on_disk)]
+            for (b0, n0), (b1, _) in zip(self.segments, self.segments[1:]):
+                if b0 + n0 != b1:
+                    raise ValueError(
+                        f"offset gap in {self.directory}: segment {b0} "
+                        f"holds {n0} records but the next base is {b1}")
 
     @property
     def start_offset(self) -> int:
-        return self.segments[0][0]
+        with self._lock:
+            return self.segments[0][0]
 
     @property
     def end_offset(self) -> int:
-        base, n = self.segments[-1]
-        return base + n
+        with self._lock:
+            base, n = self.segments[-1]
+            return base + n
 
     def _new_segment(self, base: int) -> None:
         if self._fh is not None:
@@ -185,7 +192,27 @@ class _Partition:
 
     def _active_handle(self):
         if self._fh is None:
-            self._fh = open(self._seg_path(self.segments[-1][0]), "ab")
+            path = self._seg_path(self.segments[-1][0])
+            size = os.path.getsize(path)
+            if size < HEADER_SIZE:
+                # crash between create and header flush (empty shell, no
+                # acked records): rewrite the header. Done here — when
+                # this instance claims the writer role — not at scan
+                # time, so read-only opens never mutate a directory a
+                # live foreign producer may be appending to.
+                with open(path, "wb") as f:
+                    f.write(_HEADER.pack(_MAGIC, 1, RECORD_SIZE))
+                    f.flush()
+                    if self.fsync:
+                        os.fsync(f.fileno())
+            else:
+                torn = (size - HEADER_SIZE) % RECORD_SIZE
+                if torn:
+                    # a crashed writer's torn tail (never acked):
+                    # truncate so our appends land on a record boundary
+                    with open(path, "r+b") as f:
+                        f.truncate(size - torn)
+            self._fh = open(path, "ab")
         return self._fh
 
     # -- append -------------------------------------------------------------
@@ -197,18 +224,28 @@ class _Partition:
         start = self.end_offset
         pos = 0
         while pos < len(records):
-            base, n = self.segments[-1]
-            room = self.segment_records - n
-            if room == 0:
-                self._new_segment(base + n)
-                continue
+            with self._lock:
+                base, n = self.segments[-1]
+                room = self.segment_records - n
+                if room <= 0:
+                    # no room — including an active segment HOLDING MORE
+                    # than segment_records (reopened with a smaller
+                    # segment_records): treat it as sealed and roll
+                    self._new_segment(base + n)
+                    continue
             take = min(room, len(records) - pos)
             fh = self._active_handle()
             fh.write(records[pos:pos + take].tobytes())
             fh.flush()
             if self.fsync:
                 os.fsync(fh.fileno())
-            self.segments[-1][1] += take
+            with self._lock:
+                # assign, don't increment: a concurrent reader's
+                # refresh() may already have max-bumped the count from
+                # the flushed file size — incrementing on top of that
+                # double-counts and inflates the count past the file.
+                # Single writer per partition, so n + take is exact.
+                self.segments[-1][1] = n + take
             pos += take
         return start, self.end_offset
 
@@ -222,49 +259,67 @@ class _Partition:
         tailer instance observes another process's appends (and its
         retention); a segment deleted underneath a known range (foreign
         retention) triggers one refresh+retry, so it surfaces as
-        ``LogTruncatedError``, never a raw ``FileNotFoundError``."""
+        ``LogTruncatedError``, never a raw ``FileNotFoundError`` (or a
+        short read from a foreign process's concurrent retention)."""
         try:
             return self._read(start, max_records)
-        except FileNotFoundError:
+        except OSError:  # includes FileNotFoundError and short reads
             self.refresh()
             return self._read(start, max_records)
 
     def _read(self, start: int, max_records: int) -> tuple[np.ndarray, int]:
-        if start >= self.end_offset or start < self.start_offset:
-            self.refresh()
-        if start < self.start_offset:
-            raise LogTruncatedError(
-                f"offset {start} is below the retained floor "
-                f"{self.start_offset} of {self.directory} — those records "
-                "were retired by truncate_before and cannot be replayed")
-        end = min(start + max_records, self.end_offset)
-        if end <= start:
-            return np.empty(0, RECORD_DTYPE), start
-        out = np.empty(end - start, RECORD_DTYPE)
-        filled = 0
-        for base, n in self.segments:
-            lo, hi = max(base, start), min(base + n, end)
-            if lo >= hi:
-                continue
-            with open(self._seg_path(base), "rb") as f:
-                f.seek(HEADER_SIZE + (lo - base) * RECORD_SIZE)
-                buf = f.read((hi - lo) * RECORD_SIZE)
-            out[filled:filled + hi - lo] = np.frombuffer(buf, RECORD_DTYPE)
-            filled += hi - lo
-        return out, end
+        # the whole read is under the partition lock: truncate_before /
+        # refresh cannot reshape self.segments mid-iteration, so the
+        # output buffer is either filled completely or the read raises —
+        # never returned with uninitialized np.empty rows
+        with self._lock:
+            if start >= self.end_offset or start < self.start_offset:
+                self.refresh()
+            if start < self.start_offset:
+                raise LogTruncatedError(
+                    f"offset {start} is below the retained floor "
+                    f"{self.start_offset} of {self.directory} — those "
+                    "records were retired by truncate_before and cannot "
+                    "be replayed")
+            end = min(start + max_records, self.end_offset)
+            if end <= start:
+                return np.empty(0, RECORD_DTYPE), start
+            out = np.empty(end - start, RECORD_DTYPE)
+            filled = 0
+            for base, n in self.segments:
+                lo, hi = max(base, start), min(base + n, end)
+                if lo >= hi:
+                    continue
+                with open(self._seg_path(base), "rb") as f:
+                    f.seek(HEADER_SIZE + (lo - base) * RECORD_SIZE)
+                    buf = f.read((hi - lo) * RECORD_SIZE)
+                if len(buf) != (hi - lo) * RECORD_SIZE:
+                    raise OSError(
+                        f"short read in {self._seg_path(base)}: wanted "
+                        f"records [{lo}, {hi}) but the segment holds less")
+                out[filled:filled + hi - lo] = np.frombuffer(buf,
+                                                             RECORD_DTYPE)
+                filled += hi - lo
+            if filled != end - start:
+                raise OSError(
+                    f"segment gap reading [{start}, {end}) in "
+                    f"{self.directory}: only {filled} of {end - start} "
+                    "records found")
+            return out, end
 
     # -- retention ----------------------------------------------------------
 
     def truncate_before(self, offset: int) -> int:
         """Delete sealed segments whose every record is < ``offset``
         (the active segment always survives). Returns the new floor."""
-        while len(self.segments) > 1:
-            base, n = self.segments[0]
-            if base + n > offset:
-                break
-            os.unlink(self._seg_path(base))
-            self.segments.pop(0)
-        return self.start_offset
+        with self._lock:
+            while len(self.segments) > 1:
+                base, n = self.segments[0]
+                if base + n > offset:
+                    break
+                os.unlink(self._seg_path(base))
+                self.segments.pop(0)
+            return self.start_offset
 
     def close(self) -> None:
         if self._fh is not None:

@@ -92,6 +92,17 @@ class DeadLetterBuffer:
             while self._rows > self.capacity and len(self._chunks) > 1:
                 dropped = self._chunks.pop(0)
                 self._rows -= len(dropped[0])
+            if self._rows > self.capacity:
+                # one chunk bigger than the whole buffer (a shed
+                # batch_records >> capacity): trim its front so the
+                # bound holds — "most recent capacity records", exactly
+                u, i, r = self._chunks[0]
+                excess = self._rows - self.capacity
+                # copy, not slice: a view would keep the full oversized
+                # base arrays alive, defeating the memory bound
+                self._chunks[0] = (u[excess:].copy(), i[excess:].copy(),
+                                   r[excess:].copy())
+                self._rows = self.capacity
             return len(users)
 
     def records(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
@@ -166,8 +177,13 @@ class IngestQueue:
                     self.stats.dead_letter_records += int(real.sum())
                     return False
                 else:  # "drop": shed outright, counted as loss
+                    # count the batch's REAL rating rows, not its offset
+                    # span (batch.n still covers rows _quarantine already
+                    # moved to the dead-letter buffer) — matches the
+                    # dead_letter policy's accounting, no double count
+                    rw = np.asarray(batch.ratings.weights)
                     self.stats.dropped_batches += 1
-                    self.stats.dropped_records += batch.n
+                    self.stats.dropped_records += int((rw > 0).sum())
                     return False
             self._items.append(batch)
             self.stats.enqueued_batches += 1
@@ -416,6 +432,18 @@ class QueuedSource:
             self.source.stop()
         self.queue.close()
 
+    def finish(self) -> None:
+        """Wind the feeder down and surface any fault it hit. A consumer
+        that stops iterating EARLY (``StreamingDriver.run``'s
+        ``max_batches``) never reaches the re-raise at the end of
+        ``batches()`` — it must call this instead, or a feeder crash is
+        silently swallowed."""
+        self.stop()
+        if self._thread is not None:
+            self._thread.join()
+        if self._error is not None:
+            raise self._error
+
     def batches(self) -> Iterator[StreamBatch]:
         self.start()
         while True:
@@ -423,10 +451,7 @@ class QueuedSource:
             if batch is None:
                 break
             yield batch
-        if self._thread is not None:
-            self._thread.join()
-        if self._error is not None:
-            raise self._error
+        self.finish()
 
     def __iter__(self) -> Iterator[StreamBatch]:
         return self.batches()

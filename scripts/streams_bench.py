@@ -82,7 +82,10 @@ def run(num_users=20_000, num_items=5_000, rank=32, n_batches=10,
     with tempfile.TemporaryDirectory() as tmp:
         # ---- log append leg (host-only) -------------------------------
         log = EventLog(os.path.join(tmp, "log"), fsync=fsync)
-        log.append(0, warm)  # file creation / first-segment cost
+        # file creation / first-segment cost; the acked end offset (not
+        # batch_records — append drops weight-0 padding) is where the
+        # timed stream starts
+        _, warm_end = log.append(0, warm)
         t0 = time.perf_counter()
         for b in batches:
             log.append(0, b)
@@ -107,9 +110,9 @@ def run(num_users=20_000, num_items=5_000, rank=32, n_batches=10,
             config=StreamingDriverConfig(
                 batch_records=batch_records,
                 checkpoint_every=checkpoint_every))
-        # the warm batch occupies [0, batch_records) of the log; skip it
-        # so both timed paths train the identical stream
-        model.consumed_offsets[0] = batch_records
+        # the warm batch occupies [0, warm_end) of the log; skip it so
+        # both timed paths train the identical stream
+        model.consumed_offsets[0] = warm_end
         t0 = time.perf_counter()
         applied = drv.run()
         jax.block_until_ready(model.users.array)

@@ -105,6 +105,28 @@ class TestCatchUp:
         assert log.start_offset(0) == 1024  # all but the active segment
         assert log.end_offset(0) == 1280
 
+    def test_lag_is_per_partition(self, tmp_path):
+        # another partition's backlog is NOT this driver's lag
+        # (regression: telemetry used EventLog.lag, which charges every
+        # unconsumed partition from its floor)
+        log = EventLog(str(tmp_path / "log"), num_partitions=2,
+                       fsync=False)
+        gen = SyntheticMFGenerator(num_users=60, num_items=40, rank=4,
+                                   seed=2)
+        pump_to_log(GeneratorSource(gen, 400, num_batches=2), log,
+                    partition=0)
+        pump_to_log(GeneratorSource(gen, 400, num_batches=3), log,
+                    partition=1)
+        drv = StreamingDriver(_online(), log, str(tmp_path / "ckpt"),
+                              partition=0,
+                              config=StreamingDriverConfig(
+                                  batch_records=400))
+        drv.run()
+        tele = drv.telemetry()
+        assert tele["consumed_offset"] == 800
+        assert tele["lag_records"] == 0  # p1's 1200 backlog isn't ours
+        assert log.lag({0: 800}) == 1200  # whole-log view still sees it
+
 
 class _Crash(RuntimeError):
     pass
@@ -187,6 +209,11 @@ class TestCrashRecovery:
                                  batch_records=300))
         assert d2.resume()
         assert d2.consumed_offset == 900  # 3 checkpointed batches
+        # retrain history rebuilt from the log below the restored offset
+        # — the post-restart retrain must not fit from the tail alone
+        assert m2._history_rows == 900
+        assert d2.resume()  # idempotent: the refill resets, no dup rows
+        assert m2._history_rows == 900
         engine = d2.serving_engine(k=3)
         v0 = engine.version
         d2.run()  # replays batch 4 + the tail; offline_every=3 retrains
@@ -213,6 +240,81 @@ class TestCrashRecovery:
         with pytest.raises(_Crash):
             d1.run()
         assert CheckpointManager(mgr_dir).latest_step() is None
+
+    def test_early_stop_surfaces_feeder_fault(self, tmp_path):
+        # run(max_batches=N) exits the consume loop before the feeder's
+        # end-of-stream re-raise — a feeder fault (tail read dying) must
+        # still surface from run(), not be silently swallowed
+        import time
+
+        log = _filled_log(str(tmp_path / "log"), n_batches=3)
+        calls = [0]
+        real_read = log.read
+
+        def read(partition, start, n):
+            calls[0] += 1
+            if calls[0] > 1:
+                raise RuntimeError("tail io fault")
+            return real_read(partition, start, n)
+
+        log.read = read
+        drv = StreamingDriver(_online(), log, str(tmp_path / "ckpt"),
+                              config=StreamingDriverConfig(
+                                  batch_records=400))
+
+        def hold_until_feeder_faults(batch):
+            # deterministic: don't let the consumer exit (which stops
+            # the tail source) before the feeder reaches its fault
+            deadline = time.monotonic() + 30
+            while (drv._source._error is None
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+
+        drv.on_batch = hold_until_feeder_faults
+        with pytest.raises(RuntimeError, match="tail io fault"):
+            drv.run(max_batches=1)
+        # the one applied batch was checkpointed before the fault
+        assert drv.checkpoints_written == 1
+
+    def test_checkpoint_held_while_offset_stamp_frozen(self, tmp_path):
+        # background-retrain window: the model buffers batches WITHOUT
+        # advancing its offset stamp (AdaptiveMF background=True); the
+        # driver must hold checkpoints — each would just re-persist the
+        # pre-retrain offset — and write ONE as soon as the stamp
+        # catches up past the batch (post-swap)
+        log = _filled_log(str(tmp_path / "log"), n_batches=3)
+        model = _online()
+        real_fit = model.partial_fit
+        frozen = [True]  # first two batches: simulate the buffer window
+
+        def fit(batch, offset=None, emit_updates=False):
+            return real_fit(
+                batch, offset=None if frozen[0] else offset,
+                emit_updates=emit_updates)
+
+        model.partial_fit = fit
+
+        seen = [0]
+
+        def unfreeze_after_2(batch):
+            seen[0] += 1
+            if seen[0] >= 2:
+                frozen[0] = False
+
+        drv = StreamingDriver(model, log, str(tmp_path / "ckpt"),
+                              config=StreamingDriverConfig(
+                                  batch_records=400),
+                              on_batch=unfreeze_after_2)
+        drv.run()
+        # batches 1-2 held (stamp frozen at 0), batch 3 stamps 1200 and
+        # writes the single covering checkpoint
+        assert drv.checkpoints_written == 1
+        assert drv.consumed_offset == 1200
+        d2 = StreamingDriver(_online(), log, str(tmp_path / "ckpt"),
+                             config=StreamingDriverConfig(
+                                 batch_records=400))
+        assert d2.resume()
+        assert d2.consumed_offset == 1200
 
 
 class TestOfflineStateRoundtrip:

@@ -90,6 +90,27 @@ class TestSegments:
         with pytest.raises(ValueError, match="renumber"):
             EventLog(str(tmp_path), num_partitions=4, fsync=False)
 
+    def test_reopen_with_smaller_segment_records(self, tmp_path):
+        # segment_records may shrink across opens, leaving the active
+        # segment OVER-full; the next append must seal it and roll
+        # (regression: negative room corrupted the segment counts and
+        # made acked offsets unreadable)
+        log = EventLog(str(tmp_path), segment_records=256, fsync=False)
+        b = _batch(200)
+        log.append(0, b)
+        log.close()
+        log2 = EventLog(str(tmp_path), segment_records=16, fsync=False)
+        b2 = _batch(40, seed=1)
+        assert log2.append(0, b2) == (200, 240)
+        assert [tuple(s) for s in log2._parts[0].segments] == [
+            (0, 200), (200, 16), (216, 16), (232, 8)]
+        out, nxt = log2.read(0, 190, 30)  # spans the over-full boundary
+        assert (out.n, nxt) == (30, 220)
+        np.testing.assert_array_equal(out.users[:10],
+                                      np.asarray(b.users)[190:])
+        np.testing.assert_array_equal(out.users[10:],
+                                      np.asarray(b2.users)[:20])
+
 
 class TestCrashRecovery:
     def test_torn_tail_truncated_on_reopen(self, tmp_path):
@@ -180,3 +201,77 @@ class TestRetention:
         log.truncate_before(0, 10 ** 9)  # beyond the end
         assert log.start_offset(0) == 32  # active tail never deleted
         assert log.append(0, _batch(4, seed=1)) == (40, 44)
+
+    def test_concurrent_tail_read_and_truncate(self, tmp_path):
+        # the driver's built-in race (truncate_log=True): the consumer
+        # thread truncates on every checkpoint while the feeder thread
+        # reads the tail — reads must return complete, correct data or
+        # raise, never silently hand back uninitialized buffer rows
+        import threading
+
+        log = EventLog(str(tmp_path), segment_records=32, fsync=False)
+        n = 4096
+        idx = np.arange(n)
+        log.append_arrays(0, idx % 997, idx % 991,
+                          idx.astype(np.float32))  # rating == offset
+        consumed = [0]
+        errors = []
+
+        def reader():
+            try:
+                off = 0
+                while off < n:
+                    out, nxt = log.read(0, off, 100)
+                    np.testing.assert_array_equal(
+                        np.asarray(out.ratings),
+                        np.arange(off, nxt, dtype=np.float32))
+                    off = nxt
+                    consumed[0] = off
+            except Exception as exc:  # surfaced to the main thread
+                errors.append(exc)
+                consumed[0] = n
+
+        t = threading.Thread(target=reader)
+        t.start()
+        while consumed[0] < n:  # truncate as fast as the reader commits
+            log.truncate_before(0, consumed[0])
+        t.join(timeout=30)
+        assert not errors
+        assert consumed[0] == n
+
+    def test_concurrent_append_and_tail_read(self, tmp_path):
+        # same-instance producer + tailer: a read at the end triggers
+        # refresh(), which max-bumps the active count from the flushed
+        # file size while the appender is between flush and bookkeeping
+        # (regression: += on top of that bump double-counted, inflating
+        # the in-memory count past the file — tail reads then died with
+        # short-read errors)
+        import threading
+        import time
+
+        log = EventLog(str(tmp_path), segment_records=64, fsync=False)
+        n = 3000
+        errors = []
+
+        def writer():
+            try:
+                for k in range(0, n, 50):
+                    idx = np.arange(k, k + 50)
+                    log.append_arrays(0, idx % 997, idx % 991,
+                                      idx.astype(np.float32))
+            except Exception as exc:  # surfaced to the main thread
+                errors.append(exc)
+
+        t = threading.Thread(target=writer)
+        t.start()
+        off = 0
+        deadline = time.monotonic() + 30
+        while off < n and time.monotonic() < deadline:
+            out, nxt = log.read(0, off, 75)
+            np.testing.assert_array_equal(
+                np.asarray(out.ratings),
+                np.arange(off, nxt, dtype=np.float32))
+            off = nxt
+        t.join(timeout=30)
+        assert not errors
+        assert off == n

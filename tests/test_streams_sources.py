@@ -132,6 +132,19 @@ class TestIngestQueue:
         assert q.stats.dropped_batches == 3
         assert q.stats.dropped_records == 30
 
+    def test_drop_policy_counts_real_rows_not_offset_span(self):
+        # a quarantined batch keeps its full [start, end) stamp but
+        # holds fewer real rows — drop accounting counts the rows
+        # actually lost (matching the dead_letter policy), so rows
+        # already in the dead-letter buffer are not double-counted
+        q = IngestQueue(capacity=1, policy="drop")
+        assert q.put(_sbatch(10, start=0))
+        shed = StreamBatch(ratings=_sbatch(6, seed=1).ratings.pad_to(16),
+                           partition=0, start_offset=10, end_offset=20)
+        assert not q.put(shed)
+        assert q.stats.dropped_batches == 1
+        assert q.stats.dropped_records == 6  # not shed.n == 10
+
     def test_dead_letter_policy_is_recoverable(self):
         q = IngestQueue(capacity=1, policy="dead_letter")
         assert q.put(_sbatch(10, start=0))
@@ -145,6 +158,41 @@ class TestIngestQueue:
     def test_invalid_policy_refused(self):
         with pytest.raises(ValueError, match="policy"):
             IngestQueue(policy="explode")
+
+    def test_dead_letter_buffer_bound_holds_for_oversized_chunk(self):
+        # one shed chunk larger than the whole buffer must be trimmed
+        # to the newest `capacity` records, not retained whole
+        from large_scale_recommendation_tpu.streams.sources import (
+            DeadLetterBuffer,
+        )
+
+        buf = DeadLetterBuffer(capacity=100)
+        idx = np.arange(300)
+        buf.put(idx, idx, idx.astype(np.float32))
+        assert len(buf) == 100
+        assert buf.total == 300  # lifetime counter still sees all
+        u, _, _ = buf.records()
+        np.testing.assert_array_equal(u, np.arange(200, 300))
+
+    def test_early_exit_consumer_sees_feeder_fault_via_finish(self):
+        # a consumer that breaks out of batches() early (the driver's
+        # max_batches path) never reaches the end-of-stream re-raise;
+        # finish() must surface the feeder's fault instead
+        def faulty():
+            yield _sbatch(10, start=0)
+            yield _sbatch(10, start=10)
+            raise RuntimeError("boom")
+
+        qs = QueuedSource(faulty(), capacity=4)
+        it = qs.batches()
+        assert next(it).start_offset == 0
+        # capacity 4 > 2 batches: the feeder never blocks, so it always
+        # runs through to its fault — wait for it so the test is
+        # deterministic (finish() only surfaces faults the feeder HIT;
+        # stopping a healthy feeder early is not a fault)
+        qs._thread.join(timeout=30)
+        with pytest.raises(RuntimeError, match="boom"):
+            qs.finish()
 
 
 class TestPoisonQuarantine:
