@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 import weakref
 from typing import Iterable, Iterator, Literal
 
@@ -55,6 +56,8 @@ from large_scale_recommendation_tpu.models.online import (
     OnlineMF,
     OnlineMFConfig,
 )
+from large_scale_recommendation_tpu.obs.registry import get_registry
+from large_scale_recommendation_tpu.obs.trace import get_tracer
 
 
 @dataclasses.dataclass(frozen=True)
@@ -110,6 +113,14 @@ class AdaptiveMF:
         # swap's refresh sweep would serve stale factors until the NEXT
         # swap
         self._engines_lock = threading.Lock()
+        # observability (null singletons when disabled): retrain count/
+        # duration plus retrain+swap spans — the trace view of the
+        # Online → Batch → swap state machine
+        obs = get_registry()
+        self._obs_on = obs.enabled
+        self._trace = get_tracer()
+        self._m_retrains = obs.counter("adaptive_retrains_total")
+        self._m_retrain_s = obs.histogram("adaptive_retrain_s")
         self._manager = None
         if cfg.checkpoint_dir is not None:
             from large_scale_recommendation_tpu.utils.checkpoint import (
@@ -243,17 +254,35 @@ class AdaptiveMF:
         (:125-131).
         """
         cfg = self.config
-        if cfg.offline_algorithm == "als":
-            return ALS(ALSConfig(
-                num_factors=cfg.num_factors, lambda_=cfg.lambda_,
-                iterations=cfg.offline_iterations,
-            )).fit(history)
-        return DSGD(DSGDConfig(
-            num_factors=cfg.num_factors, lambda_=cfg.lambda_,
-            iterations=cfg.offline_iterations,
-            learning_rate=0.05, lr_schedule="constant",
-            minibatch_size=min(cfg.minibatch_size, 1024),
-        )).fit(history)
+        # retrain span runs on whichever thread retrains (background
+        # mode gets its own tid lane in the trace) and blocks on the
+        # fitted tables so device time is inside the span
+        with self._trace.span("adaptive/retrain",
+                              algorithm=cfg.offline_algorithm,
+                              rows=int(history.n)) as sp:
+            t0 = time.perf_counter() if self._obs_on else 0.0
+            if cfg.offline_algorithm == "als":
+                model = ALS(ALSConfig(
+                    num_factors=cfg.num_factors, lambda_=cfg.lambda_,
+                    iterations=cfg.offline_iterations,
+                )).fit(history)
+            else:
+                model = DSGD(DSGDConfig(
+                    num_factors=cfg.num_factors, lambda_=cfg.lambda_,
+                    iterations=cfg.offline_iterations,
+                    learning_rate=0.05, lr_schedule="constant",
+                    minibatch_size=min(cfg.minibatch_size, 1024),
+                )).fit(history)
+            sp.out = (model.U, model.V)
+            if self._obs_on:
+                from large_scale_recommendation_tpu.utils.metrics import (
+                    block,
+                )
+
+                block(sp.out)  # device time belongs in the measurement
+                self._m_retrain_s.observe(time.perf_counter() - t0)
+                self._m_retrains.inc()
+        return model
 
     def _retrain_into_slot(self, history: Ratings) -> None:
         self._retrained = self._retrain(history)

@@ -84,15 +84,25 @@ class ALS:
             i_rows, u_rows, rv, items.num_rows, min_pad=cfg.min_pad)
 
         U, V = self._init_factors(users, items)
-        U, V = als_ops.als_train_planned(
-            U, V, user_plan, item_plan,
-            users.omega, items.omega,
-            lambda_=cfg.lambda_,
-            iterations=cfg.iterations,
-            reg_mode=cfg.reg_mode,
-            implicit_alpha=cfg.implicit_alpha,
-            gram_dtype=gram_dtype,
+        from large_scale_recommendation_tpu.obs.instrument import (
+            TrainSegmentTimer,
         )
+
+        timer = TrainSegmentTimer(
+            "als", "als_planned",
+            shape_key=(tuple(np.shape(U)), tuple(np.shape(V))))
+        with timer.segment(cfg.iterations) as h:
+            U, V = als_ops.als_train_planned(
+                U, V, user_plan, item_plan,
+                users.omega, items.omega,
+                lambda_=cfg.lambda_,
+                iterations=cfg.iterations,
+                reg_mode=cfg.reg_mode,
+                implicit_alpha=cfg.implicit_alpha,
+                gram_dtype=gram_dtype,
+            )
+            h.out = (U, V)
+        timer.finish(int(len(ru)))
         self.model = MFModel(U=U, V=V, users=users, items=items)
         return self.model
 
@@ -156,10 +166,20 @@ class ALS:
         V = init(np.arange(num_items, dtype=np.int32)) \
             * (omega_v > 0)[:, None]
 
-        U, V = als_ops.als_rounds(
-            V, prep_u, prep_v, num_users, num_items, cfg.lambda_,
-            cfg.iterations, implicit=cfg.implicit_alpha is not None,
-            gram_dtype=gram_dtype)
+        from large_scale_recommendation_tpu.obs.instrument import (
+            TrainSegmentTimer,
+        )
+
+        timer = TrainSegmentTimer(
+            "als", "als_device_rounds",
+            shape_key=((num_users, k), tuple(np.shape(V))))
+        with timer.segment(cfg.iterations) as h:
+            U, V = als_ops.als_rounds(
+                V, prep_u, prep_v, num_users, num_items, cfg.lambda_,
+                cfg.iterations, implicit=cfg.implicit_alpha is not None,
+                gram_dtype=gram_dtype)
+            h.out = (U, V)
+        timer.finish(int(np.shape(u)[0]))
 
         # dense-vocab IdIndex pair with host-path semantics (ids unseen in
         # training stay unknown → predict 0, dropped from risk)

@@ -146,12 +146,13 @@ class DSGD:
         U, V = self._train_segments(
             U, V, args, k, "dsgd_segment",
             checkpoint_manager, checkpoint_every, resume,
+            n_ratings=int(ratings.n),
         )
         self.model = MFModel(U=U, V=V, users=problem.users, items=problem.items)
         return self.model
 
     def _train_segments(self, U, V, args, k, kind, checkpoint_manager,
-                        checkpoint_every, resume):
+                        checkpoint_every, resume, n_ratings=None):
         """Shared segment loop + checkpoint/resume for both blocking paths.
 
         ``kind`` tags snapshots with the path that wrote them: host (fit)
@@ -159,7 +160,16 @@ class DSGD:
         (independently seeded permutations), so resuming across paths would
         attach restored factor rows to the wrong ids — same-shape tables,
         silently wrong model. The kind check turns that into an error.
+
+        With observability enabled (``obs.enable()``), each segment gets a
+        blocked wall-clock measurement (``train_segment_s{model="dsgd"}``
+        + a compile-keyed span) and ``finish`` publishes the
+        warmup-excluded throughput gauge; ``n_ratings`` is the
+        per-iteration unit count (ratings visited per sweep).
         """
+        from large_scale_recommendation_tpu.obs.instrument import (
+            TrainSegmentTimer,
+        )
         from large_scale_recommendation_tpu.utils.checkpoint import (
             restore_segment_state,
         )
@@ -176,15 +186,22 @@ class DSGD:
         # static args (frozen-dataclass updater) → refits/segments with the
         # same shapes/config hit the XLA compile cache.
         train = self._train_fn(args)
+        timer = TrainSegmentTimer(
+            "dsgd", kind,
+            shape_key=(tuple(np.shape(U)), tuple(np.shape(V)),
+                       tuple(np.shape(args[0]))))
         while done < cfg.iterations:
             seg = min(segment, cfg.iterations - done)
-            U, V = train(U, V, iterations=seg, t0=done, k=k)
+            with timer.segment(seg) as h:
+                U, V = train(U, V, iterations=seg, t0=done, k=k)
+                h.out = (U, V)
             done += seg
             if checkpoint_manager is not None:
                 checkpoint_manager.save(
                     done, {"U": np.asarray(U), "V": np.asarray(V)},
                     {"kind": kind, "iterations": cfg.iterations},
                 )
+        timer.finish(n_ratings)
         return U, V
 
     def _train_fn(self, args):
@@ -282,6 +299,7 @@ class DSGD:
         U, V = self._train_segments(
             U, V, args, k, "dsgd_device_segment",
             checkpoint_manager, checkpoint_every, resume,
+            n_ratings=int(np.shape(u)[0]),
         )
         users, items = p.to_id_indices()
         self.model = MFModel(U=U, V=V, users=users, items=items)

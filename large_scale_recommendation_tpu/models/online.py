@@ -31,6 +31,7 @@ exactly the user/item vectors touched by the batch (≙ emitting
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Iterable, Iterator
 
 import jax.numpy as jnp
@@ -48,6 +49,8 @@ from large_scale_recommendation_tpu.core.types import (
 )
 from large_scale_recommendation_tpu.core.updaters import SGDUpdater
 from large_scale_recommendation_tpu.data.tables import GrowableFactorTable
+from large_scale_recommendation_tpu.obs.registry import get_registry
+from large_scale_recommendation_tpu.obs.trace import get_tracer
 from large_scale_recommendation_tpu.ops import sgd as sgd_ops
 from large_scale_recommendation_tpu.utils.shapes import pow2_pad
 
@@ -179,6 +182,14 @@ class OnlineMF:
         # reusable padding buffers keyed by padded length (bounded: padded
         # lengths are pow2 buckets of the minibatch)
         self._pad_buffers: dict[int, tuple] = {}
+        # observability (null singletons when disabled — no clock reads,
+        # no blocking on the async dispatch path)
+        obs = get_registry()
+        self._obs_on = obs.enabled
+        self._trace = get_tracer()
+        self._m_batch_s = obs.histogram("online_batch_s")
+        self._m_batches = obs.counter("online_batches_total")
+        self._m_ratings = obs.counter("online_ratings_total")
 
     # -- training ----------------------------------------------------------
 
@@ -215,6 +226,7 @@ class OnlineMF:
             return (BatchUpdates([], [], rank=cfg.num_factors)
                     if emit_updates else None)
 
+        t0 = time.perf_counter() if self._obs_on else 0.0
         u_rows = self.users.ensure(ru)
         i_rows = self.items.ensure(ri)
 
@@ -223,19 +235,33 @@ class OnlineMF:
             buffers=self._pad_buffers,
         )
 
-        U, V = sgd_ops.online_train(
-            self.users.array, self.items.array,
-            jnp.asarray(ur), jnp.asarray(ir),
-            jnp.asarray(vals), jnp.asarray(w),
-            updater=self.updater,
-            minibatch=cfg.minibatch_size,
-            iterations=(iterations if iterations is not None
-                        else cfg.iterations_per_batch),
-            collision=cfg.collision_mode,
-        )
+        # compile-keyed span: each pow2-padded batch length compiles its
+        # own online_train variant — the trace labels that first batch
+        # "compile", steady-state batches "execute"
+        with self._trace.span("online/partial_fit",
+                              key=("online_train", len(ur)),
+                              records=len(ru)) as sp:
+            U, V = sgd_ops.online_train(
+                self.users.array, self.items.array,
+                jnp.asarray(ur), jnp.asarray(ir),
+                jnp.asarray(vals), jnp.asarray(w),
+                updater=self.updater,
+                minibatch=cfg.minibatch_size,
+                iterations=(iterations if iterations is not None
+                            else cfg.iterations_per_batch),
+                collision=cfg.collision_mode,
+            )
+            sp.out = U
         self.users.array = U
         self.items.array = V
         self.step += 1
+        if self._obs_on:
+            # block so the histogram reads device time, not dispatch
+            # (enabled-only: the uninstrumented path stays async)
+            U.block_until_ready()
+            self._m_batch_s.observe(time.perf_counter() - t0)
+            self._m_batches.inc()
+            self._m_ratings.inc(len(ru))
         if offset is not None:
             # stamped only now, with the update APPLIED: an offset in
             # consumed_offsets always means "this slice is in the

@@ -1,0 +1,98 @@
+"""Unified observability: metrics registry + JAX-aware span tracing.
+
+The paper's whole argument is a *comparison* of execution styles
+(offline DSGD, PS offline, combined online+batch, pure streaming), and a
+comparison is only as good as its instrumentation: ALX (arXiv:2112.02194)
+attributes its TPU MF wins via step-level timing breakdowns, and FLAME
+(arXiv:2509.22681) stands on per-request latency percentiles. This
+package is that instrumentation layer, shared by every runtime tier:
+
+- ``obs.registry`` — a thread-safe ``MetricsRegistry`` of labeled
+  counters, gauges, and log-bucketed histograms (p50/p90/p99), with
+  snapshot / JSONL / Prometheus-text exporters.
+- ``obs.trace`` — a nested-span ``Tracer`` (context-manager API,
+  thread-local span stack) that is JAX-aware: spans can
+  ``block_until_ready`` their outputs so async dispatch doesn't hide
+  device time, and a compile-key hook labels first-call spans
+  ``compile`` vs steady-state ``execute``. Exports Chrome trace-event
+  JSON loadable in Perfetto (https://ui.perfetto.dev).
+
+Zero-cost when disabled — the design invariant every instrumented hot
+path relies on: the module-level defaults are a ``NullRegistry`` and
+``NullTracer`` whose instruments are shared stateless singletons (no
+locks, no allocations, no clock reads). Call sites cache
+``registry.enabled`` once and skip even ``perf_counter`` when off.
+
+Usage::
+
+    from large_scale_recommendation_tpu import obs
+
+    reg, tracer = obs.enable()         # install live registry + tracer
+    ...  # build engines/drivers/models AFTER enabling: instruments
+    ...  # bind at construction time
+    print(reg.to_prometheus())
+    reg.append_jsonl("metrics.jsonl")
+    tracer.to_chrome_trace("trace.json")
+    obs.disable()                      # back to the null layer
+
+See docs/OBSERVABILITY.md for the metric-name catalog and span taxonomy.
+"""
+
+from __future__ import annotations
+
+from large_scale_recommendation_tpu.obs.registry import (
+    MetricsRegistry,
+    NullRegistry,
+    get_registry,
+    set_registry,
+)
+from large_scale_recommendation_tpu.obs.trace import (
+    NullTracer,
+    Tracer,
+    get_tracer,
+    set_tracer,
+    validate_chrome_trace,
+)
+
+__all__ = [
+    "MetricsRegistry",
+    "NullRegistry",
+    "Tracer",
+    "NullTracer",
+    "get_registry",
+    "set_registry",
+    "get_tracer",
+    "set_tracer",
+    "validate_chrome_trace",
+    "enable",
+    "disable",
+    "enabled",
+]
+
+
+def enable(registry: MetricsRegistry | None = None,
+           tracer: Tracer | None = None):
+    """Install a live registry + tracer as the module-level defaults.
+
+    Returns ``(registry, tracer)``. Instrumented components read the
+    defaults at construction time, so enable BEFORE building the
+    engines/drivers/models you want instrumented."""
+    registry = registry or MetricsRegistry()
+    tracer = tracer or Tracer()
+    set_registry(registry)
+    set_tracer(tracer)
+    return registry, tracer
+
+
+def disable() -> None:
+    """Restore the zero-cost null registry/tracer defaults."""
+    from large_scale_recommendation_tpu.obs import registry as _r
+    from large_scale_recommendation_tpu.obs import trace as _t
+
+    set_registry(_r.NULL_REGISTRY)
+    set_tracer(_t.NULL_TRACER)
+
+
+def enabled() -> bool:
+    """Whether a live (non-null) registry is currently installed."""
+    return get_registry().enabled

@@ -1,0 +1,350 @@
+"""Nested-span tracer, JAX-aware, exporting Chrome trace-event JSON.
+
+The timing problem this solves is JAX-specific: device execution is
+asynchronous, so a naive ``perf_counter`` bracket around a jitted call
+measures *dispatch*, not compute — and the first call at a new shape
+hides an XLA compile inside it. The tracer makes both visible:
+
+- ``span(name, ...)`` is a context manager; the yielded ``Span`` takes
+  ``span.out = result`` and the tracer ``block_until_ready``s it before
+  stopping the clock, so the recorded duration includes the device work
+  that produced it.
+- ``span(name, key=...)`` is the compile-event hook: the first time a
+  given key is seen the span is categorized ``"compile"`` (the call
+  carried the XLA compile), every later sighting ``"execute"`` — the
+  ALX-style first-call/steady-state split, distinguishable in the
+  exported trace. ``install_jax_compile_hook()`` additionally taps
+  ``jax.monitoring`` (where available) so backend-reported compile
+  durations land in the registry as ``jax_compile_s``.
+
+Spans nest via a thread-local stack (each thread traces independently;
+a background retrain thread's spans carry its own ``tid``), and export
+as Chrome trace-event *complete* events (``"ph": "X"``, microsecond
+``ts``/``dur``) — load the JSON at https://ui.perfetto.dev or
+``chrome://tracing``. ``validate_chrome_trace`` is the schema contract
+the golden test pins.
+
+``NullTracer`` is the zero-cost disabled twin: ``span()`` returns one
+shared stateless no-op context manager.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any
+
+# cap on buffered events: a runaway instrumented loop must not grow the
+# host heap without bound; overflow is counted, not silently dropped
+DEFAULT_MAX_EVENTS = 200_000
+
+
+def _block(x: Any) -> None:
+    """Block until device work producing ``x`` (array or pytree) is done.
+    Host-only values pass through untouched."""
+    if x is None:
+        return
+    import jax
+
+    for leaf in jax.tree_util.tree_leaves(x):
+        if hasattr(leaf, "block_until_ready"):
+            leaf.block_until_ready()
+
+
+class Span:
+    """One open span. Set ``out`` to the computation's result (array or
+    pytree) to have the tracer sync on it before the clock stops; add
+    display attributes via ``args``."""
+
+    __slots__ = ("name", "cat", "t0", "args", "out", "_tracer")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str, args: dict):
+        self._tracer = tracer
+        self.name = name
+        self.cat = cat
+        self.args = args
+        self.out = None
+        self.t0 = 0.0
+
+    def __enter__(self) -> "Span":
+        stack = self._tracer._stack()
+        stack.append(self)
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if self.out is not None:
+            _block(self.out)
+        t1 = time.perf_counter()
+        stack = self._tracer._stack()
+        if stack and stack[-1] is self:
+            stack.pop()
+        self._tracer._record(self, t1)
+
+
+class _NullSpan:
+    """Shared stateless no-op span/context manager — the whole disabled
+    tracing path is two attribute lookups and two no-op calls."""
+
+    __slots__ = ()
+    name = ""
+    cat = ""
+    args: dict = {}
+
+    # writes to .out on the shared singleton are dropped (it has no
+    # per-instance storage), which is exactly the point
+    @property
+    def out(self):
+        return None
+
+    @out.setter
+    def out(self, value):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        pass
+
+
+NULL_SPAN = _NullSpan()
+
+
+class Tracer:
+    """Collects spans into a Chrome-trace event buffer.
+
+    Thread-safe: the event buffer append is locked; the span stack and
+    the perf-counter origin are thread-local / immutable."""
+
+    enabled = True
+
+    def __init__(self, max_events: int = DEFAULT_MAX_EVENTS):
+        self.max_events = int(max_events)
+        self.dropped = 0
+        self._events: list[dict] = []
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._compile_keys: set = set()
+        # perf_counter → epoch-anchored microseconds, so traces from
+        # separate processes can be laid side by side
+        self._origin = time.time() - time.perf_counter()
+
+    def _stack(self) -> list:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    # -- span API -----------------------------------------------------------
+
+    def span(self, name: str, key: Any = None, **args) -> Span:
+        """Open a span (use as a context manager).
+
+        ``key`` opts into compile/execute categorization: the first span
+        with a given key is labeled ``compile`` (it pays the trace+XLA
+        compile of whatever jitted computation it wraps), later ones
+        ``execute``. Keys must be hashable; a good key is
+        (fn_name, shape-tuple)."""
+        cat = "span"
+        if key is not None:
+            with self._lock:
+                if key in self._compile_keys:
+                    cat = "execute"
+                else:
+                    self._compile_keys.add(key)
+                    cat = "compile"
+        return Span(self, name, cat, args)
+
+    def depth(self) -> int:
+        """Current nesting depth on the calling thread."""
+        return len(self._stack())
+
+    def _record(self, span: Span, t1: float) -> None:
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self.dropped += 1
+                return
+            self._events.append({
+                "name": span.name,
+                "cat": span.cat,
+                "ph": "X",
+                "ts": (span.t0 + self._origin) * 1e6,
+                "dur": (t1 - span.t0) * 1e6,
+                "pid": os.getpid(),
+                "tid": threading.get_ident(),
+                "args": span.args,
+            })
+
+    def instant(self, name: str, **args) -> None:
+        """Record a zero-duration instant event (``"ph": "i"``) — swap
+        markers, checkpoint boundaries."""
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self.dropped += 1
+                return
+            self._events.append({
+                "name": name,
+                "cat": "instant",
+                "ph": "i",
+                "s": "t",
+                "ts": (time.perf_counter() + self._origin) * 1e6,
+                "pid": os.getpid(),
+                "tid": threading.get_ident(),
+                "args": args,
+            })
+
+    # -- JAX compile hook ----------------------------------------------------
+
+    def install_jax_compile_hook(self, registry=None) -> bool:
+        """Tap ``jax.monitoring`` duration events: backend compile events
+        land in ``registry`` (default: the module-level one) as a
+        ``jax_compile_s`` histogram and in the trace as instant events.
+        Returns whether the hook could be installed (older jax versions
+        may lack the API)."""
+        try:
+            from jax import monitoring
+        except ImportError:  # pragma: no cover - version-dependent
+            return False
+        register = getattr(monitoring,
+                           "register_event_duration_secs_listener", None)
+        if register is None:  # pragma: no cover - version-dependent
+            return False
+        if registry is None:
+            from large_scale_recommendation_tpu.obs.registry import (
+                get_registry,
+            )
+
+            registry = get_registry()
+
+        def _listener(event: str, duration: float, **kwargs) -> None:
+            if "compile" not in event:
+                return
+            registry.histogram("jax_compile_s", event=event).observe(duration)
+            self.instant("jax_compile", event=event, duration_s=duration)
+
+        register(_listener)
+        return True
+
+    # -- export -------------------------------------------------------------
+
+    def events(self) -> list[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self.dropped = 0
+
+    def chrome_trace(self) -> dict:
+        """The Chrome trace-event JSON document (``traceEvents`` array,
+        complete events with µs timestamps) — Perfetto-loadable."""
+        return {"traceEvents": self.events(), "displayTimeUnit": "ms"}
+
+    def to_chrome_trace(self, path: str) -> dict:
+        doc = self.chrome_trace()
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return doc
+
+
+class NullTracer(Tracer):
+    """Disabled tracer: every span is the shared no-op singleton."""
+
+    enabled = False
+
+    def __init__(self):  # no buffer, no lock
+        self.max_events = 0
+        self.dropped = 0
+
+    def span(self, name: str, key: Any = None, **args):
+        return NULL_SPAN
+
+    def instant(self, name: str, **args) -> None:
+        pass
+
+    def depth(self) -> int:
+        return 0
+
+    def install_jax_compile_hook(self, registry=None) -> bool:
+        return False
+
+    def events(self) -> list[dict]:
+        return []
+
+    def clear(self) -> None:
+        pass
+
+
+NULL_TRACER = NullTracer()
+_TRACER: Tracer = NULL_TRACER
+
+
+def get_tracer() -> Tracer:
+    """The module-level default tracer (null unless ``obs.enable()``
+    installed a live one)."""
+    return _TRACER
+
+
+def set_tracer(tracer: Tracer) -> None:
+    global _TRACER
+    _TRACER = tracer
+
+
+def validate_chrome_trace(doc: dict) -> list[dict]:
+    """Schema contract for exported traces (the golden test pins this):
+
+    - top level: ``{"traceEvents": [...]}``
+    - every complete event: string ``name``/``cat``, ``ph == "X"``,
+      numeric ``ts``, non-negative ``dur``, int ``pid``/``tid``,
+      dict ``args``
+    - events on one thread NEST: two complete events on the same tid
+      either don't overlap in time or one contains the other — partial
+      overlap means the span stack was corrupted
+
+    Returns the complete events; raises ``ValueError`` on violation."""
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        raise ValueError("trace document must have a traceEvents array")
+    events = doc["traceEvents"]
+    if not isinstance(events, list):
+        raise ValueError("traceEvents must be a list")
+    complete = []
+    for e in events:
+        if not isinstance(e, dict) or not isinstance(e.get("name"), str):
+            raise ValueError(f"bad event (name): {e!r}")
+        ph = e.get("ph")
+        if ph not in ("X", "i"):
+            raise ValueError(f"unexpected phase {ph!r} in {e.get('name')!r}")
+        if not isinstance(e.get("ts"), (int, float)):
+            raise ValueError(f"bad ts in {e['name']!r}")
+        if not isinstance(e.get("pid"), int) or not isinstance(
+                e.get("tid"), int):
+            raise ValueError(f"bad pid/tid in {e['name']!r}")
+        if ph == "X":
+            if not isinstance(e.get("dur"), (int, float)) or e["dur"] < 0:
+                raise ValueError(f"bad dur in {e['name']!r}")
+            if not isinstance(e.get("args"), dict):
+                raise ValueError(f"bad args in {e['name']!r}")
+            complete.append(e)
+    by_tid: dict[int, list[dict]] = {}
+    for e in complete:
+        by_tid.setdefault(e["tid"], []).append(e)
+    for tid, evs in by_tid.items():
+        evs = sorted(evs, key=lambda e: (e["ts"], -e["dur"]))
+        open_stack: list[tuple[float, str]] = []
+        for e in evs:
+            end = e["ts"] + e["dur"]
+            while open_stack and open_stack[-1][0] <= e["ts"]:
+                open_stack.pop()
+            # float µs round-trips through JSON can wiggle by sub-µs;
+            # tolerate that at the containment check
+            if open_stack and end > open_stack[-1][0] + 0.5:
+                raise ValueError(
+                    f"events overlap without nesting on tid {tid}: "
+                    f"{e['name']!r} ends after enclosing "
+                    f"{open_stack[-1][1]!r}")
+            open_stack.append((end, e["name"]))
+    return complete

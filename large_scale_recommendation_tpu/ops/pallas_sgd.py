@@ -465,21 +465,54 @@ def probe_variants(rank: int = 128, mb: int = 2048, rpb_u: int = 10160,
             lr=lr, lam=lam, minibatch=mb, gather="loop",
             interpret=interpret)),
     }
+    from large_scale_recommendation_tpu.obs.registry import get_registry
+    from large_scale_recommendation_tpu.obs.trace import get_tracer
+
+    obs = get_registry()
+    tracer = get_tracer()
+    sort_lbl = str(bool(sort)).lower()
     out: dict = {}
     for label in variants:
         fn = all_variants[label]
         try:
-            jax.block_until_ready(fn())
+            # the warm-up call carries the compile — its span (keyed per
+            # variant/shape) labels "compile" in the exported trace, so
+            # a Perfetto view separates Mosaic/XLA compile wall from the
+            # kernel's steady-state reps
+            with tracer.span(f"pallas_probe/{label}",
+                             key=("pallas_probe", label, rank, mb, sort),
+                             rank=rank, mb=mb) as sp:
+                # block HERE, not via sp.out: the null tracer's span
+                # drops .out without blocking, and the deferred device
+                # error must surface inside this try to be recorded as
+                # a FAILED variant (and the timed reps must not overlap
+                # a still-running warm-up)
+                r = fn()
+                jax.block_until_ready(r)
+                sp.out = r
         except Exception as ex:
             out[label] = f"FAILED {type(ex).__name__}: {str(ex)[:200]}"
+            if obs.enabled:
+                obs.counter("pallas_probe_failures_total",
+                            variant=label).inc()
             continue
         walls = []
         for _ in range(reps):
-            t0 = time.perf_counter()
-            r = fn()
-            jax.block_until_ready(r)
-            walls.append(time.perf_counter() - t0)
+            with tracer.span(f"pallas_probe/{label}",
+                             key=("pallas_probe", label, rank, mb, sort),
+                             rank=rank, mb=mb) as sp:
+                t0 = time.perf_counter()
+                r = fn()
+                jax.block_until_ready(r)
+                walls.append(time.perf_counter() - t0)
+                sp.out = r
         out[label] = round(e * sweeps / min(walls), 1)
+        if obs.enabled:
+            obs.gauge("pallas_probe_ratings_per_s", variant=label,
+                      rank=rank, sorted=sort_lbl).set(out[label])
+            for w in walls:
+                obs.histogram("pallas_probe_sweep_s",
+                              variant=label).observe(w / sweeps)
     return out
 
 

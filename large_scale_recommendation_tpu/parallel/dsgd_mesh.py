@@ -263,6 +263,7 @@ class MeshDSGD:
             U, V, (ru, ri, rv, rw), problem.users.omega,
             problem.items.omega, inv_args, "mesh_dsgd_segment",
             checkpoint_manager, checkpoint_every, resume,
+            n_ratings=int(ratings.n),
         )
         self.model = MFModel(U=U, V=V, users=problem.users,
                              items=problem.items)
@@ -323,13 +324,15 @@ class MeshDSGD:
             U, V, (ru, ri, rv, rw), p.omega_u, p.omega_v, inv_args,
             "mesh_dsgd_device_segment",
             checkpoint_manager, checkpoint_every, resume,
+            n_ratings=int(np.shape(u)[0]),
         )
         users, items = p.to_id_indices()
         self.model = MFModel(U=U, V=V, users=users, items=items)
         return self.model
 
     def _train_segments(self, U, V, strata, omega_u, omega_v, inv_args,
-                        kind, checkpoint_manager, checkpoint_every, resume):
+                        kind, checkpoint_manager, checkpoint_every, resume,
+                        n_ratings=None):
         """Shared mesh segment loop + checkpoint/resume for both blocking
         paths. Same kind-tagging contract as the single-device driver
         (models/dsgd.py ``_train_segments``): host-blocked and
@@ -377,6 +380,14 @@ class MeshDSGD:
             default_interpret,
         )
 
+        from large_scale_recommendation_tpu.obs.instrument import (
+            TrainSegmentTimer,
+        )
+
+        timer = TrainSegmentTimer(
+            "mesh_dsgd", kind,
+            shape_key=(tuple(np.shape(U)), tuple(np.shape(V)),
+                       tuple(np.shape(args[0]))))
         segment = checkpoint_every or cfg.iterations
         while done < cfg.iterations:
             seg = min(segment, cfg.iterations - done)
@@ -385,8 +396,10 @@ class MeshDSGD:
                 cfg.collision_mode, with_inv, cfg.kernel,
                 default_interpret() if cfg.kernel == "pallas" else False,
             )
-            U, V = step_fn(U, V, *args, ou, ov, *inv_args,
-                           jnp.asarray(done, jnp.int32))
+            with timer.segment(seg) as h:
+                U, V = step_fn(U, V, *args, ou, ov, *inv_args,
+                               jnp.asarray(done, jnp.int32))
+                h.out = (U, V)
             done += seg
             if checkpoint_manager is not None:
                 # every process writes its OWN device shards; no gather,
@@ -396,4 +409,5 @@ class MeshDSGD:
                     done, {"U": U, "V": V},
                     {"kind": kind, "iterations": cfg.iterations},
                 )
+        timer.finish(n_ratings)
         return U, V

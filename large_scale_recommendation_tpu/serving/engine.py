@@ -48,6 +48,8 @@ import numpy as np
 import jax.numpy as jnp
 
 from large_scale_recommendation_tpu.models.mf import MFModel, _assemble_topk
+from large_scale_recommendation_tpu.obs.registry import get_registry
+from large_scale_recommendation_tpu.obs.trace import get_tracer
 from large_scale_recommendation_tpu.parallel.mesh import (
     BLOCK_AXIS,
     make_block_mesh,
@@ -105,10 +107,25 @@ class ServingEngine:
         self._dtype = jnp.dtype(dtype or jnp.float32)
         self._train = train
         self._pending: list[np.ndarray] = []
+        self._pending_t: list[float] = []  # submit stamps (obs-enabled only)
         self._lock = threading.RLock()
         self.stats = {"requests": 0, "rows": 0, "microbatches": 0,
                       "refreshes": 0, "buckets": {}}
         self.meter = ThroughputMeter()
+        # observability binds at CONSTRUCTION: with the default null
+        # registry the handles below are shared no-op singletons and
+        # _obs_on gates every clock read, so an uninstrumented engine
+        # does zero registry/tracer work on the hot path (pinned by
+        # tests/test_obs_integration.py)
+        obs = get_registry()
+        self._obs_on = obs.enabled
+        self._trace = get_tracer()
+        self._m_qwait = obs.histogram("serving_queue_wait_s")
+        self._m_assembly = obs.histogram("serving_batch_assembly_s")
+        self._m_flush = obs.histogram("serving_flush_s")
+        self._m_requests = obs.counter("serving_requests_total")
+        self._m_rows = obs.counter("serving_rows_total")
+        self._obs = obs
         # swap-observation hook: called as ``on_refresh(version)`` after
         # every successful refresh, INSIDE the engine lock so concurrent
         # refreshes report their versions in swap order (the lock is
@@ -159,6 +176,14 @@ class ServingEngine:
             self.mesh, self._k_local, self._k_out, rpb,
             donate=mesh_supports_donation(self.mesh))
         self.stats["refreshes"] += 1
+        if self._obs_on:
+            # version-labeled swap counter: the serving-side proof of
+            # WHICH retrain snapshots actually reached this engine
+            self._obs.counter("serving_catalog_swaps_total",
+                              version=self.version).inc()
+            self._obs.gauge("serving_catalog_version").set(self.version)
+            self._trace.instant("serving/catalog_swap",
+                                version=self.version)
         return self.version
 
     @property
@@ -183,6 +208,8 @@ class ServingEngine:
         ``serve``, which flush for you)."""
         with self._lock:
             self._pending.append(np.asarray(user_ids))
+            if self._obs_on:  # queue-wait stamp, consumed at flush
+                self._pending_t.append(time.perf_counter())
             return len(self._pending) - 1
 
     def recommend(self, user_ids, return_mask: bool = False):
@@ -231,6 +258,10 @@ class ServingEngine:
             if not requests:
                 return []
             t0 = time.perf_counter()
+            if self._obs_on:
+                stamps, self._pending_t = self._pending_t, []
+                for ts in stamps:
+                    self._m_qwait.observe(t0 - ts)
             # id → row space per request, then one shared row stream:
             # rows from all requests pack together, so ten 30-user
             # requests cost one 512-row micro-batch, not ten 32-row
@@ -244,7 +275,18 @@ class ServingEngine:
                 bounds.append(bounds[-1] + int(known.sum()))
             rows_all = (np.concatenate(row_slices) if row_slices
                         else np.zeros(0, np.int64))
-            top_rows, top_scores = self._serve_rows(rows_all)
+            if self._obs_on:
+                self._m_assembly.observe(time.perf_counter() - t0)
+            if self._trace.enabled:
+                # compile-keyed: the first flush at a fresh catalog
+                # geometry carries the bucket family's XLA compiles
+                with self._trace.span(
+                        "serving/flush",
+                        key=("serving_flush", self._catalog.rows_per_shard),
+                        rows=len(rows_all), requests=len(requests)):
+                    top_rows, top_scores = self._serve_rows(rows_all)
+            else:
+                top_rows, top_scores = self._serve_rows(rows_all)
             results = []
             for (n_ids, known), b0, b1 in zip(known_masks, bounds,
                                               bounds[1:]):
@@ -254,7 +296,14 @@ class ServingEngine:
                     return_mask))
             self.stats["requests"] += len(requests)
             self.stats["rows"] += len(rows_all)
-            self.meter.record(len(rows_all), time.perf_counter() - t0)
+            wall = time.perf_counter() - t0
+            self.meter.record(len(rows_all), wall)
+            if self._obs_on:
+                # results are host numpy by here, so the flush wall is a
+                # SYNCED end-to-end latency, not a dispatch time
+                self._m_flush.observe(wall)
+                self._m_requests.inc(len(requests))
+                self._m_rows.inc(len(rows_all))
             return results
 
     def _serve_rows(self, user_rows: np.ndarray):
@@ -264,16 +313,39 @@ class ServingEngine:
         path)."""
         cat, step = self._catalog, self._step
 
-        def score_chunk(cu, c):
-            excl = self._build_excl(cu, c)
-            return step(self._U[jnp.asarray(cu)], cat.V_sh, cat.w_sh,
-                        jnp.asarray(excl[0]), jnp.asarray(excl[1]),
-                        jnp.asarray(excl[2]))
+        if self._obs_on:
+            def score_chunk(cu, c):
+                # per-pow2-bucket score wall: host exclusion build +
+                # dispatch (the two-deep pipeline means device drain is
+                # attributed to the flush-level synced histogram, not
+                # here — blocking per chunk would serialize the overlap
+                # the engine exists to provide)
+                t0 = time.perf_counter()
+                excl = self._build_excl(cu, c)
+                out = step(self._U[jnp.asarray(cu)], cat.V_sh, cat.w_sh,
+                           jnp.asarray(excl[0]), jnp.asarray(excl[1]),
+                           jnp.asarray(excl[2]))
+                bucket = len(cu)
+                self._obs.histogram("serving_score_s",
+                                    bucket=bucket).observe(
+                    time.perf_counter() - t0)
+                self._obs.gauge("serving_bucket_occupancy",
+                                bucket=bucket).set(c / bucket)
+                return out
+        else:
+            def score_chunk(cu, c):
+                excl = self._build_excl(cu, c)
+                return step(self._U[jnp.asarray(cu)], cat.V_sh, cat.w_sh,
+                            jnp.asarray(excl[0]), jnp.asarray(excl[1]),
+                            jnp.asarray(excl[2]))
 
         def on_batch(bucket):
             self.stats["microbatches"] += 1
             hist = self.stats["buckets"]
             hist[bucket] = hist.get(bucket, 0) + 1
+            if self._obs_on:
+                self._obs.counter("serving_microbatches_total",
+                                  bucket=bucket).inc()
 
         return run_pipelined_topk(
             user_rows, k=self.k, k_out=self._k_out, n_rows=cat.n_rows,

@@ -51,8 +51,11 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import time
 from typing import Any, Callable
 
+from large_scale_recommendation_tpu.obs.registry import get_registry
+from large_scale_recommendation_tpu.obs.trace import get_tracer
 from large_scale_recommendation_tpu.streams.log import EventLog
 from large_scale_recommendation_tpu.streams.sources import (
     LogTailSource,
@@ -126,6 +129,21 @@ class StreamingDriver:
         # retrain swap actually reached serving
         self.catalog_versions: list[int] = []
         self._engines: list = []
+        # observability handles bind at construction (null singletons
+        # when disabled — zero hot-path cost, see obs/)
+        obs = get_registry()
+        self._obs = obs
+        self._obs_on = obs.enabled
+        self._trace = get_tracer()
+        part = str(partition)
+        self._m_batches = obs.counter("streams_batches_total",
+                                      partition=part)
+        self._m_records = obs.counter("streams_records_total",
+                                      partition=part)
+        self._m_ckpt = obs.histogram("streams_checkpoint_s",
+                                     partition=part)
+        self._m_lag = obs.gauge("streams_lag_records", partition=part)
+        self._m_depth = obs.gauge("streams_queue_depth", partition=part)
 
     # -- recovery ------------------------------------------------------------
 
@@ -184,8 +202,11 @@ class StreamingDriver:
 
     def checkpoint(self) -> str:
         """Write one atomic (factors, step, WAL offset) snapshot now."""
+        t0 = time.perf_counter() if self._obs_on else 0.0
         path = save_online_state(self.manager, self._online,
                                  self._online.step)
+        if self._obs_on:
+            self._m_ckpt.observe(time.perf_counter() - t0)
         self.checkpoints_written += 1
         self._since_checkpoint = 0
         if self.config.truncate_log:
@@ -260,6 +281,11 @@ class StreamingDriver:
         self.batches_processed += 1
         self.records_processed += batch.n
         self._since_checkpoint += 1
+        if self._obs_on:
+            self._m_batches.inc()
+            self._m_records.inc(batch.n)
+            if self._source is not None and self._source.queue is not None:
+                self._m_depth.set(self._source.stats.depth)
         if self.on_batch is not None:
             self.on_batch(batch)
         stamped = self._online.consumed_offsets.get(batch.partition, 0)
@@ -327,6 +353,18 @@ class StreamingDriver:
         # count every other partition's backlog (missing partitions are
         # charged from their floor), which is not this driver's lag
         end = self.log.end_offset(self.partition)
+        if self._obs_on:
+            # per-partition lag against the TRUE log head — refreshed
+            # here (telemetry cadence), not per batch: end_offset stats
+            # the disk, far too hot for the apply path
+            self._m_lag.set(max(0, end - self.consumed_offset))
+            from large_scale_recommendation_tpu.utils.metrics import (
+                publish_fields,
+            )
+
+            publish_fields(queue, registry=self._obs,
+                           prefix="streams_queue",
+                           partition=str(self.partition))
         return {
             "partition": self.partition,
             "batches_processed": self.batches_processed,

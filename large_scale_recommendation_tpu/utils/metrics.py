@@ -14,6 +14,17 @@ depth logged on every change (PSOfflineMF.scala:122,163), buffer depth every
   the seam a dashboard would consume.
 - ``profile``: context manager around ``jax.profiler.trace`` producing
   TensorBoard-loadable traces of the XLA timeline.
+
+These helpers predate the unified observability layer (``obs/``) and are
+now thin **shims over it**: each one keeps its original surface (every
+existing caller, incl. ``StreamingDriver.telemetry()``, works unchanged)
+but mirrors its measurements into the process registry whenever
+``obs.enable()`` has installed one — so an old ``StepTimer`` call site
+shows up in the same snapshot/Prometheus/JSONL exports as the new
+instrumentation. New code should use ``obs`` directly: the
+latency-distribution / labeling / export logic lives THERE, not here
+(the pre-obs duplicated timing logic in this module is deprecated).
+With the default null registry the mirroring is a no-op singleton call.
 """
 
 from __future__ import annotations
@@ -51,12 +62,21 @@ def block(x: Any) -> Any:
 
 @dataclasses.dataclass
 class StepTimer:
-    """Accumulating wall-clock timer for repeated steps."""
+    """Accumulating wall-clock timer for repeated steps.
+
+    Registry shim: each timed step also lands in the process
+    ``step_timer_s{name=...}`` histogram (p50/p90/p99 live in ``obs``,
+    which supersedes the mean-only accounting here)."""
 
     name: str = "step"
     total_s: float = 0.0
     count: int = 0
     last_s: float = 0.0
+
+    def __post_init__(self):
+        from large_scale_recommendation_tpu.obs.registry import get_registry
+
+        self._hist = get_registry().histogram("step_timer_s", name=self.name)
 
     @contextlib.contextmanager
     def time(self, result_holder: list | None = None) -> Iterator[None]:
@@ -69,6 +89,7 @@ class StepTimer:
         self.last_s = time.perf_counter() - t0
         self.total_s += self.last_s
         self.count += 1
+        self._hist.observe(self.last_s)
 
     @property
     def mean_s(self) -> float:
@@ -77,14 +98,28 @@ class StepTimer:
 
 @dataclasses.dataclass
 class ThroughputMeter:
-    """Elements/second over the lifetime and per window."""
+    """Elements/second over the lifetime and per window.
+
+    Registry shim: recorded elements/seconds also feed the
+    ``meter_elements_total``/``meter_seconds_total`` counters (labeled by
+    ``name``), so long-lived meters are visible in registry exports."""
 
     total_elements: int = 0
     total_s: float = 0.0
+    name: str = "throughput"
+
+    def __post_init__(self):
+        from large_scale_recommendation_tpu.obs.registry import get_registry
+
+        reg = get_registry()
+        self._c_elems = reg.counter("meter_elements_total", name=self.name)
+        self._c_secs = reg.counter("meter_seconds_total", name=self.name)
 
     def record(self, elements: int, seconds: float) -> None:
         self.total_elements += elements
         self.total_s += seconds
+        self._c_elems.inc(elements)
+        self._c_secs.inc(seconds)
 
     @property
     def rate(self) -> float:
@@ -118,22 +153,58 @@ class IngestStats:
     def snapshot(self) -> dict:
         return dataclasses.asdict(self)
 
+    def publish(self, registry=None, prefix: str = "ingest",
+                **labels) -> None:
+        """Mirror every counter field into ``registry`` (default: the
+        process one) as ``{prefix}_{field}`` gauges, so ingest counters
+        show up in the same exports as the first-class instrumentation
+        (``StreamingDriver.telemetry`` publishes its queue snapshot
+        through the same ``publish_fields`` helper under the
+        ``streams_queue`` prefix). Gauges, not counters: these fields
+        are cumulative values owned by the queue, re-published wholesale
+        each telemetry pass."""
+        publish_fields(dataclasses.asdict(self), registry=registry,
+                       prefix=prefix, **labels)
+
+
+def publish_fields(fields: dict, registry=None, prefix: str = "ingest",
+                   **labels) -> None:
+    """ONE copy of the mapping→gauges mirroring used by
+    ``IngestStats.publish`` and the streaming driver's telemetry path:
+    every ``{field: number}`` item lands as a ``{prefix}_{field}`` gauge
+    with the given labels. No-op under the null registry."""
+    if registry is None:
+        from large_scale_recommendation_tpu.obs.registry import get_registry
+
+        registry = get_registry()
+    if not registry.enabled:
+        return
+    for field, value in fields.items():
+        registry.gauge(f"{prefix}_{field}", **labels).set(value)
+
 
 class MetricsLog:
     """Append-only structured metric records.
 
     ≙ the role of the reference's in-band log lines, as data instead of
-    strings."""
+    strings. Registry shim: each logged event also bumps
+    ``metrics_log_events_total{event=...}`` so legacy event streams are
+    countable next to the first-class instrumentation."""
 
     def __init__(self, log_to: logging.Logger | None = logger,
                  level: int = logging.DEBUG):
+        from large_scale_recommendation_tpu.obs.registry import get_registry
+
         self.records: list[dict] = []
         self._logger = log_to
         self._level = level
+        self._registry = get_registry()
 
     def log(self, event: str, **fields) -> None:
         rec = {"event": event, "t": time.time(), **fields}
         self.records.append(rec)
+        self._registry.counter("metrics_log_events_total",
+                               event=event).inc()
         if self._logger is not None:
             self._logger.log(self._level, "%s %s", event, fields)
 

@@ -1,0 +1,125 @@
+"""Render a metrics snapshot as a human-readable table.
+
+One command to see serving p99, ingest lag, and train step time side by
+side::
+
+    python scripts/obs_report.py metrics.jsonl       # last snapshot line
+    python scripts/obs_report.py snapshot.json       # single snapshot
+    python scripts/obs_report.py metrics.jsonl --name serving_flush_s
+
+Input is either a single-snapshot JSON file or a JSONL metrics log
+(``MetricsRegistry.append_jsonl``); for JSONL the LAST line is rendered
+(``--line N`` picks another, 0-based). ``--name SUBSTR`` filters rows.
+
+The same renderer is importable (``render_snapshot``) — the demo and
+tests drive it in-process.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def load_snapshot(path: str, line: int | None = None) -> dict:
+    """Load a snapshot from a JSON file or a JSONL log (last line, or
+    ``line`` 0-based)."""
+    with open(path) as f:
+        text = f.read()
+    if line is None:
+        # whole-file parse first: a single snapshot may be
+        # pretty-printed (multi-line), which is NOT line-per-record JSONL
+        try:
+            doc = json.loads(text)
+            if isinstance(doc, dict):
+                return doc
+        except json.JSONDecodeError:
+            pass
+    lines = [ln for ln in text.splitlines() if ln.strip()]
+    if not lines:
+        raise ValueError(f"{path} is empty")
+    return json.loads(lines[-1 if line is None else line])
+
+
+def _fmt(v) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        if v == 0:
+            return "0"
+        if abs(v) >= 1000:
+            return f"{v:,.0f}"
+        if abs(v) >= 1:
+            return f"{v:.3g}"
+        return f"{v:.3g}"
+    return str(v)
+
+
+def _label_str(labels: dict) -> str:
+    return ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+
+
+def render_snapshot(snap: dict, name_filter: str | None = None) -> str:
+    """The table: counters/gauges first (name, labels, value), then
+    histograms (count, mean, p50/p90/p99, max)."""
+    metrics = snap.get("metrics", [])
+    if name_filter:
+        metrics = [m for m in metrics if name_filter in m["name"]]
+    scalars = [m for m in metrics if m["type"] in ("counter", "gauge")]
+    hists = [m for m in metrics if m["type"] == "histogram"]
+    out: list[str] = []
+
+    if scalars:
+        rows = [(m["name"], _label_str(m["labels"]), _fmt(m["value"]),
+                 m["type"]) for m in scalars]
+        w0 = max(len("metric"), *(len(r[0]) for r in rows))
+        w1 = max(len("labels"), *(len(r[1]) for r in rows))
+        w2 = max(len("value"), *(len(r[2]) for r in rows))
+        out.append(f"{'metric':<{w0}}  {'labels':<{w1}}  "
+                   f"{'value':>{w2}}  type")
+        out.append("-" * (w0 + w1 + w2 + 12))
+        for r in rows:
+            out.append(f"{r[0]:<{w0}}  {r[1]:<{w1}}  {r[2]:>{w2}}  {r[3]}")
+        out.append("")
+
+    if hists:
+        cols = ("count", "mean", "p50", "p90", "p99", "max")
+        rows = [(m["name"], _label_str(m["labels"]),
+                 *(_fmt(m.get(c)) for c in cols)) for m in hists]
+        w0 = max(len("histogram"), *(len(r[0]) for r in rows))
+        w1 = max(len("labels"), *(len(r[1]) for r in rows))
+        ws = [max(len(c), *(len(r[2 + j]) for r in rows))
+              for j, c in enumerate(cols)]
+        head = f"{'histogram':<{w0}}  {'labels':<{w1}}"
+        for j, c in enumerate(cols):
+            head += f"  {c:>{ws[j]}}"
+        out.append(head)
+        out.append("-" * len(head))
+        for r in rows:
+            line = f"{r[0]:<{w0}}  {r[1]:<{w1}}"
+            for j in range(len(cols)):
+                line += f"  {r[2 + j]:>{ws[j]}}"
+            out.append(line)
+        out.append("")
+
+    if not out:
+        return "(no metrics)"
+    return "\n".join(out)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="snapshot JSON or metrics JSONL file")
+    ap.add_argument("--line", type=int, default=None,
+                    help="0-based JSONL line (default: last)")
+    ap.add_argument("--name", default=None,
+                    help="only metrics whose name contains this")
+    args = ap.parse_args(argv)
+    snap = load_snapshot(args.path, args.line)
+    print(render_snapshot(snap, args.name))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

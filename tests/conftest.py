@@ -17,3 +17,25 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from large_scale_recommendation_tpu.utils.platform import force_cpu  # noqa: E402
 
 force_cpu(n_devices=8)
+
+# OBS_OUT=<dir>: run the whole suite with the observability layer live
+# and dump the session's metrics JSONL + Prometheus snapshot + Chrome
+# trace there at exit — the artifact the CI workflow uploads for every
+# tier-1 run. Unset (the default, local runs): the null layer stays
+# installed and instrumentation costs nothing.
+_OBS_OUT = os.environ.get("OBS_OUT")
+_OBS_REG = _OBS_TRACER = None
+if _OBS_OUT:
+    from large_scale_recommendation_tpu import obs as _obs  # noqa: E402
+
+    _OBS_REG, _OBS_TRACER = _obs.enable()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if not _OBS_OUT:
+        return
+    os.makedirs(_OBS_OUT, exist_ok=True)
+    _OBS_REG.append_jsonl(os.path.join(_OBS_OUT, "tier1_metrics.jsonl"))
+    with open(os.path.join(_OBS_OUT, "tier1_metrics.prom"), "w") as f:
+        f.write(_OBS_REG.to_prometheus())
+    _OBS_TRACER.to_chrome_trace(os.path.join(_OBS_OUT, "tier1_trace.json"))

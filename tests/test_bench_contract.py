@@ -96,8 +96,19 @@ def test_serving_bench_emits_contract_json():
     for key in ("engine_users_per_s", "percall_users_per_s",
                 "engine_bf16_users_per_s", "engine_executable_variants",
                 "engine_microbatches", "engine_bucket_histogram",
-                "mesh_devices", "request_rows"):
+                "mesh_devices", "request_rows",
+                # the obs_overhead_* contract: bench.py's instrumentation-
+                # overhead extras are built from these keys — enabled-run
+                # rate plus the enabled-vs-disabled delta. Structural
+                # only (key presence + a sane range), NOT a wall-clock
+                # gate: on a loaded shared runner a 3% threshold would be
+                # an intermittent red; the ≤3% evidence lives in the
+                # bench rounds' obs_overhead_pct extra
+                "engine_obs_users_per_s", "obs_overhead_pct",
+                "obs_metric_names"):
         assert key in e, f"missing extra.{key}"
+    assert e["engine_obs_users_per_s"] > 0
+    assert e["obs_metric_names"] > 0
     # the compile-count contract: the executable family is the pow2
     # bucket family (here ≤ {8..256} = 6 shapes), not the request count
     assert 0 < e["engine_executable_variants"] <= 6

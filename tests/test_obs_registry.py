@@ -1,0 +1,216 @@
+"""Registry contract: bucket/quantile math vs a numpy reference,
+label handling, exporters, thread-safety, and the zero-cost null layer.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from large_scale_recommendation_tpu import obs
+from large_scale_recommendation_tpu.obs.registry import (
+    _HIST_MIN,
+    Histogram,
+    MetricsRegistry,
+    NULL_INSTRUMENT,
+    NULL_REGISTRY,
+    NullRegistry,
+    get_registry,
+    set_registry,
+)
+
+
+@pytest.fixture
+def reg():
+    return MetricsRegistry()
+
+
+class TestHistogram:
+    def test_quantiles_match_numpy(self, reg):
+        """Log-bucket quantile estimates vs np.percentile on a lognormal
+        latency-shaped sample: the documented error bound is ~9% (half a
+        2**0.25 bucket at the geometric midpoint); assert a 15% ceiling
+        to keep the test robust to bucket-edge effects."""
+        h = reg.histogram("lat_s")
+        rng = np.random.default_rng(7)
+        vals = rng.lognormal(mean=-5.0, sigma=1.5, size=20_000)
+        for v in vals:
+            h.observe(v)
+        for q in (50, 90, 99):
+            est = h.quantile(q / 100)
+            ref = float(np.percentile(vals, q))
+            assert abs(est - ref) / ref < 0.15, (q, est, ref)
+
+    def test_exact_stats_ride_alongside(self, reg):
+        h = reg.histogram("x")
+        vals = [0.5, 1.5, 2.0, 8.0]
+        for v in vals:
+            h.observe(v)
+        assert h.count == 4
+        assert h.sum == pytest.approx(sum(vals))
+        assert h.min == 0.5 and h.max == 8.0
+        assert h.mean == pytest.approx(np.mean(vals))
+
+    def test_bucket_bounds_contain_value(self):
+        rng = np.random.default_rng(0)
+        for v in rng.lognormal(0, 8, 200):
+            idx = Histogram.bucket_index(float(v))
+            lo, hi = Histogram.bucket_bounds(idx)
+            if v <= _HIST_MIN:
+                assert idx == 0
+            else:
+                assert lo <= v < hi * (1 + 1e-12), (v, lo, hi)
+
+    def test_quantile_clamped_to_observed_extremes(self, reg):
+        h = reg.histogram("one")
+        h.observe(3.0)
+        for q in (0.5, 0.9, 0.99):
+            assert h.quantile(q) == 3.0
+        assert np.isnan(reg.histogram("empty").quantile(0.5))
+
+    def test_summary_fields(self, reg):
+        h = reg.histogram("s")
+        h.observe(1.0)
+        s = h.summary()
+        for key in ("count", "sum", "mean", "min", "max",
+                    "p50", "p90", "p99"):
+            assert key in s
+
+
+class TestLabels:
+    def test_same_labels_same_instrument(self, reg):
+        assert reg.counter("c", a="1", b="2") is reg.counter(
+            "c", b="2", a="1")
+        assert reg.counter("c", a="1") is not reg.counter("c", a="2")
+        assert reg.gauge("g") is reg.gauge("g")
+
+    def test_name_label_does_not_collide_with_positional(self, reg):
+        # instruments labeled name=... (StepTimer/ThroughputMeter shims)
+        c = reg.counter("step_timer_s", name="sweep")
+        c.inc()
+        assert c.value == 1
+
+    def test_types_are_namespaced_separately(self, reg):
+        reg.counter("m").inc()
+        reg.gauge("m").set(5)
+        assert reg.counter("m").value == 1
+        assert reg.gauge("m").value == 5
+
+
+class TestExporters:
+    def test_snapshot_is_json_safe_and_sorted(self, reg):
+        reg.counter("b_total").inc(3)
+        reg.gauge("a_gauge", part="0").set(1.5)
+        reg.histogram("c_s").observe(0.25)
+        snap = json.loads(json.dumps(reg.snapshot()))
+        names = [m["name"] for m in snap["metrics"]]
+        assert names == sorted(names)
+        by_name = {m["name"]: m for m in snap["metrics"]}
+        assert by_name["b_total"]["value"] == 3
+        assert by_name["a_gauge"]["labels"] == {"part": "0"}
+        assert by_name["c_s"]["count"] == 1
+
+    def test_jsonl_append(self, reg, tmp_path):
+        path = str(tmp_path / "m.jsonl")
+        reg.counter("x").inc()
+        reg.append_jsonl(path)
+        reg.counter("x").inc()
+        reg.append_jsonl(path)
+        lines = open(path).read().splitlines()
+        assert len(lines) == 2
+        first, last = json.loads(lines[0]), json.loads(lines[-1])
+        assert first["metrics"][0]["value"] == 1
+        assert last["metrics"][0]["value"] == 2
+
+    def test_prometheus_text(self, reg):
+        reg.counter("req_total", code="200").inc(7)
+        reg.gauge("depth").set(3)
+        h = reg.histogram("lat_s", route="a")
+        h.observe(0.5)
+        text = reg.to_prometheus()
+        assert "# TYPE req_total counter" in text
+        assert 'req_total{code="200"} 7' in text
+        assert "# TYPE depth gauge" in text
+        assert "# TYPE lat_s summary" in text
+        assert 'lat_s{route="a",quantile="0.5"}' in text
+        assert 'lat_s_count{route="a"} 1' in text
+        assert text.endswith("\n")
+
+
+class TestThreadSafety:
+    def test_concurrent_updates_are_exact(self, reg):
+        c = reg.counter("n")
+        h = reg.histogram("h")
+
+        def work():
+            for _ in range(1000):
+                c.inc()
+                h.observe(0.01)
+
+        threads = [threading.Thread(target=work) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert c.value == 8000
+        assert h.count == 8000
+
+    def test_concurrent_instrument_creation(self, reg):
+        out = []
+
+        def make(i):
+            out.append(reg.counter("same", k=str(i % 2)))
+
+        threads = [threading.Thread(target=make, args=(i,))
+                   for i in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len({id(c) for c in out}) == 2
+
+
+class TestNullLayer:
+    def test_instruments_are_shared_singletons(self):
+        """The zero-allocation pin: EVERY null instrument is the one
+        module-level object — handing them out costs nothing."""
+        null = NullRegistry()
+        assert null.counter("a") is NULL_INSTRUMENT
+        assert null.gauge("b", x="1") is NULL_INSTRUMENT
+        assert null.histogram("c") is NULL_INSTRUMENT
+        assert not hasattr(NULL_INSTRUMENT, "__dict__")  # __slots__ = ()
+
+    def test_mutators_record_nothing(self):
+        null = NULL_REGISTRY
+        null.counter("a").inc(100)
+        null.gauge("b").set(5)
+        null.histogram("c").observe(1.0)
+        assert null.snapshot()["metrics"] == []
+        assert null.to_prometheus() == ""
+        assert null.names() == set()
+        assert not null.enabled
+
+    def test_null_jsonl_writes_nothing(self, tmp_path):
+        path = tmp_path / "never.jsonl"
+        NULL_REGISTRY.append_jsonl(str(path))
+        assert not path.exists()
+
+    def test_enable_disable_roundtrip(self):
+        from large_scale_recommendation_tpu.obs.trace import (
+            get_tracer,
+            set_tracer,
+        )
+
+        prev_r, prev_t = get_registry(), get_tracer()
+        try:
+            reg, tracer = obs.enable()
+            assert get_registry() is reg
+            assert get_tracer() is tracer
+            assert obs.enabled()
+            obs.disable()
+            assert isinstance(get_registry(), NullRegistry)
+            assert not obs.enabled()
+        finally:
+            set_registry(prev_r)
+            set_tracer(prev_t)

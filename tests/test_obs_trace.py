@@ -1,0 +1,155 @@
+"""Tracer contract: span nesting, the Chrome trace-event JSON golden
+schema, compile/execute categorization, output blocking, and the null
+layer.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from large_scale_recommendation_tpu.obs.trace import (
+    NULL_SPAN,
+    NullTracer,
+    Tracer,
+    validate_chrome_trace,
+)
+
+
+@pytest.fixture
+def tracer():
+    return Tracer()
+
+
+class TestSpans:
+    def test_nested_spans_nest_in_export(self, tracer):
+        with tracer.span("outer", kind="a"):
+            assert tracer.depth() == 1
+            with tracer.span("inner"):
+                assert tracer.depth() == 2
+        with tracer.span("sibling"):
+            pass
+        assert tracer.depth() == 0
+        # golden schema: JSON round-trip then validate — the validator
+        # IS the schema contract (complete events, µs ts/dur, pid/tid,
+        # per-tid nesting)
+        doc = json.loads(json.dumps(tracer.chrome_trace()))
+        events = validate_chrome_trace(doc)
+        by_name = {e["name"]: e for e in events}
+        assert set(by_name) == {"outer", "inner", "sibling"}
+        outer, inner = by_name["outer"], by_name["inner"]
+        # child interval strictly inside the parent interval
+        assert outer["ts"] <= inner["ts"]
+        assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"]
+        assert outer["args"] == {"kind": "a"}
+        assert outer["tid"] == inner["tid"]
+
+    def test_threads_get_independent_stacks(self, tracer):
+        barrier = threading.Barrier(4)  # all alive at once, so thread
+        # idents are distinct (the OS reuses idents of joined threads)
+
+        def work(i):
+            barrier.wait()
+            with tracer.span(f"thread-{i}"):
+                with tracer.span(f"thread-{i}-child"):
+                    pass
+            barrier.wait()
+
+        threads = [threading.Thread(target=work, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        events = validate_chrome_trace(tracer.chrome_trace())
+        assert len(events) == 8
+        assert len({e["tid"] for e in events}) == 4
+
+    def test_compile_then_execute_categories(self, tracer):
+        """The compile-event hook: first sighting of a key labels the
+        span ``compile`` (it carried the jit), steady-state ``execute``
+        — the two must be distinguishable in the exported trace."""
+        for _ in range(3):
+            with tracer.span("step", key=("fn", 128)):
+                pass
+        with tracer.span("step", key=("fn", 256)):  # new shape → compile
+            pass
+        cats = [e["cat"] for e in tracer.events()]
+        assert cats == ["compile", "execute", "execute", "compile"]
+
+    def test_span_blocks_on_out(self, tracer):
+        import jax.numpy as jnp
+
+        with tracer.span("matmul") as sp:
+            x = jnp.ones((64, 64)) @ jnp.ones((64, 64))
+            sp.out = x
+        (e,) = tracer.events()
+        assert e["dur"] > 0
+        np.testing.assert_allclose(np.asarray(x)[0, 0], 64.0)
+
+    def test_instant_events_pass_validation(self, tracer):
+        tracer.instant("swap", version=3)
+        doc = tracer.chrome_trace()
+        validate_chrome_trace(doc)
+        (e,) = doc["traceEvents"]
+        assert e["ph"] == "i" and e["args"] == {"version": 3}
+
+    def test_max_events_cap_counts_drops(self):
+        tracer = Tracer(max_events=2)
+        for i in range(4):
+            with tracer.span(f"s{i}"):
+                pass
+        assert len(tracer.events()) == 2
+        assert tracer.dropped == 2
+        tracer.clear()
+        assert tracer.events() == [] and tracer.dropped == 0
+
+
+class TestValidation:
+    def test_rejects_partial_overlap(self):
+        base = {"cat": "span", "ph": "X", "pid": 1, "tid": 1, "args": {}}
+        doc = {"traceEvents": [
+            {"name": "a", "ts": 0.0, "dur": 10.0, **base},
+            {"name": "b", "ts": 5.0, "dur": 10.0, **base},  # overlaps a
+        ]}
+        with pytest.raises(ValueError, match="overlap"):
+            validate_chrome_trace(doc)
+
+    def test_rejects_malformed_events(self):
+        with pytest.raises(ValueError):
+            validate_chrome_trace({"traceEvents": [{"ph": "X"}]})
+        with pytest.raises(ValueError):
+            validate_chrome_trace({"nope": []})
+        ok = {"traceEvents": [{"name": "a", "cat": "s", "ph": "X",
+                               "ts": 0.0, "dur": 1.0, "pid": 1,
+                               "tid": 1, "args": {}}]}
+        assert len(validate_chrome_trace(ok)) == 1
+
+    def test_disjoint_same_tid_ok(self):
+        base = {"cat": "span", "ph": "X", "pid": 1, "tid": 1, "args": {}}
+        doc = {"traceEvents": [
+            {"name": "a", "ts": 0.0, "dur": 5.0, **base},
+            {"name": "b", "ts": 5.0, "dur": 5.0, **base},
+        ]}
+        assert len(validate_chrome_trace(doc)) == 2
+
+
+class TestNullTracer:
+    def test_span_is_shared_noop_singleton(self):
+        null = NullTracer()
+        sp = null.span("anything", key="k", x=1)
+        assert sp is NULL_SPAN
+        with sp as s:
+            s.out = object()  # dropped: the singleton stores nothing
+        assert s.out is None
+        assert null.events() == []
+        assert null.depth() == 0
+        null.instant("x")
+        assert null.chrome_trace()["traceEvents"] == []
+
+    def test_null_span_is_reentrant(self):
+        null = NullTracer()
+        with null.span("a"):
+            with null.span("b"):
+                pass
