@@ -1,15 +1,28 @@
-"""Observability demo: train → serve → stream, then dump every artifact.
+"""Observability demo: train → serve → stream behind a LIVE health layer.
 
-One run produces, under ``--out`` (default ``obs_out/``):
+One run starts the endpoint server, drives every tier through it, then
+deliberately poisons the stream to show the watchdog + ``/healthz``
+doing their job:
 
-- ``metrics.prom``   — Prometheus text snapshot (serving latency
-  summaries, train step time, ingest counters, side by side)
-- ``metrics.jsonl``  — the same snapshot as one JSONL line
-  (``scripts/obs_report.py metrics.jsonl`` renders the table)
-- ``trace.json``     — Chrome trace-event JSON; open it at
-  https://ui.perfetto.dev (or chrome://tracing) and the DSGD segments
-  show as ``compile`` then ``execute`` spans, the serving flushes as
-  nested spans under their thread lane.
+1. ``obs.enable()`` + ``ObsServer`` — ``/metrics``, ``/healthz``,
+   ``/varz``, ``/tracez`` served over a real socket (port printed).
+2. DSGD training (2 segments: compile vs execute split in the trace).
+3. ``ServingEngine`` with an ``SLOTracker`` — flush walls feed the
+   attainment window; the serving health check reads its burn rate.
+4. Durable streaming ingest with a ``TrainingWatchdog(policy=
+   "rollback")``, a stream-lag check, a checkpoint-staleness check, and
+   the timed telemetry export keeping the lag gauges fresh.
+5. ``curl /healthz`` → 200, every check OK.
+6. **A NaN micro-batch is injected**: the watchdog trips BEFORE the
+   offset stamp, rolls the model back to the last durable checkpoint,
+   and ``/healthz`` flips to 503 with the training check CRITICAL —
+   the poisoned batch never reaches a checkpoint or a catalog swap.
+
+Artifacts under ``--out`` (default ``obs_out/``): ``metrics.prom``
+(fetched from the live ``/metrics`` route), ``metrics.jsonl``,
+``trace.json`` (Perfetto-loadable), ``healthz.json`` (the final
+CRITICAL report). ``scripts/obs_report.py <url>/varz --watch 2`` tails
+the same server live.
 
 Run: ``JAX_PLATFORMS=cpu python examples/obs_demo.py``
 """
@@ -17,6 +30,7 @@ Run: ``JAX_PLATFORMS=cpu python examples/obs_demo.py``
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import tempfile
@@ -24,6 +38,8 @@ import tempfile
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from large_scale_recommendation_tpu.obs.server import http_get as _curl  # noqa: E402
 
 
 def main(argv=None) -> int:
@@ -40,17 +56,30 @@ def main(argv=None) -> int:
     from large_scale_recommendation_tpu.core.generators import (
         SyntheticMFGenerator,
     )
+    from large_scale_recommendation_tpu.core.types import Ratings
     from large_scale_recommendation_tpu.models.dsgd import DSGD, DSGDConfig
     from large_scale_recommendation_tpu.models.online import (
         OnlineMF,
         OnlineMFConfig,
     )
+    from large_scale_recommendation_tpu.obs.health import (
+        HealthMonitor,
+        SLOTracker,
+        TrainingDivergedError,
+        TrainingWatchdog,
+    )
+    from large_scale_recommendation_tpu.obs.server import ObsServer
     from large_scale_recommendation_tpu.serving.engine import ServingEngine
     from large_scale_recommendation_tpu.streams.driver import (
         StreamingDriver,
         StreamingDriverConfig,
     )
     from large_scale_recommendation_tpu.streams.log import EventLog
+
+    monitor = HealthMonitor()
+    server = ObsServer(monitor=monitor).start()
+    print(f"# endpoint server live at {server.url} "
+          f"(/metrics /healthz /varz /tracez)")
 
     # ---- train: segmented so compile vs execute splits in the trace ----
     print("# train: DSGD, 2 segments (first carries the compile)")
@@ -61,15 +90,24 @@ def main(argv=None) -> int:
                              minibatch_size=1024, learning_rate=0.05))
     model = solver.fit(ratings, checkpoint_every=1)
 
-    # ---- serve: a mixed-size request stream through the engine ---------
-    print("# serve: 40 mixed-size requests through ServingEngine")
-    engine = ServingEngine(model, k=10, max_batch=256)
+    # ---- serve: SLO-tracked mixed-size request stream ------------------
+    # target is deliberately loose (10s): demo flushes carry XLA compiles
+    # and run on arbitrary CI hosts — the point here is the wiring, not a
+    # latency claim. A deployment would set its real target.
+    print("# serve: 40 mixed-size requests, SLO 99% of flushes < 10s")
+    slo = SLOTracker(target_s=10.0, objective=0.99, window=256)
+    monitor.watch_slo(slo)
+    engine = ServingEngine(model, k=10, max_batch=256, slo=slo)
     rng = np.random.default_rng(1)
     engine.serve([rng.integers(0, 500, int(sz)).astype(np.int64)
                   for sz in rng.integers(1, 48, 40)])
+    print(f"#   slo: attainment={slo.attainment:.3f} "
+          f"burn={slo.burn_rate:.2f} "
+          f"budget_remaining={slo.error_budget_remaining:.2f}")
 
-    # ---- stream: durable log → online model, checkpointed --------------
-    print("# stream: 3 micro-batches through the durable ingest driver")
+    # ---- stream: watchdog-guarded durable ingest -----------------------
+    print("# stream: 3 micro-batches through the durable ingest driver, "
+          "watchdog armed (policy=rollback)")
     with tempfile.TemporaryDirectory() as tmp:
         log = EventLog(os.path.join(tmp, "log"))
         for _ in range(3):
@@ -80,18 +118,58 @@ def main(argv=None) -> int:
         driver = StreamingDriver(
             online, log, os.path.join(tmp, "ckpt"),
             config=StreamingDriverConfig(batch_records=2_000))
+        watchdog = TrainingWatchdog(policy="rollback",
+                                    manager=driver.manager)
+        online.watchdog = watchdog
+        monitor.watch_watchdog(watchdog)
+        monitor.watch_driver(driver, degraded_lag=50_000)
+        monitor.watch_checkpoints(driver.manager, degraded_after_s=300)
+        driver.start_telemetry_export(interval_s=1.0)  # fresh lag gauges
         driver.run()
-        driver.telemetry()  # publishes lag/queue gauges
 
-    # ---- dump the three artifacts --------------------------------------
-    os.makedirs(args.out, exist_ok=True)
-    prom_path = os.path.join(args.out, "metrics.prom")
-    with open(prom_path, "w") as f:
-        f.write(reg.to_prometheus())
+        # ---- healthy: /healthz is 200 with every check OK --------------
+        code, body = _curl(server.url + "/healthz")
+        report = json.loads(body)
+        checks = {k: v["status"] for k, v in report["checks"].items()}
+        print(f"# healthz (healthy): HTTP {code}, status="
+              f"{report['status']!r}, checks={checks}")
+        assert code == 200, body
+
+        # ---- poison: a NaN batch trips the watchdog --------------------
+        print("# inject: one NaN micro-batch")
+        bad = Ratings.from_arrays(
+            np.arange(16, dtype=np.int64) % 500,
+            np.arange(16, dtype=np.int64) % 200,
+            np.full(16, np.nan, np.float32))
+        try:
+            online.partial_fit(bad, offset=(0, driver.consumed_offset + 16))
+            print("#   ERROR: watchdog did not trip")
+            return 1
+        except TrainingDivergedError as e:
+            print(f"#   tripped: reason={e.reason!r} "
+                  f"rolled_back={e.rolled_back} — the poisoned offset was "
+                  "never stamped, no checkpoint/catalog swap saw NaNs")
+
+        code, body = _curl(server.url + "/healthz")
+        report = json.loads(body)
+        print(f"# healthz (tripped): HTTP {code}, "
+              f"training={report['checks']['training']['status']!r}")
+        assert code == 503, body
+        driver.stop_telemetry_export()
+
+        # ---- dump the artifacts ----------------------------------------
+        os.makedirs(args.out, exist_ok=True)
+        with open(os.path.join(args.out, "healthz.json"), "w") as f:
+            json.dump(report, f, indent=2)
+        _, prom = _curl(server.url + "/metrics")  # the SERVED text
+        prom_path = os.path.join(args.out, "metrics.prom")
+        with open(prom_path, "w") as f:
+            f.write(prom)
     jsonl_path = os.path.join(args.out, "metrics.jsonl")
     reg.append_jsonl(jsonl_path)
     trace_path = os.path.join(args.out, "trace.json")
     doc = tracer.to_chrome_trace(trace_path)
+    server.stop()
 
     from large_scale_recommendation_tpu.obs.trace import (
         validate_chrome_trace,
@@ -99,7 +177,8 @@ def main(argv=None) -> int:
 
     events = validate_chrome_trace(doc)
     cats = sorted({e["cat"] for e in events})
-    print(f"# wrote {prom_path}, {jsonl_path}, {trace_path}")
+    print(f"# wrote {prom_path}, {jsonl_path}, {trace_path}, "
+          f"{os.path.join(args.out, 'healthz.json')}")
     print(f"# trace: {len(events)} spans, categories {cats} "
           f"— open trace.json in https://ui.perfetto.dev")
 

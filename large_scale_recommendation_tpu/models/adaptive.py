@@ -136,6 +136,19 @@ class AdaptiveMF:
     def state(self) -> str:
         return self._state
 
+    @property
+    def watchdog(self):
+        """The divergence guard (``obs.health.TrainingWatchdog``) lives
+        on the online model — micro-batches run through its
+        ``partial_fit`` hook — and additionally gates every retrain
+        swap here (``_install`` refuses to stream non-finite retrained
+        factors into a catalog swap)."""
+        return self.online.watchdog
+
+    @watchdog.setter
+    def watchdog(self, wd) -> None:
+        self.online.watchdog = wd
+
     # -- ingest ------------------------------------------------------------
 
     def process(self, batch: Ratings,
@@ -314,6 +327,13 @@ class AdaptiveMF:
         """
         import jax.numpy as jnp
 
+        wd = self.online.watchdog
+        if wd is not None:
+            # the retrain ran from history on a separate code path — a
+            # diverged retrain must abort HERE, before it overwrites the
+            # live tables and refreshes every serving engine (streaming
+            # NaNs into a catalog swap is the failure this guards)
+            wd.check_swap(model.U, model.V)
         U = np.asarray(model.U)
         V = np.asarray(model.V)
         for table, T, index in ((self.online.users, U, model.users),

@@ -92,6 +92,11 @@ class DSGD:
             schedule=self.config.schedule_fn(),
         )
         self.model: MFModel | None = None
+        # divergence guard (obs.health.TrainingWatchdog): when attached,
+        # each segment boundary scans the full tables for NaN/Inf (a
+        # segment is seconds of work — the sweep is noise) and trips per
+        # the watchdog's policy. None = one pointer test per segment.
+        self.watchdog = None
 
     # -- fit ---------------------------------------------------------------
 
@@ -196,6 +201,10 @@ class DSGD:
                 U, V = train(U, V, iterations=seg, t0=done, k=k)
                 h.out = (U, V)
             done += seg
+            if self.watchdog is not None:
+                # BEFORE the checkpoint: a tripped segment must not
+                # persist its poisoned tables as a resume point
+                self.watchdog.after_segment(U, V, label=kind)
             if checkpoint_manager is not None:
                 checkpoint_manager.save(
                     done, {"U": np.asarray(U), "V": np.asarray(V)},

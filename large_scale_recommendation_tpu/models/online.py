@@ -182,6 +182,12 @@ class OnlineMF:
         # reusable padding buffers keyed by padded length (bounded: padded
         # lengths are pow2 buckets of the minibatch)
         self._pad_buffers: dict[int, tuple] = {}
+        # divergence guard (obs.health.TrainingWatchdog) — attach one to
+        # get NaN/Inf scans on each batch's touched rows, tripped BEFORE
+        # the WAL offset stamp so a halted/rolled-back batch can never
+        # be checkpointed. None (the default) is one pointer test per
+        # batch: zero-cost when unused.
+        self.watchdog = None
         # observability (null singletons when disabled — no clock reads,
         # no blocking on the async dispatch path)
         obs = get_registry()
@@ -262,6 +268,11 @@ class OnlineMF:
             self._m_batch_s.observe(time.perf_counter() - t0)
             self._m_batches.inc()
             self._m_ratings.inc(len(ru))
+        if self.watchdog is not None:
+            # BEFORE the offset stamp: a tripped halt/rollback raises
+            # here, so the stream position never claims a poisoned
+            # batch and the driver's checkpoint path never persists it
+            self.watchdog.after_batch(self, U, V, u_rows, i_rows)
         if offset is not None:
             # stamped only now, with the update APPLIED: an offset in
             # consumed_offsets always means "this slice is in the

@@ -16,6 +16,14 @@ package is that instrumentation layer, shared by every runtime tier:
   device time, and a compile-key hook labels first-call spans
   ``compile`` vs steady-state ``execute``. Exports Chrome trace-event
   JSON loadable in Perfetto (https://ui.perfetto.dev).
+- ``obs.health`` — the ACTIVE half: ``HealthMonitor`` (pluggable
+  OK/DEGRADED/CRITICAL checks), ``SLOTracker`` (latency-target
+  attainment + error-budget burn, wired into ``ServingEngine``),
+  ``TrainingWatchdog`` (NaN/divergence guard with halt/rollback
+  policies, hooked into the training tiers).
+- ``obs.server`` — a zero-dependency stdlib HTTP endpoint server:
+  ``/metrics`` (Prometheus text), ``/healthz`` (non-200 on CRITICAL),
+  ``/varz`` (snapshot JSON), ``/tracez`` (recent spans).
 
 Zero-cost when disabled — the design invariant every instrumented hot
 path relies on: the module-level defaults are a ``NullRegistry`` and
@@ -40,12 +48,23 @@ See docs/OBSERVABILITY.md for the metric-name catalog and span taxonomy.
 
 from __future__ import annotations
 
+from large_scale_recommendation_tpu.obs.health import (
+    CRITICAL,
+    DEGRADED,
+    OK,
+    CheckResult,
+    HealthMonitor,
+    SLOTracker,
+    TrainingDivergedError,
+    TrainingWatchdog,
+)
 from large_scale_recommendation_tpu.obs.registry import (
     MetricsRegistry,
     NullRegistry,
     get_registry,
     set_registry,
 )
+from large_scale_recommendation_tpu.obs.server import ObsServer
 from large_scale_recommendation_tpu.obs.trace import (
     NullTracer,
     Tracer,
@@ -67,6 +86,15 @@ __all__ = [
     "enable",
     "disable",
     "enabled",
+    "HealthMonitor",
+    "CheckResult",
+    "SLOTracker",
+    "TrainingWatchdog",
+    "TrainingDivergedError",
+    "ObsServer",
+    "OK",
+    "DEGRADED",
+    "CRITICAL",
 ]
 
 

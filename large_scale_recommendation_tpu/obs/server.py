@@ -1,0 +1,200 @@
+"""Zero-dependency HTTP endpoint server for the observability layer.
+
+Stdlib ``http.server`` only — no Prometheus client, no web framework —
+because the whole point is that any deployment of this package, however
+minimal, can expose its live state to a scraper or a ``curl``:
+
+- ``/metrics``  — Prometheus text exposition
+  (``MetricsRegistry.to_prometheus()``), the scrape surface.
+- ``/healthz``  — the aggregated ``HealthMonitor`` report as JSON.
+  **Non-200 (503) when any check is CRITICAL** — the contract load
+  balancers and k8s liveness probes key on. Without a monitor attached
+  the route reports trivial ``ok`` (the endpoint being up IS the check).
+- ``/varz``     — ``MetricsRegistry.snapshot()`` JSON (the form
+  ``scripts/obs_report.py --watch`` polls for terminal dashboards).
+- ``/tracez``   — the most recent spans (bounded tail of the tracer's
+  Chrome-trace buffer) as JSON, for a quick look without Perfetto.
+
+Usage::
+
+    from large_scale_recommendation_tpu import obs
+    from large_scale_recommendation_tpu.obs.health import HealthMonitor
+    from large_scale_recommendation_tpu.obs.server import ObsServer
+
+    reg, tracer = obs.enable()
+    monitor = HealthMonitor()
+    server = ObsServer(monitor=monitor).start()   # port 0 → ephemeral
+    print(server.url)                             # http://127.0.0.1:<port>
+    ...
+    server.stop()
+
+Checks run *per request* (pull model): ``/healthz`` always reflects the
+system's state at scrape time, and an idle system pays nothing — the
+same zero-cost-when-unused discipline as the rest of ``obs``. Handler
+threads are daemons (``ThreadingHTTPServer``), so a forgotten server
+never blocks interpreter exit; still, call ``stop()`` (or use the
+context-manager form) to release the socket deterministically.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from large_scale_recommendation_tpu.obs.health import CRITICAL
+from large_scale_recommendation_tpu.obs.registry import get_registry
+from large_scale_recommendation_tpu.obs.trace import get_tracer
+
+DEFAULT_TRACEZ_LIMIT = 256
+
+
+def http_get(url: str, timeout: float = 10.0) -> tuple[int, str]:
+    """``(status, body)`` for one GET — the scrape-side twin of the
+    routes above, shared by the demo and the CI conftest so non-200
+    handling can't drift. HTTP errors return their real status and
+    body; connection-level failures (server thread died) return a
+    synthetic 599 with the error text, so callers always get a
+    diagnosable pair instead of an exception."""
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:  # non-200 still carries a body
+        return e.code, e.read().decode()
+    except (urllib.error.URLError, OSError) as e:
+        return 599, repr(e)
+
+
+class ObsServer:
+    """Background-thread HTTP server over one registry/tracer/monitor.
+
+    ``registry``/``tracer`` default to the module-level ones AT
+    CONSTRUCTION (build the server after ``obs.enable()``), ``monitor``
+    is optional. ``port=0`` binds an ephemeral port — read ``.port`` /
+    ``.url`` after ``start()``. ``host`` defaults to loopback: exposing
+    metrics beyond the machine is a deployment decision, not a default.
+    """
+
+    def __init__(self, registry=None, tracer=None, monitor=None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 tracez_limit: int = DEFAULT_TRACEZ_LIMIT):
+        self.registry = registry or get_registry()
+        self.tracer = tracer or get_tracer()
+        self.monitor = monitor
+        self.host = host
+        self.port = int(port)
+        # the port the caller ASKED for, kept separate from the bound
+        # one: a stop()/start() cycle on port=0 must bind a fresh
+        # ephemeral port, not re-claim the last one (EADDRINUSE if any
+        # other process grabbed it in between)
+        self._requested_port = int(port)
+        self.tracez_limit = int(tracez_limit)
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ObsServer":
+        if self._httpd is not None:
+            return self
+        handler = _make_handler(self)
+        self._httpd = ThreadingHTTPServer((self.host, self._requested_port),
+                                          handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name=f"obs-server:{self.port}", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0) -> None:
+        httpd, self._httpd = self._httpd, None
+        thread, self._thread = self._thread, None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if thread is not None:
+            thread.join(timeout)
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    @property
+    def running(self) -> bool:
+        return self._httpd is not None
+
+    def __enter__(self) -> "ObsServer":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.stop()
+
+    # -- route bodies (shared with tests / in-process callers) --------------
+
+    def healthz(self) -> tuple[int, dict]:
+        """(http_status, report) for ``/healthz`` — 503 iff CRITICAL."""
+        if self.monitor is None:
+            report = {"status": "ok", "checks": {},
+                      "note": "no health monitor attached"}
+        else:
+            report = self.monitor.run()
+        code = 503 if report.get("status") == CRITICAL else 200
+        return code, report
+
+    def tracez(self) -> dict:
+        events = self.tracer.events()
+        return {"recent": events[-self.tracez_limit:],
+                "total_buffered": len(events),
+                "dropped": self.tracer.dropped}
+
+
+def _make_handler(server: ObsServer):
+    class Handler(BaseHTTPRequestHandler):
+        # one handler class per server instance; the closure carries the
+        # bound registry/tracer/monitor without module-global state
+
+        def do_GET(self):  # noqa: N802 (http.server API)
+            path = self.path.split("?", 1)[0].rstrip("/") or "/"
+            try:
+                if path == "/metrics":
+                    self._send(200, server.registry.to_prometheus(),
+                               "text/plain; version=0.0.4; charset=utf-8")
+                elif path in ("/healthz", "/health"):
+                    code, report = server.healthz()
+                    self._send_json(code, report)
+                elif path == "/varz":
+                    self._send_json(200, server.registry.snapshot())
+                elif path == "/tracez":
+                    self._send_json(200, server.tracez())
+                elif path == "/":
+                    self._send_json(200, {"routes": ["/metrics", "/healthz",
+                                                     "/varz", "/tracez"]})
+                else:
+                    self._send_json(404, {"error": f"no route {path!r}"})
+            except Exception as e:  # surface, don't kill the thread
+                try:
+                    self._send_json(500, {"error": repr(e)})
+                except OSError:
+                    pass  # client went away mid-error
+
+        def _send(self, code: int, body: str, ctype: str) -> None:
+            data = body.encode("utf-8")
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def _send_json(self, code: int, doc: dict) -> None:
+            self._send(code, json.dumps(doc),
+                       "application/json; charset=utf-8")
+
+        def log_message(self, fmt, *args):  # quiet: scrapes are not news
+            pass
+
+    return Handler

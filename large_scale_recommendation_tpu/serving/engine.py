@@ -77,7 +77,9 @@ class ServingEngine:
     ``Ratings`` or ``(user_ids, item_ids)`` exclusion set, same contract
     as ``MFModel.recommend``), ``dtype`` (``"bfloat16"`` opts into the
     half-width catalog), ``max_batch``/``min_bucket`` (the pow2 bucket
-    policy — ``max_batch`` must be a power of two).
+    policy — ``max_batch`` must be a power of two), ``slo`` (an
+    ``obs.health.SLOTracker``; every flush's synced wall is recorded
+    into its attainment window).
 
     Results carry the ``recommend`` conventions exactly: int64 ids,
     unknown users → -1/0.0 rows, below-catalog slots → -1/0.0.
@@ -90,7 +92,7 @@ class ServingEngine:
 
     def __init__(self, model: MFModel, k: int = 10, mesh=None,
                  train=None, dtype=None, max_batch: int = 1024,
-                 min_bucket: int = 8):
+                 min_bucket: int = 8, slo=None):
         if max_batch & (max_batch - 1):
             raise ValueError(f"max_batch must be a power of two, "
                              f"got {max_batch}")
@@ -126,6 +128,11 @@ class ServingEngine:
         self._m_requests = obs.counter("serving_requests_total")
         self._m_rows = obs.counter("serving_rows_total")
         self._obs = obs
+        # SLO wiring (obs.health.SLOTracker): each flush's synced wall —
+        # already measured for the meter, so attaching a tracker adds no
+        # clock reads — feeds the sliding attainment window. None (the
+        # default) is one pointer test per flush: zero-cost when unused.
+        self._slo = slo
         # swap-observation hook: called as ``on_refresh(version)`` after
         # every successful refresh, INSIDE the engine lock so concurrent
         # refreshes report their versions in swap order (the lock is
@@ -298,6 +305,8 @@ class ServingEngine:
             self.stats["rows"] += len(rows_all)
             wall = time.perf_counter() - t0
             self.meter.record(len(rows_all), wall)
+            if self._slo is not None:
+                self._slo.record(wall)
             if self._obs_on:
                 # results are host numpy by here, so the flush wall is a
                 # SYNCED end-to-end latency, not a dispatch time

@@ -144,6 +144,9 @@ class StreamingDriver:
                                      partition=part)
         self._m_lag = obs.gauge("streams_lag_records", partition=part)
         self._m_depth = obs.gauge("streams_queue_depth", partition=part)
+        # timed telemetry cadence (start_telemetry_export): None until
+        # explicitly started — zero threads, zero cost by default
+        self._telemetry_task = None
 
     # -- recovery ------------------------------------------------------------
 
@@ -339,6 +342,30 @@ class StreamingDriver:
             engine.refresh(snapshot)
 
     # -- telemetry -----------------------------------------------------------
+
+    def start_telemetry_export(self, interval_s: float = 5.0):
+        """Publish ``telemetry()`` into the registry on a timed cadence
+        (daemon thread). Without this, the lag/queue gauges only refresh
+        when someone calls ``telemetry()`` by hand — a ``/metrics``
+        scrape between calls would read stale stream lag. Idempotent:
+        an already-running exporter is returned as-is. The exporter is
+        independent of ``run()``'s lifecycle (telemetry of a *stopped*
+        driver — frozen consumed offset vs a still-growing log — is
+        exactly the lag signal a health check wants); stop it via
+        ``stop_telemetry_export()``. Returns the ``PeriodicTask``."""
+        if self._telemetry_task is not None and self._telemetry_task.running:
+            return self._telemetry_task
+        from large_scale_recommendation_tpu.obs.health import PeriodicTask
+
+        self._telemetry_task = PeriodicTask(
+            self.telemetry, interval_s,
+            name=f"telemetry-p{self.partition}").start()
+        return self._telemetry_task
+
+    def stop_telemetry_export(self) -> None:
+        task, self._telemetry_task = self._telemetry_task, None
+        if task is not None:
+            task.stop()
 
     def telemetry(self) -> dict:
         """One structured snapshot of the ingest tier: progress, lag

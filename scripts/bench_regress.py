@@ -1,0 +1,245 @@
+"""Bench regression gate: diff the newest bench round against a baseline.
+
+The bench rounds (``BENCH_r*.json``) are the repo's perf evidence, but
+nothing *reads* them across rounds — a 30% serving regression would ship
+silently as long as tier-1 stays green. This gate closes that gap::
+
+    python scripts/bench_regress.py                   # newest vs previous
+    python scripts/bench_regress.py --baseline BENCH_r03.json
+    python scripts/bench_regress.py --key serving_users_per_s=10
+    python scripts/bench_regress.py --report out.txt  # also write the table
+
+It loads both rounds, compares the watched keys (higher-is-better rates
+by default; ``--lower`` flags wall-clock-style keys), prints a table,
+and exits non-zero iff any watched key regressed past its percentage
+threshold. Keys missing on either side are reported but only fail under
+``--strict`` (machine/config drift between rounds routinely drops
+extras). Rounds flagged as CPU-fallback runs (an ``error`` field in the
+result) are compared anyway but the caveat is printed — cross-backend
+comparisons are noise, and CI runs this step non-blocking for exactly
+that reason.
+
+File formats accepted, per side:
+
+- a driver wrapper ``{"n", "cmd", "rc", "tail", "parsed"}`` — ``parsed``
+  is used when present; otherwise numeric ``"key": value`` pairs are
+  regex-salvaged from the (possibly front-truncated) ``tail``;
+- a raw bench JSON line (``{"metric", "value", "unit", "extra": {...}}``);
+- a flat ``{key: number}`` dict (hand-built baselines).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# watched keys → allowed regression (percent). Rates: higher is better.
+# Thresholds are deliberately loose — rounds run on shared machines with
+# real drift; the gate exists to catch step-function regressions, not
+# 5% noise (tighten per-key via --key NAME=PCT).
+DEFAULT_KEYS: dict[str, float] = {
+    "value": 30.0,  # the headline metric line
+    "e2e_ratings_per_s_incl_setup": 30.0,
+    "serving_users_per_s": 30.0,
+    "online_ratings_per_s": 30.0,
+    "online_ratings_per_s_steady": 30.0,
+    "ps_ratings_per_s": 30.0,
+    "als_rank32_rows_per_s": 30.0,
+}
+
+# keys where LOWER is better (walls, latencies) when watched explicitly
+DEFAULT_LOWER = ("_wall_s", "_ms_", "time_to_", "_s_p")
+
+_NUM_PAIR = re.compile(
+    r'"([A-Za-z_][A-Za-z0-9_]*)":\s*(-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)')
+
+
+def _salvage_numeric_pairs(text: str) -> dict[str, float]:
+    """Numeric ``"key": value`` pairs from a (possibly front-truncated)
+    stdout tail — array elements don't match (no preceding key), so
+    ``rmse_curve`` entries and friends are skipped."""
+    return {k: float(v) for k, v in _NUM_PAIR.findall(text)}
+
+
+def flatten_result(doc: dict) -> dict[str, float]:
+    """One flat {key: number} view of any accepted format. The headline
+    ``value`` keeps its name; ``extra.*`` keys are lifted to top level
+    (they don't collide — bench extras never use 'value')."""
+    if "tail" in doc or "parsed" in doc:  # driver wrapper
+        parsed = doc.get("parsed")
+        if isinstance(parsed, dict):
+            doc = parsed
+        else:
+            return _salvage_numeric_pairs(doc.get("tail") or "")
+    out: dict[str, float] = {}
+    if isinstance(doc.get("value"), (int, float)):
+        out["value"] = float(doc["value"])
+    extra = doc.get("extra")
+    if isinstance(extra, dict):
+        for k, v in extra.items():
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[k] = float(v)
+    if not out:  # flat {key: number} baseline
+        out = {k: float(v) for k, v in doc.items()
+               if isinstance(v, (int, float)) and not isinstance(v, bool)}
+    return out
+
+
+_ERR_PAIR = re.compile(r'"error":\s*"((?:[^"\\]|\\.)*)"')
+
+
+def load_result(path: str) -> tuple[dict[str, float], str | None]:
+    """(flat metrics, caveat-or-None) for one bench file."""
+    with open(path) as f:
+        doc = json.load(f)
+    inner = doc.get("parsed") if isinstance(doc.get("parsed"), dict) else doc
+    err = inner.get("error") or doc.get("error")
+    if not err and isinstance(doc.get("tail"), str):
+        # tail-salvaged rounds (parsed=null) carry the CPU-fallback
+        # caveat inside the tail text — a cross-backend comparison must
+        # not print caveat-free
+        m = _ERR_PAIR.search(doc["tail"])
+        if m:
+            err = m.group(1)
+    return flatten_result(doc), (str(err) if err else None)
+
+
+def find_rounds(directory: str = REPO) -> list[str]:
+    """BENCH_r*.json sorted by round number, oldest first."""
+    paths = glob.glob(os.path.join(directory, "BENCH_r*.json"))
+
+    def round_no(p: str) -> int:
+        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        return int(m.group(1)) if m else -1
+
+    return sorted((p for p in paths if round_no(p) >= 0), key=round_no)
+
+
+def is_lower_better(key: str, lower_flags: set[str]) -> bool:
+    return key in lower_flags or any(pat in key for pat in DEFAULT_LOWER)
+
+
+def compare(baseline: dict[str, float], current: dict[str, float],
+            keys: dict[str, float],
+            lower_flags: set[str] | None = None) -> list[dict]:
+    """One row per watched key: baseline, current, delta %, verdict.
+    Verdicts: ``ok`` / ``REGRESSION`` / ``missing`` (either side)."""
+    lower_flags = lower_flags or set()
+    rows = []
+    for key, pct in keys.items():
+        b, c = baseline.get(key), current.get(key)
+        row = {"key": key, "baseline": b, "current": c,
+               "threshold_pct": pct, "delta_pct": None, "verdict": "missing"}
+        if b is not None and c is not None:
+            lower = is_lower_better(key, lower_flags)
+            delta = ((c - b) / abs(b) * 100.0) if b else 0.0
+            row["delta_pct"] = delta
+            worse = -delta if not lower else delta
+            row["verdict"] = "REGRESSION" if worse > pct else "ok"
+        rows.append(row)
+    return rows
+
+
+def render_table(rows: list[dict], baseline_path: str,
+                 current_path: str) -> str:
+    sys.path.insert(0, REPO)  # absolute, so the script works from any cwd
+    from scripts.obs_report import format_table
+
+    def fmt(v):
+        if v is None:
+            return "-"
+        if isinstance(v, float):
+            return f"{v:,.1f}" if abs(v) >= 100 else f"{v:.4g}"
+        return str(v)
+
+    header = ("key", "baseline", "current", "delta%", "allowed%", "verdict")
+    body = [(r["key"], fmt(r["baseline"]), fmt(r["current"]),
+             fmt(r["delta_pct"]), fmt(r["threshold_pct"]), r["verdict"])
+            for r in rows]
+    lines = [f"baseline: {baseline_path}", f"current:  {current_path}", ""]
+    lines.extend(format_table(header, body))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--current", default=None,
+                    help="current round file (default: newest BENCH_r*.json)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: previous BENCH_r*.json)")
+    ap.add_argument("--key", action="append", default=[],
+                    metavar="NAME[=PCT]",
+                    help="watch NAME at PCT%% (repeatable; replaces the "
+                         "default key set when given)")
+    ap.add_argument("--threshold", type=float, default=None,
+                    help="override every watched key's threshold %%")
+    ap.add_argument("--lower", action="append", default=[], metavar="NAME",
+                    help="NAME is lower-is-better (walls/latency)")
+    ap.add_argument("--report", default=None,
+                    help="also write the table to this path")
+    ap.add_argument("--strict", action="store_true",
+                    help="missing watched keys fail too")
+    args = ap.parse_args(argv)
+
+    current, baseline = args.current, args.baseline
+    if current is None or baseline is None:
+        rounds = find_rounds()
+        if current is None:
+            if not rounds:
+                print("no BENCH_r*.json rounds found — nothing to gate")
+                return 2 if args.strict else 0
+            current = rounds[-1]
+        if baseline is None:
+            prior = [p for p in rounds if os.path.abspath(p)
+                     != os.path.abspath(current)]
+            if not prior:
+                print(f"only one round ({current}) — no baseline to "
+                      "diff against")
+                return 2 if args.strict else 0
+            baseline = prior[-1]
+
+    if args.key:
+        keys = {}
+        for spec in args.key:
+            name, _, pct = spec.partition("=")
+            keys[name] = float(pct) if pct else 30.0
+    else:
+        keys = dict(DEFAULT_KEYS)
+    if args.threshold is not None:
+        keys = {k: args.threshold for k in keys}
+
+    base_flat, base_caveat = load_result(baseline)
+    cur_flat, cur_caveat = load_result(current)
+    rows = compare(base_flat, cur_flat, keys, set(args.lower))
+    table = render_table(rows, baseline, current)
+    caveats = []
+    if base_caveat:
+        caveats.append(f"baseline caveat: {base_caveat}")
+    if cur_caveat:
+        caveats.append(f"current caveat:  {cur_caveat}")
+    out = table + ("\n\n" + "\n".join(caveats) if caveats else "")
+    print(out)
+    if args.report:
+        with open(args.report, "w") as f:
+            f.write(out + "\n")
+
+    regressed = [r["key"] for r in rows if r["verdict"] == "REGRESSION"]
+    missing = [r["key"] for r in rows if r["verdict"] == "missing"]
+    if regressed:
+        print(f"\nREGRESSION in: {', '.join(regressed)}")
+        return 1
+    if missing and args.strict:
+        print(f"\nmissing watched keys (strict): {', '.join(missing)}")
+        return 1
+    print("\nno regressions in watched keys")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,13 +6,23 @@ side::
     python scripts/obs_report.py metrics.jsonl       # last snapshot line
     python scripts/obs_report.py snapshot.json       # single snapshot
     python scripts/obs_report.py metrics.jsonl --name serving_flush_s
+    python scripts/obs_report.py http://127.0.0.1:8080/varz --watch 2
 
-Input is either a single-snapshot JSON file or a JSONL metrics log
-(``MetricsRegistry.append_jsonl``); for JSONL the LAST line is rendered
-(``--line N`` picks another, 0-based). ``--name SUBSTR`` filters rows.
+Input is a single-snapshot JSON file, a JSONL metrics log
+(``MetricsRegistry.append_jsonl``), or — live mode — an HTTP URL to a
+running ``obs.server.ObsServer``'s ``/varz`` route. For JSONL the LAST
+line is rendered (``--line N`` picks another, 0-based). ``--name
+SUBSTR`` filters rows.
 
-The same renderer is importable (``render_snapshot``) — the demo and
-tests drive it in-process.
+``--watch N`` polls the source every N seconds and renders *deltas and
+rates* between consecutive snapshots — counters show Δ and Δ/s,
+histograms show new observations per second next to their current
+p50/p99 — so the live endpoint is usable from a terminal without a
+Prometheus stack. ``--count M`` bounds the number of polls (default:
+until interrupted).
+
+The renderers are importable (``render_snapshot``, ``render_deltas``,
+``fetch_snapshot``) — the demo and tests drive them in-process.
 """
 
 from __future__ import annotations
@@ -20,6 +30,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
+import time
 
 
 def load_snapshot(path: str, line: int | None = None) -> dict:
@@ -108,15 +119,129 @@ def render_snapshot(snap: dict, name_filter: str | None = None) -> str:
     return "\n".join(out)
 
 
+def fetch_snapshot(src: str, line: int | None = None,
+                   timeout: float = 5.0) -> dict:
+    """One snapshot from a file path or a live ``/varz`` URL."""
+    if src.startswith(("http://", "https://")):
+        import urllib.request
+
+        with urllib.request.urlopen(src, timeout=timeout) as resp:
+            return json.loads(resp.read().decode("utf-8"))
+    return load_snapshot(src, line)
+
+
+def _index(snap: dict) -> dict:
+    return {(m["name"], tuple(sorted(m["labels"].items()))): m
+            for m in snap.get("metrics", [])}
+
+
+def snapshot_deltas(prev: dict, cur: dict, dt: float) -> list[dict]:
+    """Per-instrument deltas between two snapshots: counters get
+    ``delta``/``rate`` (per second), histograms get observation-count
+    deltas alongside their current quantiles, gauges get their current
+    value plus the change since the last snapshot (``delta``, no rate —
+    a gauge delta is rarely a rate, but it decides whether the row is
+    "active" in watch mode: a moving lag gauge must show up). New
+    instruments count from zero. ``dt`` ≤ 0 suppresses rates."""
+    before = _index(prev)
+    rows = []
+    for key, m in _index(cur).items():
+        p = before.get(key)
+        row = {"name": m["name"], "labels": m["labels"], "type": m["type"]}
+        if m["type"] in ("counter", "gauge"):
+            row["value"] = m["value"]
+            delta = m["value"] - (p["value"] if p else 0.0)
+            row["delta"] = delta
+            if m["type"] == "counter":
+                row["rate"] = delta / dt if dt > 0 else None
+        else:  # histogram
+            delta = m["count"] - (p["count"] if p else 0)
+            row["value"] = m["count"]
+            row["delta"] = delta
+            row["rate"] = delta / dt if dt > 0 else None
+            row["p50"] = m.get("p50")
+            row["p99"] = m.get("p99")
+        rows.append(row)
+    rows.sort(key=lambda r: (r["name"], sorted(r["labels"].items())))
+    return rows
+
+
+def format_table(header: tuple, rows: list) -> list[str]:
+    """Fixed-width left-aligned table lines (header, dashed rule, one
+    line per row of pre-formatted strings) — ONE copy of the layout
+    logic, shared with ``scripts/bench_regress.py``'s report table."""
+    widths = [max(len(header[i]), *(len(r[i]) for r in rows)) if rows
+              else len(header[i]) for i in range(len(header))]
+    lines = ["  ".join(h.ljust(widths[i]) for i, h in enumerate(header))]
+    lines.append("-" * (sum(widths) + 2 * (len(widths) - 1)))
+    for r in rows:
+        lines.append("  ".join(r[i].ljust(widths[i])
+                               for i in range(len(header))))
+    return lines
+
+
+def render_deltas(prev: dict, cur: dict, dt: float,
+                  name_filter: str | None = None,
+                  active_only: bool = False) -> str:
+    """Delta/rate table between two snapshots. ``active_only`` drops
+    rows whose counters/gauges/histograms saw nothing this interval."""
+    rows = snapshot_deltas(prev, cur, dt)
+    if name_filter:
+        rows = [r for r in rows if name_filter in r["name"]]
+    if active_only:
+        rows = [r for r in rows if r.get("delta")]
+    if not rows:
+        return "(no activity)" if active_only else "(no metrics)"
+    cells = [(r["name"], _label_str(r["labels"]), r["type"],
+              _fmt(r["value"]), _fmt(r.get("delta")),
+              _fmt(r.get("rate")), _fmt(r.get("p50")), _fmt(r.get("p99")))
+             for r in rows]
+    header = ("metric", "labels", "type", "value", "Δ", "Δ/s", "p50", "p99")
+    return "\n".join(format_table(header, cells))
+
+
+def watch(src: str, interval_s: float, count: int | None = None,
+          name_filter: str | None = None, out=sys.stdout) -> int:
+    """Poll ``src`` every ``interval_s`` and render deltas/rates. The
+    first poll prints the full snapshot (nothing to diff yet)."""
+    prev = fetch_snapshot(src)
+    print(f"# {src} — snapshot at {time.strftime('%H:%M:%S')}", file=out)
+    print(render_snapshot(prev, name_filter), file=out)
+    polls = 0
+    while count is None or polls < count:
+        time.sleep(interval_s)
+        cur = fetch_snapshot(src)
+        dt = cur.get("time", 0.0) - prev.get("time", 0.0)
+        if dt <= 0:
+            dt = interval_s
+        print(f"\n# Δ over {dt:.1f}s at {time.strftime('%H:%M:%S')}",
+              file=out)
+        print(render_deltas(prev, cur, dt, name_filter, active_only=True),
+              file=out)
+        prev = cur
+        polls += 1
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("path", help="snapshot JSON or metrics JSONL file")
+    ap.add_argument("path", help="snapshot JSON / metrics JSONL file, or "
+                                 "a live /varz URL")
     ap.add_argument("--line", type=int, default=None,
                     help="0-based JSONL line (default: last)")
     ap.add_argument("--name", default=None,
                     help="only metrics whose name contains this")
+    ap.add_argument("--watch", type=float, default=None, metavar="N",
+                    help="poll every N seconds and render deltas/rates")
+    ap.add_argument("--count", type=int, default=None,
+                    help="number of --watch polls (default: forever)")
     args = ap.parse_args(argv)
-    snap = load_snapshot(args.path, args.line)
+    if args.watch is not None:
+        try:
+            return watch(args.path, args.watch, args.count, args.name)
+        except KeyboardInterrupt:
+            return 0
+    snap = fetch_snapshot(args.path, args.line)
     print(render_snapshot(snap, args.name))
     return 0
 

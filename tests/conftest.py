@@ -21,21 +21,64 @@ force_cpu(n_devices=8)
 # OBS_OUT=<dir>: run the whole suite with the observability layer live
 # and dump the session's metrics JSONL + Prometheus snapshot + Chrome
 # trace there at exit — the artifact the CI workflow uploads for every
-# tier-1 run. Unset (the default, local runs): the null layer stays
-# installed and instrumentation costs nothing.
+# tier-1 run. The endpoint server also runs for the whole session, and
+# sessionfinish fetches /healthz + /metrics over the REAL socket (the
+# .prom artifact is the served body, proving the scrape surface end to
+# end); the /healthz report lands in tier1_healthz.json, which the CI
+# workflow gates on (job fails if status == "critical"). Unset (the
+# default, local runs): the null layer stays installed and
+# instrumentation costs nothing.
 _OBS_OUT = os.environ.get("OBS_OUT")
-_OBS_REG = _OBS_TRACER = None
+_OBS_REG = _OBS_TRACER = _OBS_SERVER = None
 if _OBS_OUT:
     from large_scale_recommendation_tpu import obs as _obs  # noqa: E402
+    from large_scale_recommendation_tpu.obs import health as _health  # noqa: E402
+    from large_scale_recommendation_tpu.obs.server import ObsServer  # noqa: E402
 
     _OBS_REG, _OBS_TRACER = _obs.enable()
+    _OBS_MONITOR = _health.HealthMonitor()
+
+    def _session_check():
+        # the layer itself is the subject: a live registry and a trace
+        # buffer that isn't silently dropping spans
+        if not _OBS_REG.enabled:
+            return _health.critical(note="registry not live")
+        if _OBS_TRACER.dropped:
+            return _health.degraded(dropped_spans=_OBS_TRACER.dropped)
+        return _health.ok(metric_names=len(_OBS_REG.names()))
+
+    _OBS_MONITOR.register("obs_session", _session_check)
+    _OBS_SERVER = ObsServer(registry=_OBS_REG, tracer=_OBS_TRACER,
+                            monitor=_OBS_MONITOR).start()
 
 
 def pytest_sessionfinish(session, exitstatus):
     if not _OBS_OUT:
         return
+    import json
+
+    from large_scale_recommendation_tpu.obs.server import http_get
+
     os.makedirs(_OBS_OUT, exist_ok=True)
     _OBS_REG.append_jsonl(os.path.join(_OBS_OUT, "tier1_metrics.jsonl"))
-    with open(os.path.join(_OBS_OUT, "tier1_metrics.prom"), "w") as f:
-        f.write(_OBS_REG.to_prometheus())
     _OBS_TRACER.to_chrome_trace(os.path.join(_OBS_OUT, "tier1_trace.json"))
+    # scrape the session's endpoint server for real: the artifacts below
+    # came over the socket, not from in-process calls (http_get turns a
+    # dead-server connection failure into a synthetic 599, so both
+    # artifacts always exist and the CI gate shows WHAT broke)
+    code, prom = http_get(_OBS_SERVER.url + "/metrics")
+    if code != 200:  # fall back so the artifact always exists
+        prom = _OBS_REG.to_prometheus()
+    with open(os.path.join(_OBS_OUT, "tier1_metrics.prom"), "w") as f:
+        f.write(prom)
+    code, body = http_get(_OBS_SERVER.url + "/healthz")
+    try:
+        report = json.loads(body)
+    except ValueError:
+        report = {"status": "critical",
+                  "error": "unparseable /healthz body",
+                  "body": body[:500]}
+    report["http_status"] = code
+    with open(os.path.join(_OBS_OUT, "tier1_healthz.json"), "w") as f:
+        json.dump(report, f, indent=2)
+    _OBS_SERVER.stop()

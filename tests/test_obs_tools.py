@@ -1,0 +1,205 @@
+"""The terminal-side observability tooling: the bench regression gate
+(``scripts/bench_regress.py`` — wrapper/raw/salvage loading, threshold
+verdicts, exit codes) and the live-watch delta math in
+``scripts/obs_report.py``.
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from scripts.bench_regress import (  # noqa: E402
+    compare,
+    flatten_result,
+    load_result,
+    main as regress_main,
+)
+from scripts.obs_report import snapshot_deltas  # noqa: E402
+
+
+def _bench_doc(value=1000.0, extra=None):
+    return {"metric": "ratings/s test", "value": value, "unit": "ratings/s",
+            "vs_baseline": 1.0, "extra": extra or {}}
+
+
+def _wrapper(parsed=None, tail=""):
+    return {"n": 1, "cmd": "python bench.py", "rc": 0, "tail": tail,
+            "parsed": parsed}
+
+
+class TestLoading:
+    def test_raw_bench_line(self, tmp_path):
+        p = tmp_path / "raw.json"
+        p.write_text(json.dumps(_bench_doc(
+            2000.0, {"serving_users_per_s": 42.5, "pipeline": "device"})))
+        flat, caveat = load_result(str(p))
+        assert flat == {"value": 2000.0, "serving_users_per_s": 42.5}
+        assert caveat is None
+
+    def test_wrapper_with_parsed(self, tmp_path):
+        p = tmp_path / "w.json"
+        p.write_text(json.dumps(_wrapper(parsed=_bench_doc(
+            3000.0, {"online_ratings_per_s": 7.0}))))
+        flat, _ = load_result(str(p))
+        assert flat["value"] == 3000.0
+        assert flat["online_ratings_per_s"] == 7.0
+
+    def test_truncated_tail_salvage(self, tmp_path):
+        """A front-truncated tail (the real r05 shape) still yields its
+        numeric pairs — array elements (no preceding key) don't match."""
+        tail = ('_per_s\": 123.4, \"rmse_curve\": [0.27, 0.26], '
+                '\"serving_users_per_s\": 25837.8}}')
+        p = tmp_path / "t.json"
+        p.write_text(json.dumps(_wrapper(parsed=None, tail=tail)))
+        flat, _ = load_result(str(p))
+        assert flat["serving_users_per_s"] == 25837.8
+        assert 0.26 not in flat.values()  # curve entries not salvaged
+
+    def test_error_field_is_caveat(self, tmp_path):
+        doc = _bench_doc(1.0)
+        doc["error"] = "CPU fallback run"
+        p = tmp_path / "e.json"
+        p.write_text(json.dumps(_wrapper(parsed=doc)))
+        _, caveat = load_result(str(p))
+        assert "CPU fallback" in caveat
+
+    def test_flat_baseline_dict(self):
+        flat = flatten_result({"serving_users_per_s": 10.0, "note": "x"})
+        assert flat == {"serving_users_per_s": 10.0}
+
+
+class TestCompare:
+    def test_verdicts(self):
+        base = {"a": 100.0, "b": 100.0, "c": 100.0}
+        cur = {"a": 95.0, "b": 60.0}
+        rows = compare(base, cur, {"a": 10.0, "b": 10.0, "c": 10.0})
+        by_key = {r["key"]: r for r in rows}
+        assert by_key["a"]["verdict"] == "ok"  # -5% within 10%
+        assert by_key["b"]["verdict"] == "REGRESSION"  # -40%
+        assert by_key["c"]["verdict"] == "missing"
+
+    def test_improvement_is_ok(self):
+        rows = compare({"a": 100.0}, {"a": 300.0}, {"a": 10.0})
+        assert rows[0]["verdict"] == "ok"
+
+    def test_lower_is_better_keys(self):
+        # *_wall_s is auto-flagged lower-better: growth is the regression
+        rows = compare({"dsgd_train_wall_s": 2.0},
+                       {"dsgd_train_wall_s": 3.0},
+                       {"dsgd_train_wall_s": 10.0})
+        assert rows[0]["verdict"] == "REGRESSION"
+        rows = compare({"dsgd_train_wall_s": 2.0},
+                       {"dsgd_train_wall_s": 1.0},
+                       {"dsgd_train_wall_s": 10.0})
+        assert rows[0]["verdict"] == "ok"
+
+
+class TestGateEndToEnd:
+    def _write(self, tmp_path, name, value, extra=None):
+        p = tmp_path / name
+        p.write_text(json.dumps(_wrapper(parsed=_bench_doc(value, extra))))
+        return str(p)
+
+    def test_ok_exit_zero(self, tmp_path, capsys):
+        b = self._write(tmp_path, "b.json", 1000.0,
+                        {"serving_users_per_s": 50.0})
+        c = self._write(tmp_path, "c.json", 980.0,
+                        {"serving_users_per_s": 51.0})
+        rc = regress_main(["--baseline", b, "--current", c])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "no regressions" in out
+
+    def test_regression_exit_one_and_table(self, tmp_path, capsys):
+        b = self._write(tmp_path, "b.json", 1000.0)
+        c = self._write(tmp_path, "c.json", 500.0)
+        rc = regress_main(["--baseline", b, "--current", c,
+                           "--key", "value=20"])
+        assert rc == 1
+        out = capsys.readouterr().out
+        assert "REGRESSION" in out and "value" in out
+
+    def test_report_file_written(self, tmp_path):
+        b = self._write(tmp_path, "b.json", 1000.0)
+        c = self._write(tmp_path, "c.json", 990.0)
+        report = tmp_path / "report.txt"
+        rc = regress_main(["--baseline", b, "--current", c,
+                           "--report", str(report)])
+        assert rc == 0
+        assert "baseline" in report.read_text()
+
+    def test_missing_key_fails_only_strict(self, tmp_path):
+        b = self._write(tmp_path, "b.json", 1000.0,
+                        {"serving_users_per_s": 50.0})
+        c = self._write(tmp_path, "c.json", 1000.0)  # extra key gone
+        args = ["--baseline", b, "--current", c,
+                "--key", "value=30", "--key", "serving_users_per_s=30"]
+        assert regress_main(args) == 0
+        assert regress_main(args + ["--strict"]) == 1
+
+    def test_real_rounds_parse(self):
+        """Every committed *successful* BENCH_r*.json loads into a
+        non-empty flat metric dict — the gate can always read the
+        repo's own rounds (a crashed round, rc != 0 with a traceback
+        tail, legitimately yields nothing and must not blow up)."""
+        from scripts.bench_regress import find_rounds
+
+        rounds = find_rounds()
+        assert len(rounds) >= 2
+        parsed_any = 0
+        for path in rounds:
+            with open(path) as f:
+                rc = json.load(f).get("rc")
+            flat, _ = load_result(path)  # must never raise
+            if rc == 0:
+                assert flat, f"no numeric keys salvaged from {path}"
+                parsed_any += 1
+        assert parsed_any >= 2  # enough healthy rounds to actually gate
+
+
+class TestWatchDeltas:
+    def _snap(self, t, counter=0.0, gauge=0.0, hist_count=0):
+        return {"time": t, "metrics": [
+            {"name": "c_total", "type": "counter", "labels": {},
+             "value": counter},
+            {"name": "g", "type": "gauge", "labels": {"x": "1"},
+             "value": gauge},
+            {"name": "h_s", "type": "histogram", "labels": {},
+             "count": hist_count, "sum": 1.0, "mean": 0.1, "min": 0.1,
+             "max": 0.1, "p50": 0.1, "p90": 0.1, "p99": 0.1},
+        ]}
+
+    def test_counter_and_histogram_rates(self):
+        rows = snapshot_deltas(self._snap(0, counter=10, hist_count=4),
+                               self._snap(2, counter=30, gauge=7.0,
+                                          hist_count=10), dt=2.0)
+        by = {r["name"]: r for r in rows}
+        assert by["c_total"]["delta"] == 20
+        assert by["c_total"]["rate"] == 10.0
+        assert by["h_s"]["delta"] == 6
+        assert by["h_s"]["rate"] == 3.0
+        assert by["h_s"]["p99"] == 0.1
+        # gauges: value + change (no rate) — the delta is what keeps a
+        # moving lag/SLO gauge visible in --watch's active-only view
+        assert by["g"]["value"] == 7.0
+        assert by["g"]["delta"] == 7.0
+        assert "rate" not in by["g"]
+
+    def test_watch_active_view_keeps_moving_gauges(self):
+        from scripts.obs_report import render_deltas
+
+        prev = self._snap(0, gauge=3.0)
+        cur = self._snap(1, gauge=9.0)
+        table = render_deltas(prev, cur, dt=1.0, active_only=True)
+        assert "g" in table.splitlines()[2]  # the gauge row survived
+        stale = render_deltas(cur, cur, dt=1.0, active_only=True)
+        assert "(no activity)" in stale  # unchanged gauge drops out
+
+    def test_new_instrument_counts_from_zero(self):
+        prev = {"time": 0, "metrics": []}
+        rows = snapshot_deltas(prev, self._snap(1, counter=5), dt=1.0)
+        by = {r["name"]: r for r in rows}
+        assert by["c_total"]["delta"] == 5
