@@ -17,12 +17,19 @@ doing their job:
    offset stamp, rolls the model back to the last durable checkpoint,
    and ``/healthz`` flips to 503 with the training check CRITICAL —
    the poisoned batch never reaches a checkpoint or a catalog swap.
+   Because a flight recorder is running (step 1), the trip also
+   FREEZES A POSTMORTEM BUNDLE — recent metric series, the structured
+   event tail (catalog swaps, checkpoints, the trip itself), the span
+   tail, and the health/registry snapshots — whose path is printed and
+   which ``scripts/obs_report.py --bundle <dir>`` renders.
 
 Artifacts under ``--out`` (default ``obs_out/``): ``metrics.prom``
 (fetched from the live ``/metrics`` route), ``metrics.jsonl``,
 ``trace.json`` (Perfetto-loadable), ``healthz.json`` (the final
-CRITICAL report). ``scripts/obs_report.py <url>/varz --watch 2`` tails
-the same server live.
+CRITICAL report), and ``postmortem/bundle_watchdog_trip_*/`` (the
+validated incident bundle). ``scripts/obs_report.py <url>/varz
+--watch 2`` tails the same server live; ``/seriesz`` and ``/eventz``
+serve the recorder's history and the event ring.
 
 Run: ``JAX_PLATFORMS=cpu python examples/obs_demo.py``
 """
@@ -49,9 +56,13 @@ def main(argv=None) -> int:
 
     from large_scale_recommendation_tpu import obs
 
-    # enable FIRST: instruments bind at construction time
+    # enable FIRST: instruments bind at construction time — and the
+    # flight recorder right after, so event hooks bind too and the
+    # sampler is already recording the lead-up when the incident hits
     reg, tracer = obs.enable()
     tracer.install_jax_compile_hook()
+    recorder, journal = obs.enable_flight_recorder(
+        interval_s=0.25, bundle_dir=os.path.join(args.out, "postmortem"))
 
     from large_scale_recommendation_tpu.core.generators import (
         SyntheticMFGenerator,
@@ -150,12 +161,32 @@ def main(argv=None) -> int:
                   f"rolled_back={e.rolled_back} — the poisoned offset was "
                   "never stamped, no checkpoint/catalog swap saw NaNs")
 
+        # ---- the trip froze a postmortem bundle ------------------------
+        from large_scale_recommendation_tpu.obs.recorder import (
+            validate_bundle,
+        )
+
+        bundle = watchdog.last_bundle
+        assert bundle is not None, "watchdog trip wrote no bundle"
+        manifest = validate_bundle(bundle)  # the schema contract holds
+        print(f"# postmortem bundle: {bundle}")
+        print(f"#   trigger={manifest['trigger']!r} "
+              f"series={manifest['counts']['series']} "
+              f"events={manifest['counts']['events']} "
+              f"spans={manifest['counts']['spans']} — render it with "
+              f"scripts/obs_report.py --bundle {bundle}")
+        _, eventz = _curl(server.url + "/eventz")
+        kinds = sorted({e["kind"]
+                        for e in json.loads(eventz)["recent"]})
+        print(f"# eventz: {len(journal)} journaled, kinds={kinds}")
+
         code, body = _curl(server.url + "/healthz")
         report = json.loads(body)
         print(f"# healthz (tripped): HTTP {code}, "
               f"training={report['checks']['training']['status']!r}")
         assert code == 503, body
         driver.stop_telemetry_export()
+        recorder.stop()
 
         # ---- dump the artifacts ----------------------------------------
         os.makedirs(args.out, exist_ok=True)

@@ -56,6 +56,7 @@ from large_scale_recommendation_tpu.models.online import (
     OnlineMF,
     OnlineMFConfig,
 )
+from large_scale_recommendation_tpu.obs.events import get_events
 from large_scale_recommendation_tpu.obs.registry import get_registry
 from large_scale_recommendation_tpu.obs.trace import get_tracer
 
@@ -119,6 +120,10 @@ class AdaptiveMF:
         obs = get_registry()
         self._obs_on = obs.enabled
         self._trace = get_tracer()
+        # structured event journal (obs.events): None unless installed —
+        # retrain start/install/abort emissions are one `is not None`
+        # test each, all on the (cold) retrain path
+        self._events = get_events()
         self._m_retrains = obs.counter("adaptive_retrains_total")
         self._m_retrain_s = obs.histogram("adaptive_retrain_s")
         self._manager = None
@@ -224,6 +229,11 @@ class AdaptiveMF:
             return
         self._batches_since_retrain = 0
         history = self._history_ratings()
+        if self._events is not None:
+            self._events.emit("adaptive.retrain_start",
+                              algorithm=self.config.offline_algorithm,
+                              rows=int(history.n),
+                              background=self.config.background)
         if self.config.background:
             self._state = "Batch"
             self._retrained = None
@@ -333,7 +343,15 @@ class AdaptiveMF:
             # diverged retrain must abort HERE, before it overwrites the
             # live tables and refreshes every serving engine (streaming
             # NaNs into a catalog swap is the failure this guards)
-            wd.check_swap(model.U, model.V)
+            try:
+                wd.check_swap(model.U, model.V)
+            except BaseException:
+                if self._events is not None:
+                    self._events.emit("adaptive.retrain_abort",
+                                      severity="error",
+                                      reason="diverged_retrain",
+                                      retrain_count=self.retrain_count)
+                raise
         U = np.asarray(model.U)
         V = np.asarray(model.V)
         for table, T, index in ((self.online.users, U, model.users),
@@ -356,6 +374,10 @@ class AdaptiveMF:
         snapshot = self.to_model() if engines else None
         for engine in engines:
             engine.refresh(snapshot)
+        if self._events is not None:
+            self._events.emit("adaptive.retrain_install",
+                              retrain_count=self.retrain_count + 1,
+                              engines_refreshed=len(engines))
 
     def serving_engine(self, k: int = 10, **kwargs):
         """A ``ServingEngine`` bound to the CURRENT serving snapshot

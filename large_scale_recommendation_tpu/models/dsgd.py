@@ -97,6 +97,12 @@ class DSGD:
         # segment is seconds of work — the sweep is noise) and trips per
         # the watchdog's policy. None = one pointer test per segment.
         self.watchdog = None
+        # structured event journal (obs.events): None unless installed —
+        # segment/checkpoint emissions are one `is not None` test each,
+        # once per segment (seconds of work)
+        from large_scale_recommendation_tpu.obs.events import get_events
+
+        self._events = get_events()
 
     # -- fit ---------------------------------------------------------------
 
@@ -205,11 +211,18 @@ class DSGD:
                 # BEFORE the checkpoint: a tripped segment must not
                 # persist its poisoned tables as a resume point
                 self.watchdog.after_segment(U, V, label=kind)
+            if self._events is not None:
+                self._events.emit("train.segment", model="dsgd", kind=kind,
+                                  iterations=int(seg), done=int(done),
+                                  total=int(cfg.iterations))
             if checkpoint_manager is not None:
                 checkpoint_manager.save(
                     done, {"U": np.asarray(U), "V": np.asarray(V)},
                     {"kind": kind, "iterations": cfg.iterations},
                 )
+                if self._events is not None:
+                    self._events.emit("train.checkpoint", model="dsgd",
+                                      kind=kind, step=int(done))
         timer.finish(n_ratings)
         return U, V
 

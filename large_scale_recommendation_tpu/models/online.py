@@ -49,6 +49,7 @@ from large_scale_recommendation_tpu.core.types import (
 )
 from large_scale_recommendation_tpu.core.updaters import SGDUpdater
 from large_scale_recommendation_tpu.data.tables import GrowableFactorTable
+from large_scale_recommendation_tpu.obs.events import get_events
 from large_scale_recommendation_tpu.obs.registry import get_registry
 from large_scale_recommendation_tpu.obs.trace import get_tracer
 from large_scale_recommendation_tpu.ops import sgd as sgd_ops
@@ -193,6 +194,9 @@ class OnlineMF:
         obs = get_registry()
         self._obs_on = obs.enabled
         self._trace = get_tracer()
+        # structured event journal (obs.events): None unless installed —
+        # the table-growth emission is one `is not None` test per batch
+        self._events = get_events()
         self._m_batch_s = obs.histogram("online_batch_s")
         self._m_batches = obs.counter("online_batches_total")
         self._m_ratings = obs.counter("online_ratings_total")
@@ -233,8 +237,20 @@ class OnlineMF:
                     if emit_updates else None)
 
         t0 = time.perf_counter() if self._obs_on else 0.0
+        ev = self._events
+        if ev is not None:  # growth detection costs two attr reads,
+            cap_u = self.users.capacity  # journaled runs only
+            cap_i = self.items.capacity
         u_rows = self.users.ensure(ru)
         i_rows = self.items.ensure(ri)
+        if ev is not None and (self.users.capacity != cap_u
+                               or self.items.capacity != cap_i):
+            # capacity doubling is rare and operationally loud (it
+            # recompiles the update kernels at the new table shape) —
+            # exactly the discrete lead-up marker a postmortem wants
+            ev.emit("online.table_growth", step=self.step,
+                    users_capacity=int(self.users.capacity),
+                    items_capacity=int(self.items.capacity))
 
         ur, ir, vals, w = sgd_ops.pad_minibatches(
             u_rows, i_rows, rv, cfg.minibatch_size,

@@ -23,7 +23,17 @@ package is that instrumentation layer, shared by every runtime tier:
   policies, hooked into the training tiers).
 - ``obs.server`` — a zero-dependency stdlib HTTP endpoint server:
   ``/metrics`` (Prometheus text), ``/healthz`` (non-200 on CRITICAL),
-  ``/varz`` (snapshot JSON), ``/tracez`` (recent spans).
+  ``/varz`` (snapshot JSON), ``/tracez`` (recent spans), ``/seriesz``
+  (flight-recorder history), ``/eventz`` (structured event journal).
+- ``obs.recorder`` / ``obs.events`` / ``obs.anomaly`` — the FLIGHT
+  RECORDER: a fixed-memory time-series store sampling every registry
+  instrument on a cadence (tiered downsampling bounds the heap), a
+  ring-bounded structured event journal correlated to trace span ids,
+  EWMA/rate-of-change anomaly checks that learn a series' normal
+  instead of needing static thresholds, and atomic postmortem bundle
+  directories frozen on watchdog trips / CRITICAL health transitions
+  (``validate_bundle`` is the schema contract;
+  ``scripts/obs_report.py --bundle`` renders one).
 
 Zero-cost when disabled — the design invariant every instrumented hot
 path relies on: the module-level defaults are a ``NullRegistry`` and
@@ -48,6 +58,16 @@ See docs/OBSERVABILITY.md for the metric-name catalog and span taxonomy.
 
 from __future__ import annotations
 
+from large_scale_recommendation_tpu.obs.anomaly import (
+    AnomalyCheck,
+    ewma_zscore,
+    rate_of_change,
+)
+from large_scale_recommendation_tpu.obs.events import (
+    EventJournal,
+    get_events,
+    set_events,
+)
 from large_scale_recommendation_tpu.obs.health import (
     CRITICAL,
     DEGRADED,
@@ -57,6 +77,15 @@ from large_scale_recommendation_tpu.obs.health import (
     SLOTracker,
     TrainingDivergedError,
     TrainingWatchdog,
+)
+from large_scale_recommendation_tpu.obs.recorder import (
+    FlightRecorder,
+    get_recorder,
+    load_bundle,
+    series_key,
+    set_recorder,
+    validate_bundle,
+    write_bundle,
 )
 from large_scale_recommendation_tpu.obs.registry import (
     MetricsRegistry,
@@ -86,6 +115,20 @@ __all__ = [
     "enable",
     "disable",
     "enabled",
+    "enable_flight_recorder",
+    "FlightRecorder",
+    "EventJournal",
+    "AnomalyCheck",
+    "ewma_zscore",
+    "rate_of_change",
+    "get_recorder",
+    "set_recorder",
+    "get_events",
+    "set_events",
+    "series_key",
+    "validate_bundle",
+    "load_bundle",
+    "write_bundle",
     "HealthMonitor",
     "CheckResult",
     "SLOTracker",
@@ -112,11 +155,45 @@ def enable(registry: MetricsRegistry | None = None,
     return registry, tracer
 
 
+def enable_flight_recorder(interval_s: float = 1.0,
+                           bundle_dir: str | None = None,
+                           event_capacity: int = 4096,
+                           event_jsonl: str | None = None,
+                           start: bool = True,
+                           **recorder_kwargs):
+    """Install the flight-recorder layer: an ``EventJournal`` as the
+    module-level journal and a ``FlightRecorder`` as the module-level
+    recorder (started unless ``start=False``). Call AFTER ``enable()``
+    (the recorder samples the live registry; the journal stamps the
+    live tracer's span ids) and BEFORE building the engines/drivers/
+    models whose emissions you want journaled — event hooks bind at
+    construction, same as the instruments. Returns
+    ``(recorder, journal)``."""
+    prev = get_recorder()
+    if prev is not None:  # re-enable must not leak the old sampler
+        prev.stop()       # thread (unreachable once replaced)
+    journal = EventJournal(capacity=event_capacity, jsonl_path=event_jsonl)
+    set_events(journal)
+    recorder = FlightRecorder(interval_s=interval_s, bundle_dir=bundle_dir,
+                              **recorder_kwargs)
+    set_recorder(recorder)
+    if start:
+        recorder.start()
+    return recorder, journal
+
+
 def disable() -> None:
-    """Restore the zero-cost null registry/tracer defaults."""
+    """Restore the zero-cost defaults: null registry/tracer, and no
+    flight recorder or event journal at all (their sampler thread is
+    stopped first)."""
     from large_scale_recommendation_tpu.obs import registry as _r
     from large_scale_recommendation_tpu.obs import trace as _t
 
+    recorder = get_recorder()
+    if recorder is not None:
+        recorder.stop()
+    set_recorder(None)
+    set_events(None)
     set_registry(_r.NULL_REGISTRY)
     set_tracer(_t.NULL_TRACER)
 

@@ -1,0 +1,189 @@
+"""Anomaly detection over flight-recorder series: EWMA z-score + rate
+of change.
+
+Static health thresholds (PR 4) need someone to KNOW the right number —
+but "the right number" for throughput or lag depends on workload, chip,
+and time of day. These detectors learn the recent normal from the
+recorder's own history and flag departures from it, so a throughput
+collapse or a lag explosion flips ``/healthz`` *before* any absolute
+threshold would, with no threshold configured at all.
+
+Math (numpy-pinned in ``tests/test_obs_anomaly.py``):
+
+- ``ewma_mean_var(values, alpha)`` — exponentially weighted mean and
+  variance (the standard incremental form: ``d = x - m;
+  m += α·d; v = (1-α)·(v + α·d²)``), returned per step so a test can
+  check every prefix against a reference loop.
+- ``ewma_zscore(values, alpha)`` — the z-score of the LAST value
+  against the EWMA mean/stddev of everything BEFORE it. The newest
+  sample never contaminates the baseline it is judged against.
+- ``rate_of_change(values, span)`` — relative change of the last value
+  vs ``span`` steps earlier: ``(last - prev) / max(|prev|, eps)``.
+
+``AnomalyCheck`` packages them as a ``HealthMonitor`` check over one
+recorder series: OK while warming (a baseline learned from too few
+points is noise), DEGRADED at ``degraded_z`` deviations, CRITICAL at
+``critical_z`` — with a ``direction`` filter so a throughput check
+pages on collapses, not on the system getting faster. ``mode="delta"``
+first-differences the series, turning a monotonic counter into the rate
+signal the z-score actually wants.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from large_scale_recommendation_tpu.obs.health import (
+    CheckResult,
+    critical,
+    degraded,
+    ok,
+)
+
+DIRECTIONS = ("drop", "spike", "both")
+
+
+def ewma_mean_var(values, alpha: float = 0.25):
+    """Per-step EWMA mean and variance arrays (same length as input)."""
+    v = np.asarray(values, dtype=np.float64)
+    if not 0.0 < alpha <= 1.0:
+        raise ValueError(f"alpha must be in (0, 1], got {alpha}")
+    means = np.empty_like(v)
+    variances = np.empty_like(v)
+    m = var = 0.0
+    for i, x in enumerate(v):
+        if i == 0:
+            m, var = float(x), 0.0
+        else:
+            d = float(x) - m
+            m += alpha * d
+            var = (1.0 - alpha) * (var + alpha * d * d)
+        means[i] = m
+        variances[i] = var
+    return means, variances
+
+
+def ewma_zscore(values, alpha: float = 0.25) -> float:
+    """z of ``values[-1]`` against the EWMA baseline of ``values[:-1]``.
+
+    A near-zero learned variance (flat series) is floored relative to
+    the mean's magnitude, so a genuine step off a perfectly flat
+    baseline reads as a large-but-finite z instead of dividing by
+    zero."""
+    v = np.asarray(values, dtype=np.float64)
+    if len(v) < 2:
+        return 0.0
+    means, variances = ewma_mean_var(v[:-1], alpha)
+    m = float(means[-1])
+    std = math.sqrt(float(variances[-1]))
+    floor = 1e-9 + 1e-3 * abs(m)
+    return (float(v[-1]) - m) / max(std, floor)
+
+
+def rate_of_change(values, span: int = 1) -> float:
+    """Relative change of the last value vs ``span`` steps earlier."""
+    v = np.asarray(values, dtype=np.float64)
+    if span < 1:
+        raise ValueError(f"span must be >= 1, got {span}")
+    if len(v) <= span:
+        return 0.0
+    prev, last = float(v[-1 - span]), float(v[-1])
+    return (last - prev) / max(abs(prev), 1e-9)
+
+
+class AnomalyCheck:
+    """Threshold-free health check over one flight-recorder series.
+
+    ``recorder`` is an ``obs.recorder.FlightRecorder``; ``series`` a
+    key from ``recorder.series_names()`` (``series_key(name, labels)``
+    builds one). ``direction``: ``"drop"`` pages only on values below
+    the learned baseline (throughput), ``"spike"`` only above (lag,
+    latency), ``"both"`` on either. ``mode="delta"`` first-differences
+    the series (counters → rates). The verdict carries the z-score,
+    the rate of change, the baseline, and the last value, so a
+    ``/healthz`` reader sees WHY it flagged.
+    """
+
+    def __init__(self, recorder, series: str, alpha: float = 0.25,
+                 warmup: int = 8, degraded_z: float = 3.0,
+                 critical_z: float = 6.0, direction: str = "both",
+                 mode: str = "value", max_points: int = 256,
+                 roc_span: int = 1):
+        if direction not in DIRECTIONS:
+            raise ValueError(f"unknown direction {direction!r}; expected "
+                             f"one of {DIRECTIONS}")
+        if mode not in ("value", "delta"):
+            raise ValueError(f"unknown mode {mode!r}; expected 'value' or "
+                             "'delta'")
+        if warmup < 3:
+            raise ValueError(f"warmup must be >= 3, got {warmup}")
+        if not 0 < degraded_z <= critical_z:
+            raise ValueError(f"need 0 < degraded_z <= critical_z, got "
+                             f"({degraded_z}, {critical_z})")
+        self.recorder = recorder
+        self.series = series
+        self.alpha = float(alpha)
+        self.warmup = int(warmup)
+        self.degraded_z = float(degraded_z)
+        self.critical_z = float(critical_z)
+        self.direction = direction
+        self.mode = mode
+        self.max_points = int(max_points)
+        self.roc_span = int(roc_span)
+
+    def _signal(self, values) -> tuple[float, float]:
+        vals = np.asarray(values, dtype=np.float64)
+        if self.mode == "delta":
+            vals = np.diff(vals)
+        if len(vals) < 2:
+            return 0.0, 0.0
+        return (ewma_zscore(vals, self.alpha),
+                rate_of_change(vals, self.roc_span))
+
+    def _effective(self, z: float) -> float:
+        """The severity-relevant magnitude after the direction filter:
+        a drop-watcher ignores positive z entirely (and vice versa)."""
+        if self.direction == "drop":
+            return max(0.0, -z)
+        if self.direction == "spike":
+            return max(0.0, z)
+        return abs(z)
+
+    def __call__(self) -> CheckResult:
+        values = self.recorder.series_values(self.series,
+                                             last_n=self.max_points)
+        # A non-finite LAST value IS the incident (a NaN gauge is
+        # exactly what precedes a trip), and any non-finite sample left
+        # in the window would propagate through the EWMA baseline:
+        # z=NaN compares False against every threshold, so the check
+        # would return ok through a genuine collapse — and the bare NaN
+        # in the detail would break strict-JSON /healthz readers.
+        if values and not math.isfinite(values[-1]):
+            return critical(series=self.series, reason="non_finite_value",
+                            last=repr(values[-1]), points=len(values))
+        finite = [x for x in values if math.isfinite(x)]
+        dropped = len(values) - len(finite)
+        need = self.warmup + (1 if self.mode == "delta" else 0)
+        if len(finite) < need:
+            return ok(note=f"warming ({len(finite)}/{need} points)",
+                      series=self.series)
+        z, roc = self._signal(finite)
+        eff = self._effective(z)
+        detail = {
+            "series": self.series,
+            "z": round(z, 3),
+            "rate_of_change": round(roc, 4),
+            "last": finite[-1],
+            "points": len(finite),
+            "direction": self.direction,
+            "mode": self.mode,
+        }
+        if dropped:
+            detail["non_finite_dropped"] = dropped
+        if eff >= self.critical_z:
+            return critical(**detail)
+        if eff >= self.degraded_z:
+            return degraded(**detail)
+        return ok(**detail)

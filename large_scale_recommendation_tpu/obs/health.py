@@ -99,6 +99,10 @@ class HealthMonitor:
         self._checks: dict[str, Callable[[], CheckResult]] = {}
         self._lock = threading.Lock()
         self._obs = registry or get_registry()
+        # last aggregate status, for transition events + the CRITICAL
+        # postmortem trigger (obs.recorder) — a persistent CRITICAL
+        # dumps ONE bundle at the transition, not one per scrape
+        self._last_status: str | None = None
 
     def register(self, name: str, check: Callable[[], CheckResult]) -> None:
         with self._lock:
@@ -132,6 +136,16 @@ class HealthMonitor:
         self.register(name, CheckpointStalenessCheck(
             manager, degraded_after_s, critical_after_s))
 
+    def watch_series(self, recorder, series: str, name: str | None = None,
+                     **kwargs) -> None:
+        """Register an ``obs.anomaly.AnomalyCheck`` over one flight-
+        recorder series — threshold-free: the check learns the series'
+        recent normal and flags departures from it."""
+        from large_scale_recommendation_tpu.obs.anomaly import AnomalyCheck
+
+        self.register(name or f"anomaly:{series}",
+                      AnomalyCheck(recorder, series, **kwargs))
+
     # -- evaluation ----------------------------------------------------------
 
     def run(self) -> dict:
@@ -159,7 +173,43 @@ class HealthMonitor:
             self._obs.gauge("health_check_status",
                             check=name).set(SEVERITY[res.status])
         self._obs.gauge("health_status").set(SEVERITY[worst])
-        return {"status": worst, "time": time.time(), "checks": results}
+        report = {"status": worst, "time": time.time(), "checks": results}
+        with self._lock:
+            prev, self._last_status = self._last_status, worst
+        # an unobserved monitor counts as OK: a FIRST evaluation that is
+        # already DEGRADED/CRITICAL (monitor started after the incident
+        # began) is exactly the transition the black box must capture
+        prev = OK if prev is None else prev
+        if worst != prev:
+            self._on_transition(prev, worst, report)
+        return report
+
+    def _on_transition(self, prev: str, worst: str, report: dict) -> None:
+        """Aggregate status changed: journal the transition, and on an
+        entry into CRITICAL freeze a postmortem bundle (the flight
+        recorder's auto-trigger — the lead-up series/events are exactly
+        what this transition needs explained). Lazy module lookups:
+        transitions are cold, and lazy resolution makes construction
+        order between monitor, journal, and recorder irrelevant."""
+        from large_scale_recommendation_tpu.obs.events import get_events
+        from large_scale_recommendation_tpu.obs.recorder import get_recorder
+
+        failing = {n: r["status"] for n, r in report["checks"].items()
+                   if r["status"] != OK}
+        journal = get_events()
+        if journal is not None:
+            severity = {OK: "info", DEGRADED: "warning",
+                        CRITICAL: "critical"}[worst]
+            journal.emit("health.transition", severity=severity,
+                         from_status=prev, to_status=worst,
+                         failing_checks=failing)
+        if worst == CRITICAL:
+            recorder = get_recorder()
+            if recorder is not None:
+                recorder.maybe_dump("health_critical",
+                                    detail={"from_status": prev,
+                                            "failing_checks": failing},
+                                    health_report=report)
 
 
 # --------------------------------------------------------------------------
@@ -435,6 +485,9 @@ class TrainingWatchdog:
         self._losses: deque[float] = deque(maxlen=max(2, self.loss_window))
         self._model = None  # last online model seen (rollback target)
         self._lock = threading.Lock()
+        # path of the postmortem bundle the last trip froze (None when
+        # no flight recorder with a bundle_dir was installed)
+        self.last_bundle: str | None = None
         obs = registry or get_registry()
         self._obs = obs
         self._m_state = obs.gauge("watchdog_state")
@@ -519,6 +572,28 @@ class TrainingWatchdog:
             self.trips += 1
         if first:  # publish once per incident, not per re-detection
             self._obs.counter("watchdog_trips_total", reason=reason).inc()
+            # the flight-recorder half of the incident: journal the
+            # finding and freeze a postmortem bundle BEFORE any policy
+            # runs — the bundle must hold the lead-up (and, under
+            # rollback, the pre-restore state), not the aftermath.
+            # Lazy lookups: trips are cold, and this way the recorder
+            # may be installed before or after the watchdog.
+            from large_scale_recommendation_tpu.obs.events import get_events
+            from large_scale_recommendation_tpu.obs.recorder import (
+                get_recorder,
+            )
+
+            journal = get_events()
+            if journal is not None:
+                journal.emit("watchdog.trip", severity="critical",
+                             reason=reason, policy=self.policy,
+                             context=detail)
+            recorder = get_recorder()
+            if recorder is not None:
+                self.last_bundle = recorder.maybe_dump(
+                    "watchdog_trip",
+                    detail={"reason": reason, "policy": self.policy,
+                            **detail})
         self._m_state.set(2)
         if self.policy == "observe":
             return
@@ -543,6 +618,14 @@ class TrainingWatchdog:
                 self.detail = detail
             rolled_back = True
             self._obs.counter("watchdog_rollbacks_total").inc()
+            from large_scale_recommendation_tpu.obs.events import get_events
+
+            journal = get_events()
+            if journal is not None:
+                journal.emit("watchdog.rollback", severity="error",
+                             reason=reason,
+                             rows_reinitialized=healed,
+                             restored_step=self.manager.latest_step())
         raise TrainingDivergedError(reason, detail, rolled_back=rolled_back)
 
     def reset(self) -> None:
@@ -731,3 +814,15 @@ class PeriodicTask:
     @property
     def running(self) -> bool:
         return self._thread is not None and self._thread.is_alive()
+
+
+def ensure_periodic(task: PeriodicTask | None, fn: Callable[[], Any],
+                    interval_s: float, name: str) -> PeriodicTask:
+    """Idempotent start-or-reuse for a ``PeriodicTask`` — ONE copy of
+    the cadence/error-counting wiring shared by every timed exporter
+    (``StreamingDriver.start_telemetry_export``, the flight recorder's
+    sampler). A live task is returned as-is; a missing or stopped one
+    is replaced by a freshly started task."""
+    if task is not None and task.running:
+        return task
+    return PeriodicTask(fn, interval_s, name=name).start()

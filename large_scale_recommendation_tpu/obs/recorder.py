@@ -1,0 +1,538 @@
+"""FlightRecorder: always-on metric history + postmortem bundles.
+
+The registry (PR 3) serves the current instant and the health layer
+(PR 4) evaluates thresholds against it — so when a watchdog trips or an
+SLO burns, the *lead-up* is already gone. This module is the black box:
+a fixed-memory time-series store that samples every registry instrument
+on a timed cadence, plus the incident artifact writer that freezes the
+recent series, the event tail, the span tail, and the health/registry
+snapshots into one atomic bundle directory the moment something breaks.
+
+- ``SeriesRing`` — one series' storage: a dense **recent** window (every
+  sample) and a decimated **old** window (every ``decimation``-th point
+  evicted from the recent tier), both hard-capped, so a series costs at
+  most ``recent_points + decimated_points`` (t, v) pairs FOREVER — the
+  memory bound ``tests/test_obs_recorder.py`` pins.
+- ``FlightRecorder`` — walks ``registry.snapshot()`` per ``sample()``:
+  counters/gauges record their value, histograms record ``count`` and
+  quantile fields (``:p50``/``:p99`` key suffixes). ``start()`` runs the
+  sampler on the shared ``obs.health.PeriodicTask`` cadence (one copy of
+  the scheduling/error-counting machinery with the streaming driver's
+  telemetry exporter — ``ensure_periodic``). The series table itself is
+  capped (``max_series``; overflow counted, never grown).
+  ``obs.server.ObsServer`` serves ``snapshot()`` at ``/seriesz``;
+  ``obs.anomaly.AnomalyCheck`` reads ``series_values()``.
+- ``write_bundle`` / ``FlightRecorder.dump`` — the postmortem artifact:
+  a directory written atomically (tmp + rename) holding ``series.json``,
+  ``events.jsonl``, ``trace.json`` (span tail), ``health.json``,
+  ``metrics.json``, ``config.json`` and a ``manifest.json`` indexing
+  them. Triggers: watchdog trip, a CRITICAL health transition
+  (``HealthMonitor``), or an explicit ``dump()``. ``validate_bundle``
+  is the schema contract the golden test and ``scripts/obs_report.py
+  --bundle`` both run.
+
+Zero-cost when unused: the module default is ``None`` (``get_recorder``)
+and nothing on any training/serving hot path ever touches a recorder —
+sampling happens on the recorder's own thread, against the registry the
+hot paths were already writing.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import platform
+import sys
+import tempfile
+import threading
+import time
+from collections import deque
+
+from large_scale_recommendation_tpu.obs.events import _json_safe, get_events
+from large_scale_recommendation_tpu.obs.registry import (
+    _labels_key,
+    _labels_str,
+    get_registry,
+)
+from large_scale_recommendation_tpu.obs.trace import get_tracer
+
+BUNDLE_VERSION = 1
+BUNDLE_FILES = ("series.json", "events.jsonl", "trace.json", "health.json",
+                "metrics.json", "config.json")
+# env prefixes worth freezing into a bundle — runtime knobs, never secrets
+_ENV_PREFIXES = ("JAX_", "XLA_", "OBS_", "BENCH_", "LIBTPU", "TPU_")
+
+
+class SeriesRing:
+    """Two-tier bounded history for one series.
+
+    Dense tier: the newest ``recent_points`` samples, every one kept.
+    Old tier: of the samples evicted from the dense tier, every
+    ``decimation``-th survives, newest ``decimated_points`` of those.
+    Total memory is therefore hard-capped at
+    ``recent_points + decimated_points`` points regardless of runtime —
+    a week of 1 Hz sampling costs the same as a minute.
+    """
+
+    __slots__ = ("recent_points", "decimation", "_recent", "_old",
+                 "_evicted")
+
+    def __init__(self, recent_points: int = 512,
+                 decimated_points: int = 512, decimation: int = 8):
+        if recent_points < 1 or decimated_points < 0 or decimation < 1:
+            raise ValueError(
+                f"bad ring geometry ({recent_points}, {decimated_points}, "
+                f"{decimation})")
+        self.recent_points = int(recent_points)
+        self.decimation = int(decimation)
+        self._recent: deque[tuple[float, float]] = deque()
+        # maxlen=0 is valid and means "no old tier" (decimated_points=0)
+        self._old: deque[tuple[float, float]] = deque(
+            maxlen=int(decimated_points))
+        self._evicted = 0
+
+    def append(self, t: float, v: float) -> None:
+        if len(self._recent) >= self.recent_points:
+            point = self._recent.popleft()
+            # keep the FIRST of each decimation stride, so the old tier
+            # is a uniform every-Nth subsample of the evicted stream
+            if self._evicted % self.decimation == 0:
+                self._old.append(point)
+            self._evicted += 1
+        self._recent.append((float(t), float(v)))
+
+    def points(self) -> list[tuple[float, float]]:
+        """Old (decimated) then recent (dense), oldest→newest."""
+        return list(self._old) + list(self._recent)
+
+    def values(self, last_n: int | None = None) -> list[float]:
+        pts = self.points()
+        if last_n is not None and len(pts) > last_n:
+            pts = pts[-last_n:]
+        return [v for _, v in pts]
+
+    def __len__(self) -> int:
+        return len(self._old) + len(self._recent)
+
+
+def series_key(name: str, labels: dict, field: str | None = None) -> str:
+    """Canonical series name: ``name{label="v"}`` (+ ``:field`` for
+    histogram-derived series) — matches the Prometheus label text so
+    keys read the same in ``/metrics`` and ``/seriesz``."""
+    key = f"{name}{_labels_str(_labels_key(labels))}"
+    return f"{key}:{field}" if field else key
+
+
+class FlightRecorder:
+    """Samples the whole registry into bounded per-series rings.
+
+    ``interval_s`` is the cadence ``start()`` runs ``sample()`` at;
+    ``sample()`` may also be driven by hand (tests, deterministic
+    demos). ``bundle_dir`` is where triggered postmortems land
+    (``dump()``'s default); hooks that auto-dump (watchdog trip,
+    CRITICAL health transition) skip silently when it is unset.
+    """
+
+    def __init__(self, registry=None, interval_s: float = 1.0,
+                 recent_points: int = 512, decimated_points: int = 512,
+                 decimation: int = 8, max_series: int = 1024,
+                 histogram_fields: tuple = ("count", "p50", "p99"),
+                 bundle_dir: str | None = None):
+        self._registry = registry or get_registry()
+        self.interval_s = float(interval_s)
+        self.recent_points = int(recent_points)
+        self.decimated_points = int(decimated_points)
+        self.decimation = int(decimation)
+        self.max_series = int(max_series)
+        self.histogram_fields = tuple(histogram_fields)
+        self.bundle_dir = bundle_dir
+        self.samples = 0
+        # distinct keys refused past max_series (a set, not a counter:
+        # the same overflow key is refused again on EVERY sample tick).
+        # Itself capped at max_series entries — unbounded label
+        # cardinality (e.g. version-labeled swap counters) must not grow
+        # the recorder's heap through its own overflow accounting
+        self._dropped_keys: set[str] = set()
+        self.bundles_written = 0
+        self.last_bundle: str | None = None
+        self._series: dict[str, SeriesRing] = {}
+        self._lock = threading.Lock()
+        self._task = None
+        self._bundle_lock = threading.Lock()
+
+    # -- sampling ------------------------------------------------------------
+
+    def sample(self) -> int:
+        """Record one point per live instrument (histograms: one per
+        configured field). Returns the number of series touched."""
+        snap = self._registry.snapshot()
+        t = snap["time"]
+        touched = 0
+        with self._lock:
+            for m in snap["metrics"]:
+                if m["type"] in ("counter", "gauge"):
+                    touched += self._record(
+                        series_key(m["name"], m["labels"]), t, m["value"])
+                else:  # histogram: count + quantiles
+                    for field in self.histogram_fields:
+                        v = m.get(field)
+                        if v is None:
+                            continue
+                        touched += self._record(
+                            series_key(m["name"], m["labels"], field), t, v)
+            self.samples += 1
+        return touched
+
+    @property
+    def dropped_series(self) -> int:
+        """Distinct series keys refused because the table was full
+        (saturates at ``max_series`` — read as ">=" once there)."""
+        with self._lock:
+            return len(self._dropped_keys)
+
+    def _record(self, key: str, t: float, v: float) -> int:
+        ring = self._series.get(key)
+        if ring is None:
+            if len(self._series) >= self.max_series:
+                if len(self._dropped_keys) < self.max_series:
+                    self._dropped_keys.add(key)
+                return 0
+            ring = self._series[key] = SeriesRing(
+                self.recent_points, self.decimated_points, self.decimation)
+        ring.append(t, v)
+        return 1
+
+    # -- cadence (shared PeriodicTask machinery) -----------------------------
+
+    def start(self, interval_s: float | None = None) -> "FlightRecorder":
+        """Run ``sample()`` every ``interval_s`` on a daemon thread.
+        Idempotent — an already-running sampler at the same cadence is
+        kept; asking for a DIFFERENT cadence restarts it (the
+        advertised ``interval_s`` must be the one points actually
+        arrive at)."""
+        from large_scale_recommendation_tpu.obs.health import ensure_periodic
+
+        if interval_s is not None:
+            if (self._task is not None and self._task.running
+                    and float(interval_s) != self._task.interval_s):
+                self.stop()
+            self.interval_s = float(interval_s)
+        self._task = ensure_periodic(self._task, self.sample,
+                                     self.interval_s,
+                                     name="flight-recorder")
+        return self
+
+    def stop(self) -> None:
+        task, self._task = self._task, None
+        if task is not None:
+            task.stop()
+
+    @property
+    def running(self) -> bool:
+        return self._task is not None and self._task.running
+
+    # -- reads ---------------------------------------------------------------
+
+    def series_names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._series)
+
+    def series_points(self, key: str) -> list[tuple[float, float]]:
+        with self._lock:
+            ring = self._series.get(key)
+            return ring.points() if ring is not None else []
+
+    def series_values(self, key: str,
+                      last_n: int | None = None) -> list[float]:
+        with self._lock:
+            ring = self._series.get(key)
+            return ring.values(last_n) if ring is not None else []
+
+    def snapshot(self, name_filter: str | None = None) -> dict:
+        """The ``/seriesz`` body (JSON-safe): every series' merged
+        old+recent points as ``[[t, v], ...]`` plus the recorder's own
+        accounting."""
+        with self._lock:
+            # non-finite samples (a NaN gauge is exactly what precedes
+            # an incident) export as null, keeping /seriesz and bundle
+            # series.json strict RFC-8259 — points stay [t, number|null]
+            series = {
+                key: {"points": [[t, v if math.isfinite(v) else None]
+                                 for t, v in ring.points()],
+                      "n": len(ring)}
+                for key, ring in sorted(self._series.items())
+                if name_filter is None or name_filter in key
+            }
+            return {
+                "time": time.time(),
+                "interval_s": self.interval_s,
+                "samples": self.samples,
+                "series_count": len(self._series),
+                "max_series": self.max_series,
+                # raw set, not the property: it re-takes this lock
+                "dropped_series": len(self._dropped_keys),
+                "tiering": {"recent_points": self.recent_points,
+                            "decimated_points": self.decimated_points,
+                            "decimation": self.decimation},
+                "series": series,
+            }
+
+    # -- postmortem bundles --------------------------------------------------
+
+    def dump(self, trigger: str = "manual", detail: dict | None = None,
+             directory: str | None = None, monitor=None,
+             health_report: dict | None = None) -> str:
+        """Write one postmortem bundle and return its path.
+
+        ``directory`` overrides the default
+        ``<bundle_dir>/bundle_<trigger>_<seq>`` location. ``monitor`` /
+        ``health_report`` feed ``health.json`` (a transition hook passes
+        the report it just computed; ``dump()`` callers may pass the
+        monitor to run fresh). Serialized under a lock so two triggers
+        firing together (watchdog trip + the health transition it
+        causes) write two complete bundles, not one torn one.
+        """
+        # run the monitor BEFORE taking the bundle lock: run() may
+        # itself detect an ok→CRITICAL transition and auto-dump through
+        # maybe_dump — with the (non-reentrant) lock already held that
+        # nested dump would deadlock this very thread at incident time
+        if health_report is None and monitor is not None:
+            health_report = _safe_health_report(monitor)
+        with self._bundle_lock:
+            if directory is None:
+                if self.bundle_dir is None:
+                    raise ValueError(
+                        "no bundle destination: construct the recorder "
+                        "with bundle_dir=... or pass directory=...")
+                # never reuse an existing auto-name: a restarted process
+                # counts from zero again, and clobbering the PREVIOUS
+                # run's incident bundle (the one that likely explains
+                # the restart) would defeat the black box
+                seq = self.bundles_written
+                while True:
+                    directory = os.path.join(
+                        self.bundle_dir, f"bundle_{trigger}_{seq:03d}")
+                    if not os.path.exists(directory):
+                        break
+                    seq += 1
+            path = write_bundle(
+                directory, trigger=trigger, detail=detail, recorder=self,
+                health_report=health_report)
+            self.bundles_written += 1
+            self.last_bundle = path
+        return path
+
+    def maybe_dump(self, trigger: str, detail: dict | None = None,
+                   monitor=None, health_report: dict | None = None,
+                   ) -> str | None:
+        """The auto-trigger form (watchdog trip, CRITICAL transition):
+        no ``bundle_dir`` → no bundle; a bundle-write failure is
+        swallowed — the incident path must never die on its own
+        recorder."""
+        if self.bundle_dir is None:
+            return None
+        try:
+            return self.dump(trigger=trigger, detail=detail,
+                             monitor=monitor, health_report=health_report)
+        except Exception:
+            return None
+
+
+# --------------------------------------------------------------------------
+# Bundle writer + schema contract
+# --------------------------------------------------------------------------
+
+
+def _safe_health_report(monitor) -> dict:
+    """Run a monitor for a bundle's health.json without letting a
+    broken monitor void the bundle — ONE copy of the downgrade policy
+    shared by ``FlightRecorder.dump`` and ``write_bundle``."""
+    try:
+        return monitor.run()
+    except Exception as e:
+        return {"status": "unknown", "error": repr(e)}
+
+
+def write_bundle(directory: str, *, trigger: str, detail: dict | None = None,
+                 recorder: FlightRecorder | None = None, events=None,
+                 tracer=None, registry=None, monitor=None,
+                 health_report: dict | None = None, span_tail: int = 512,
+                 event_tail: int = 1024) -> str:
+    """Write one incident bundle ATOMICALLY: everything lands in a
+    temp directory first, one ``os.replace`` publishes it — a crash
+    mid-write leaves a ``.tmp-*`` orphan, never a half bundle at the
+    final path. Returns the final directory."""
+    events = events if events is not None else get_events()
+    tracer = tracer or get_tracer()
+    registry = registry or get_registry()
+    created = time.time()
+
+    if health_report is None and monitor is not None:
+        health_report = _safe_health_report(monitor)
+    if health_report is None:
+        health_report = {"status": "unknown",
+                         "note": "no health monitor attached"}
+
+    series_doc = (recorder.snapshot() if recorder is not None
+                  else {"series": {}, "note": "no flight recorder"})
+    event_lines = (events.tail(event_tail) if events is not None else [])
+    trace_doc = {"traceEvents": tracer.events()[-span_tail:],
+                 "displayTimeUnit": "ms"}
+    config_doc = {
+        "time": created,
+        "pid": os.getpid(),
+        "argv": list(sys.argv),
+        "python": sys.version,
+        "platform": platform.platform(),
+        "cwd": os.getcwd(),
+        "env": {k: v for k, v in sorted(os.environ.items())
+                if k.startswith(_ENV_PREFIXES)},
+    }
+    manifest = {
+        "bundle_version": BUNDLE_VERSION,
+        "created": created,
+        "trigger": str(trigger),
+        "detail": detail or {},
+        "files": list(BUNDLE_FILES),
+        "counts": {"series": len(series_doc.get("series", {})),
+                   "events": len(event_lines),
+                   "spans": len(trace_doc["traceEvents"])},
+    }
+
+    parent = os.path.dirname(os.path.abspath(directory)) or "."
+    os.makedirs(parent, exist_ok=True)
+    tmp = tempfile.mkdtemp(prefix=os.path.basename(directory) + ".tmp-",
+                           dir=parent)
+    try:
+        def _write_json(name, doc):
+            # _json_safe: NaN/Infinity (a trip's non-finite loss, an
+            # empty histogram's inf extremes) must not land as python's
+            # non-RFC-8259 tokens — the bundle is built FOR external
+            # strict parsers (jq, JS fetch)
+            with open(os.path.join(tmp, name), "w") as f:
+                json.dump(_json_safe(doc), f, indent=2, default=repr)
+
+        _write_json("series.json", series_doc)
+        with open(os.path.join(tmp, "events.jsonl"), "w") as f:
+            for ev in event_lines:
+                f.write(json.dumps(_json_safe(ev), default=repr) + "\n")
+        _write_json("trace.json", trace_doc)
+        _write_json("health.json", health_report)
+        _write_json("metrics.json", registry.snapshot())
+        _write_json("config.json", config_doc)
+        _write_json("manifest.json", manifest)
+        if os.path.isdir(directory):  # re-dump to the same explicit path
+            import shutil
+
+            shutil.rmtree(directory)
+        os.replace(tmp, directory)
+    except BaseException:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    return directory
+
+
+def load_bundle(directory: str) -> dict:
+    """Load AND validate one postmortem bundle: the schema contract for
+    bundles (the golden test and ``scripts/obs_report.py --bundle``
+    both run it). Checks the manifest, every required file's presence
+    and JSON shape, the trace tail against ``validate_chrome_trace``,
+    and the series point form. Returns every parsed document keyed by
+    stem (``manifest``, ``series``, ``events``, ``trace``, ``health``,
+    ``metrics``, ``config``) — ONE loader, so renderers never re-parse
+    or drift from validation. Raises ``ValueError`` on violation."""
+    from large_scale_recommendation_tpu.obs.trace import validate_chrome_trace
+
+    def _load(name):
+        path = os.path.join(directory, name)
+        if not os.path.isfile(path):
+            raise ValueError(f"bundle {directory}: missing {name}")
+        with open(path) as f:
+            text = f.read()
+        if name.endswith(".jsonl"):
+            return [json.loads(ln) for ln in text.splitlines() if ln.strip()]
+        try:
+            return json.loads(text)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"bundle {directory}: {name} is not valid "
+                             f"JSON: {e}") from e
+
+    manifest = _load("manifest.json")
+    if manifest.get("bundle_version") != BUNDLE_VERSION:
+        raise ValueError(f"bundle {directory}: unsupported bundle_version "
+                         f"{manifest.get('bundle_version')!r}")
+    for key in ("created", "trigger", "files", "counts"):
+        if key not in manifest:
+            raise ValueError(f"bundle {directory}: manifest missing {key!r}")
+    for name in BUNDLE_FILES:
+        if name not in manifest["files"]:
+            raise ValueError(
+                f"bundle {directory}: manifest does not list {name}")
+
+    series = _load("series.json")
+    if not isinstance(series.get("series"), dict):
+        raise ValueError(f"bundle {directory}: series.json has no series "
+                         "mapping")
+    for key, s in series["series"].items():
+        pts = s.get("points")
+        if not isinstance(pts, list) or any(
+                not (isinstance(p, list) and len(p) == 2
+                     and isinstance(p[0], (int, float))
+                     # null = a non-finite sample, exported strict-JSON
+                     and (p[1] is None or isinstance(p[1], (int, float))))
+                for p in pts):
+            raise ValueError(f"bundle {directory}: series {key!r} points "
+                             "are not [t, number|null] pairs")
+
+    events = _load("events.jsonl")
+    for ev in events:
+        for key in ("time", "kind", "severity", "detail"):
+            if key not in ev:
+                raise ValueError(
+                    f"bundle {directory}: event missing {key!r}: {ev!r}")
+
+    trace = _load("trace.json")
+    validate_chrome_trace(trace)
+
+    health = _load("health.json")
+    if not isinstance(health.get("status"), str):
+        raise ValueError(f"bundle {directory}: health.json has no status")
+    metrics = _load("metrics.json")
+    if not isinstance(metrics.get("metrics"), list):
+        raise ValueError(f"bundle {directory}: metrics.json has no metrics "
+                         "list")
+    config = _load("config.json")
+    if not isinstance(config.get("env"), dict):
+        raise ValueError(f"bundle {directory}: config.json has no env map")
+    return {"manifest": manifest, "series": series, "events": events,
+            "trace": trace, "health": health, "metrics": metrics,
+            "config": config}
+
+
+def validate_bundle(directory: str) -> dict:
+    """Validate a bundle and return its manifest (the check-only form
+    of ``load_bundle``). Raises ``ValueError`` on violation."""
+    return load_bundle(directory)["manifest"]
+
+
+# --------------------------------------------------------------------------
+# Module-level default: None (zero-cost), installed by enable helpers
+# --------------------------------------------------------------------------
+
+_RECORDER: FlightRecorder | None = None
+
+
+def get_recorder() -> FlightRecorder | None:
+    """The installed flight recorder or ``None``. Incident hooks
+    (watchdog trip, health transitions) resolve this lazily — they are
+    cold paths, and lazy resolution means construction order between
+    the recorder and its triggers never matters."""
+    return _RECORDER
+
+
+def set_recorder(recorder: FlightRecorder | None) -> None:
+    global _RECORDER
+    _RECORDER = recorder

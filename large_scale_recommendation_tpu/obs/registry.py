@@ -44,10 +44,19 @@ def _labels_key(labels: dict) -> tuple:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
 
+def _escape_label(v: str) -> str:
+    """Prometheus text-format label-value escaping (backslash, quote,
+    newline). A label value carrying quotes is real: the health gauge's
+    ``check`` label holds check NAMES, and ``watch_series`` defaults
+    those to recorder series keys like ``lag{partition="0"}`` — emitted
+    unescaped, one such check would abort the whole /metrics parse."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
 def _labels_str(key: tuple) -> str:
     if not key:
         return ""
-    return "{" + ",".join(f'{k}="{v}"' for k, v in key) + "}"
+    return "{" + ",".join(f'{k}="{_escape_label(v)}"' for k, v in key) + "}"
 
 
 class Counter:

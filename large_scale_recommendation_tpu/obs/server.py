@@ -14,6 +14,10 @@ minimal, can expose its live state to a scraper or a ``curl``:
   ``scripts/obs_report.py --watch`` polls for terminal dashboards).
 - ``/tracez``   — the most recent spans (bounded tail of the tracer's
   Chrome-trace buffer) as JSON, for a quick look without Perfetto.
+- ``/seriesz``  — the flight recorder's time-series history
+  (``FlightRecorder.snapshot()``): the lead-up, not just the instant.
+- ``/eventz``   — the structured event journal's recent ring
+  (``EventJournal.snapshot()``): swaps, checkpoints, trips, rolls.
 
 Usage::
 
@@ -42,11 +46,14 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from large_scale_recommendation_tpu.obs.events import get_events
 from large_scale_recommendation_tpu.obs.health import CRITICAL
+from large_scale_recommendation_tpu.obs.recorder import get_recorder
 from large_scale_recommendation_tpu.obs.registry import get_registry
 from large_scale_recommendation_tpu.obs.trace import get_tracer
 
 DEFAULT_TRACEZ_LIMIT = 256
+DEFAULT_EVENTZ_LIMIT = 256
 
 
 def http_get(url: str, timeout: float = 10.0) -> tuple[int, str]:
@@ -79,11 +86,18 @@ class ObsServer:
     """
 
     def __init__(self, registry=None, tracer=None, monitor=None,
+                 recorder=None, events=None,
                  host: str = "127.0.0.1", port: int = 0,
-                 tracez_limit: int = DEFAULT_TRACEZ_LIMIT):
+                 tracez_limit: int = DEFAULT_TRACEZ_LIMIT,
+                 eventz_limit: int = DEFAULT_EVENTZ_LIMIT):
         self.registry = registry or get_registry()
         self.tracer = tracer or get_tracer()
         self.monitor = monitor
+        # flight-recorder surfaces: default to whatever is installed at
+        # construction (None stays None — the routes answer with a note)
+        self.recorder = recorder if recorder is not None else get_recorder()
+        self.events = events if events is not None else get_events()
+        self.eventz_limit = int(eventz_limit)
         self.host = host
         self.port = int(port)
         # the port the caller ASKED for, kept separate from the bound
@@ -152,6 +166,16 @@ class ObsServer:
                 "total_buffered": len(events),
                 "dropped": self.tracer.dropped}
 
+    def seriesz(self) -> dict:
+        if self.recorder is None:
+            return {"note": "no flight recorder attached", "series": {}}
+        return self.recorder.snapshot()
+
+    def eventz(self) -> dict:
+        if self.events is None:
+            return {"note": "no event journal attached", "recent": []}
+        return self.events.snapshot(limit=self.eventz_limit)
+
 
 def _make_handler(server: ObsServer):
     class Handler(BaseHTTPRequestHandler):
@@ -171,9 +195,14 @@ def _make_handler(server: ObsServer):
                     self._send_json(200, server.registry.snapshot())
                 elif path == "/tracez":
                     self._send_json(200, server.tracez())
+                elif path == "/seriesz":
+                    self._send_json(200, server.seriesz())
+                elif path == "/eventz":
+                    self._send_json(200, server.eventz())
                 elif path == "/":
                     self._send_json(200, {"routes": ["/metrics", "/healthz",
-                                                     "/varz", "/tracez"]})
+                                                     "/varz", "/tracez",
+                                                     "/seriesz", "/eventz"]})
                 else:
                     self._send_json(404, {"error": f"no route {path!r}"})
             except Exception as e:  # surface, don't kill the thread

@@ -30,6 +30,7 @@ shared stateless no-op context manager.
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import threading
@@ -39,6 +40,12 @@ from typing import Any
 # cap on buffered events: a runaway instrumented loop must not grow the
 # host heap without bound; overflow is counted, not silently dropped
 DEFAULT_MAX_EVENTS = 200_000
+
+# span ids are PROCESS-unique (module-level, not per-tracer): an
+# enable()/disable()/enable() cycle must not restart the sequence, or a
+# journal/bundle spanning both cycles would join events against the
+# wrong spans. 0/None means "no span"; next() is atomic under the GIL.
+_SPAN_IDS = itertools.count(1)
 
 
 def _block(x: Any) -> None:
@@ -56,16 +63,21 @@ def _block(x: Any) -> None:
 class Span:
     """One open span. Set ``out`` to the computation's result (array or
     pytree) to have the tracer sync on it before the clock stops; add
-    display attributes via ``args``."""
+    display attributes via ``args``. ``id`` is process-unique and lands
+    in the exported event's args — the correlation token
+    ``obs.events.EventJournal`` stamps onto events emitted while this
+    span is open."""
 
-    __slots__ = ("name", "cat", "t0", "args", "out", "_tracer")
+    __slots__ = ("name", "cat", "t0", "args", "out", "id", "_tracer")
 
-    def __init__(self, tracer: "Tracer", name: str, cat: str, args: dict):
+    def __init__(self, tracer: "Tracer", name: str, cat: str, args: dict,
+                 span_id: int):
         self._tracer = tracer
         self.name = name
         self.cat = cat
         self.args = args
         self.out = None
+        self.id = span_id
         self.t0 = 0.0
 
     def __enter__(self) -> "Span":
@@ -92,6 +104,7 @@ class _NullSpan:
     name = ""
     cat = ""
     args: dict = {}
+    id = None
 
     # writes to .out on the shared singleton are dropped (it has no
     # per-instance storage), which is exactly the point
@@ -156,11 +169,20 @@ class Tracer:
                 else:
                     self._compile_keys.add(key)
                     cat = "compile"
-        return Span(self, name, cat, args)
+        return Span(self, name, cat, args, next(_SPAN_IDS))
 
     def depth(self) -> int:
         """Current nesting depth on the calling thread."""
         return len(self._stack())
+
+    def current_span_id(self) -> int | None:
+        """The id of the innermost OPEN span on the calling thread, or
+        ``None`` outside any span — the correlation token the event
+        journal stamps onto events (``span_id`` also lands in every
+        exported trace event's args, so event↔span joins work from the
+        artifacts alone)."""
+        stack = self._stack()
+        return stack[-1].id if stack else None
 
     def _record(self, span: Span, t1: float) -> None:
         with self._lock:
@@ -175,12 +197,15 @@ class Tracer:
                 "dur": (t1 - span.t0) * 1e6,
                 "pid": os.getpid(),
                 "tid": threading.get_ident(),
-                "args": span.args,
+                "args": dict(span.args, span_id=span.id),
             })
 
     def instant(self, name: str, **args) -> None:
         """Record a zero-duration instant event (``"ph": "i"``) — swap
-        markers, checkpoint boundaries."""
+        markers, checkpoint boundaries. Stamped with the ENCLOSING open
+        span's id (or None), same correlation contract as complete
+        events."""
+        span_id = self.current_span_id()
         with self._lock:
             if len(self._events) >= self.max_events:
                 self.dropped += 1
@@ -193,7 +218,7 @@ class Tracer:
                 "ts": (time.perf_counter() + self._origin) * 1e6,
                 "pid": os.getpid(),
                 "tid": threading.get_ident(),
-                "args": args,
+                "args": dict(args, span_id=span_id),
             })
 
     # -- JAX compile hook ----------------------------------------------------
@@ -268,6 +293,9 @@ class NullTracer(Tracer):
 
     def depth(self) -> int:
         return 0
+
+    def current_span_id(self) -> int | None:
+        return None
 
     def install_jax_compile_hook(self, registry=None) -> bool:
         return False

@@ -48,6 +48,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from large_scale_recommendation_tpu.models.mf import MFModel, _assemble_topk
+from large_scale_recommendation_tpu.obs.events import get_events
 from large_scale_recommendation_tpu.obs.registry import get_registry
 from large_scale_recommendation_tpu.obs.trace import get_tracer
 from large_scale_recommendation_tpu.parallel.mesh import (
@@ -122,6 +123,9 @@ class ServingEngine:
         obs = get_registry()
         self._obs_on = obs.enabled
         self._trace = get_tracer()
+        # structured event journal (obs.events): None unless installed —
+        # the catalog-swap emission below is one `is not None` test
+        self._events = get_events()
         self._m_qwait = obs.histogram("serving_queue_wait_s")
         self._m_assembly = obs.histogram("serving_batch_assembly_s")
         self._m_flush = obs.histogram("serving_flush_s")
@@ -156,11 +160,21 @@ class ServingEngine:
         keyed on shapes, not versions. Returns the new catalog version
         (and reports it to ``on_refresh``, if set).
         """
+        swap_detail = None
         with self._lock:
             version = self._refresh(model)
             hook = self.on_refresh
             if hook is not None:
                 hook(version)
+            if self._events is not None:
+                swap_detail = {"version": version,
+                               "refreshes": self.stats["refreshes"],
+                               "rows": int(self._catalog.n_rows)}
+        if swap_detail is not None:
+            # journaled OUTSIDE the engine lock: the emit may hit the
+            # journal's JSONL disk mirror, and every submit/flush/serve
+            # serializes on this lock
+            self._events.emit("serving.catalog_swap", **swap_detail)
         return version
 
     def _refresh(self, model: MFModel | None) -> int:

@@ -54,6 +54,7 @@ import threading
 import time
 from typing import Any, Callable
 
+from large_scale_recommendation_tpu.obs.events import get_events
 from large_scale_recommendation_tpu.obs.registry import get_registry
 from large_scale_recommendation_tpu.obs.trace import get_tracer
 from large_scale_recommendation_tpu.streams.log import EventLog
@@ -135,6 +136,9 @@ class StreamingDriver:
         self._obs = obs
         self._obs_on = obs.enabled
         self._trace = get_tracer()
+        # structured event journal (obs.events): None unless installed —
+        # the checkpoint-commit emission is one `is not None` test
+        self._events = get_events()
         part = str(partition)
         self._m_batches = obs.counter("streams_batches_total",
                                       partition=part)
@@ -212,6 +216,12 @@ class StreamingDriver:
             self._m_ckpt.observe(time.perf_counter() - t0)
         self.checkpoints_written += 1
         self._since_checkpoint = 0
+        if self._events is not None:
+            self._events.emit("stream.checkpoint",
+                              partition=self.partition,
+                              step=int(self._online.step),
+                              offset=int(self.consumed_offset),
+                              path=path)
         if self.config.truncate_log:
             # retention chases the CHECKPOINTED offset (what this very
             # snapshot guarantees is applied), never the live one — the
@@ -353,13 +363,11 @@ class StreamingDriver:
         driver — frozen consumed offset vs a still-growing log — is
         exactly the lag signal a health check wants); stop it via
         ``stop_telemetry_export()``. Returns the ``PeriodicTask``."""
-        if self._telemetry_task is not None and self._telemetry_task.running:
-            return self._telemetry_task
-        from large_scale_recommendation_tpu.obs.health import PeriodicTask
+        from large_scale_recommendation_tpu.obs.health import ensure_periodic
 
-        self._telemetry_task = PeriodicTask(
-            self.telemetry, interval_s,
-            name=f"telemetry-p{self.partition}").start()
+        self._telemetry_task = ensure_periodic(
+            self._telemetry_task, self.telemetry, interval_s,
+            name=f"telemetry-p{self.partition}")
         return self._telemetry_task
 
     def stop_telemetry_export(self) -> None:

@@ -72,6 +72,12 @@ class _Partition:
         self.directory = directory
         self.segment_records = segment_records
         self.fsync = fsync
+        # structured event journal (obs.events): None unless installed —
+        # the segment-roll emission is one `is not None` test on a path
+        # that runs once per `segment_records` appends
+        from large_scale_recommendation_tpu.obs.events import get_events
+
+        self._events = get_events()
         os.makedirs(directory, exist_ok=True)
         # sealed: sorted [(base_offset, n_records)]; the LAST entry is
         # the active (appendable) segment
@@ -224,6 +230,7 @@ class _Partition:
         start = self.end_offset
         pos = 0
         while pos < len(records):
+            rolled = None
             with self._lock:
                 base, n = self.segments[-1]
                 room = self.segment_records - n
@@ -232,7 +239,18 @@ class _Partition:
                     # than segment_records (reopened with a smaller
                     # segment_records): treat it as sealed and roll
                     self._new_segment(base + n)
-                    continue
+                    rolled = (int(base), int(base + n))
+            if rolled is not None:
+                # journaled OUTSIDE the lock: the emit may hit the
+                # journal's JSONL disk mirror, and readers/truncators
+                # serialize on this lock — same reason the record
+                # writes below happen unlocked
+                if self._events is not None:
+                    self._events.emit("wal.segment_roll",
+                                      directory=self.directory,
+                                      sealed_base=rolled[0],
+                                      new_base=rolled[1])
+                continue
             take = min(room, len(records) - pos)
             fh = self._active_handle()
             fh.write(records[pos:pos + take].tobytes())

@@ -147,10 +147,16 @@ class IngestQueue:
         self._items: list[StreamBatch] = []
         self._closed = False
         self._cv = threading.Condition()
+        # structured event journal (obs.events): None unless installed —
+        # quarantine/shed emissions are one `is not None` test each
+        from large_scale_recommendation_tpu.obs.events import get_events
+
+        self._events = get_events()
 
     def put(self, batch: StreamBatch, timeout: float | None = None) -> bool:
         """Enqueue; returns False if the batch was shed (or the queue is
         closed / a blocking put timed out)."""
+        shed_records = None
         with self._cv:
             if self._closed:
                 return False
@@ -174,8 +180,8 @@ class IngestQueue:
                     real = rw > 0
                     self.dead_letters.put(ru[real], ri[real], rv[real])
                     self.stats.dead_letter_batches += 1
-                    self.stats.dead_letter_records += int(real.sum())
-                    return False
+                    shed_records = int(real.sum())
+                    self.stats.dead_letter_records += shed_records
                 else:  # "drop": shed outright, counted as loss
                     # count the batch's REAL rating rows, not its offset
                     # span (batch.n still covers rows _quarantine already
@@ -185,14 +191,25 @@ class IngestQueue:
                     self.stats.dropped_batches += 1
                     self.stats.dropped_records += int((rw > 0).sum())
                     return False
-            self._items.append(batch)
-            self.stats.enqueued_batches += 1
-            self.stats.enqueued_records += batch.n
-            self.stats.depth = len(self._items)
-            self.stats.depth_high_water = max(self.stats.depth_high_water,
-                                              self.stats.depth)
-            self._cv.notify_all()
-            return True
+            if shed_records is None:
+                self._items.append(batch)
+                self.stats.enqueued_batches += 1
+                self.stats.enqueued_records += batch.n
+                self.stats.depth = len(self._items)
+                self.stats.depth_high_water = max(
+                    self.stats.depth_high_water, self.stats.depth)
+                self._cv.notify_all()
+        if shed_records is not None:
+            # journaled OUTSIDE the cv: the emit may hit the journal's
+            # JSONL disk mirror, and every producer put() and the
+            # consumer get() serialize on this condition variable
+            if self._events is not None:
+                self._events.emit("stream.dead_letter", severity="warning",
+                                  reason="backpressure_shed",
+                                  records=shed_records,
+                                  partition=batch.partition)
+            return False
+        return True
 
     def get(self, timeout: float | None = None) -> StreamBatch | None:
         """Dequeue the oldest batch; ``None`` on end-of-stream (closed
@@ -384,6 +401,12 @@ class QueuedSource:
         self.validate = validate
         self._error: BaseException | None = None
         self._thread: threading.Thread | None = None
+        # own journal handle (the construction-bind idiom every emitter
+        # follows) — quarantine events must not depend on the queue's
+        # private caching
+        from large_scale_recommendation_tpu.obs.events import get_events
+
+        self._events = get_events()
 
     @property
     def stats(self) -> IngestStats:
@@ -402,6 +425,12 @@ class QueuedSource:
             return batch
         self.dead_letters.put(ru[bad], ri[bad], rv[bad])
         self.queue.stats.poison_records += int(bad.sum())
+        if self._events is not None:
+            self._events.emit(
+                "stream.dead_letter", severity="warning", reason="poison",
+                records=int(bad.sum()), partition=batch.partition,
+                start_offset=int(batch.start_offset),
+                end_offset=int(batch.end_offset))
         keep = real & good
         return StreamBatch(
             ratings=Ratings.from_arrays(ru[keep], ri[keep], rv[keep]),

@@ -7,6 +7,12 @@ side::
     python scripts/obs_report.py snapshot.json       # single snapshot
     python scripts/obs_report.py metrics.jsonl --name serving_flush_s
     python scripts/obs_report.py http://127.0.0.1:8080/varz --watch 2
+    python scripts/obs_report.py --bundle postmortem/bundle_watchdog_trip_000
+
+``--bundle <dir>`` renders a postmortem bundle (``obs.recorder``):
+validates it first (``validate_bundle`` — a torn bundle is an error,
+not a pretty table), then prints the trigger/detail, the health report,
+the event tail, and a per-series summary of the recorded lead-up.
 
 Input is a single-snapshot JSON file, a JSONL metrics log
 (``MetricsRegistry.append_jsonl``), or — live mode — an HTTP URL to a
@@ -223,10 +229,75 @@ def watch(src: str, interval_s: float, count: int | None = None,
     return 0
 
 
+def render_bundle(directory: str, name_filter: str | None = None,
+                  event_tail: int = 20) -> str:
+    """Validate + render one postmortem bundle directory."""
+    import os
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from large_scale_recommendation_tpu.obs.recorder import load_bundle
+
+    docs = load_bundle(directory)  # validates; raises on a torn bundle
+    manifest = docs["manifest"]
+
+    out = [f"# postmortem bundle {directory}",
+           f"trigger   : {manifest['trigger']}",
+           f"created   : "
+           f"{time.strftime('%Y-%m-%d %H:%M:%S', time.localtime(manifest['created']))}",
+           f"detail    : {json.dumps(manifest['detail'])}",
+           f"contents  : {manifest['counts']['series']} series, "
+           f"{manifest['counts']['events']} events, "
+           f"{manifest['counts']['spans']} spans", ""]
+
+    health = docs["health"]
+    out.append(f"health    : {health.get('status', 'unknown')}")
+    for name, res in sorted(health.get("checks", {}).items()):
+        if res.get("status") != "ok":
+            out.append(f"  {name}: {res['status']} "
+                       f"{json.dumps(res.get('detail', {}))[:120]}")
+    out.append("")
+
+    events = docs["events"]
+    if events:
+        out.append(f"event tail (last {min(event_tail, len(events))} "
+                   f"of {len(events)}):")
+        rows = [(time.strftime("%H:%M:%S", time.localtime(e["time"])),
+                 e["severity"], e["kind"],
+                 "-" if e.get("span_id") is None else str(e["span_id"]),
+                 json.dumps(e.get("detail", {}))[:60])
+                for e in events[-event_tail:]]
+        out.extend(format_table(("time", "sev", "kind", "span", "detail"),
+                                rows))
+        out.append("")
+
+    series = docs["series"].get("series", {})
+    keys = sorted(k for k in series
+                  if name_filter is None or name_filter in k)
+    if keys:
+        out.append(f"series lead-up ({len(keys)} of {len(series)}):")
+        rows = []
+        for key in keys:
+            vals = [v for _, v in series[key]["points"]] or [None]
+            numeric = [v for v in vals if isinstance(v, (int, float))]
+            rows.append((key, str(len(series[key]["points"])),
+                         _fmt(vals[0]),
+                         _fmt(min(numeric) if numeric else None),
+                         _fmt(max(numeric) if numeric else None),
+                         _fmt(vals[-1])))
+        out.extend(format_table(
+            ("series", "n", "first", "min", "max", "last"), rows))
+        out.append("")
+    out.append("(full registry snapshot: metrics.json; span tail: "
+               "trace.json — Perfetto-loadable)")
+    return "\n".join(out)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("path", help="snapshot JSON / metrics JSONL file, or "
-                                 "a live /varz URL")
+    ap.add_argument("path", nargs="?", default=None,
+                    help="snapshot JSON / metrics JSONL file, or "
+                         "a live /varz URL")
     ap.add_argument("--line", type=int, default=None,
                     help="0-based JSONL line (default: last)")
     ap.add_argument("--name", default=None,
@@ -235,7 +306,14 @@ def main(argv=None) -> int:
                     help="poll every N seconds and render deltas/rates")
     ap.add_argument("--count", type=int, default=None,
                     help="number of --watch polls (default: forever)")
+    ap.add_argument("--bundle", default=None, metavar="DIR",
+                    help="validate + render a postmortem bundle directory")
     args = ap.parse_args(argv)
+    if args.bundle is not None:
+        print(render_bundle(args.bundle, args.name))
+        return 0
+    if args.path is None:
+        ap.error("path is required unless --bundle is given")
     if args.watch is not None:
         try:
             return watch(args.path, args.watch, args.count, args.name)

@@ -25,17 +25,23 @@ force_cpu(n_devices=8)
 # sessionfinish fetches /healthz + /metrics over the REAL socket (the
 # .prom artifact is the served body, proving the scrape surface end to
 # end); the /healthz report lands in tier1_healthz.json, which the CI
-# workflow gates on (job fails if status == "critical"). Unset (the
-# default, local runs): the null layer stays installed and
+# workflow gates on (job fails if status == "critical"). The FLIGHT
+# RECORDER also runs for the whole session (1 s cadence, bounded
+# memory), so sessionfinish can freeze a full postmortem bundle
+# (tier1_bundle/) — on a health-gate failure, the uploaded artifact
+# carries the lead-up series/events/spans, not just the final verdict.
+# Unset (the default, local runs): the null layer stays installed and
 # instrumentation costs nothing.
 _OBS_OUT = os.environ.get("OBS_OUT")
-_OBS_REG = _OBS_TRACER = _OBS_SERVER = None
+_OBS_REG = _OBS_TRACER = _OBS_SERVER = _OBS_RECORDER = None
 if _OBS_OUT:
     from large_scale_recommendation_tpu import obs as _obs  # noqa: E402
     from large_scale_recommendation_tpu.obs import health as _health  # noqa: E402
     from large_scale_recommendation_tpu.obs.server import ObsServer  # noqa: E402
 
     _OBS_REG, _OBS_TRACER = _obs.enable()
+    _OBS_RECORDER, _OBS_JOURNAL = _obs.enable_flight_recorder(
+        interval_s=1.0, bundle_dir=os.path.join(_OBS_OUT, "postmortem"))
     _OBS_MONITOR = _health.HealthMonitor()
 
     def _session_check():
@@ -50,6 +56,48 @@ if _OBS_OUT:
     _OBS_MONITOR.register("obs_session", _session_check)
     _OBS_SERVER = ObsServer(registry=_OBS_REG, tracer=_OBS_TRACER,
                             monitor=_OBS_MONITOR).start()
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def null_obs():
+    """The fully-disabled obs layer installed for one test, with the
+    ENTIRE previous layer restored after — registry, tracer, event
+    journal, AND flight recorder (an OBS_OUT session runs one
+    suite-wide; its sampler is restarted if it was live). ONE copy,
+    shared by every obs test file: the restore invariant is non-trivial
+    and must not drift between copies."""
+    from large_scale_recommendation_tpu import obs
+    from large_scale_recommendation_tpu.obs.events import (
+        get_events,
+        set_events,
+    )
+    from large_scale_recommendation_tpu.obs.recorder import (
+        get_recorder,
+        set_recorder,
+    )
+    from large_scale_recommendation_tpu.obs.registry import (
+        get_registry,
+        set_registry,
+    )
+    from large_scale_recommendation_tpu.obs.trace import (
+        get_tracer,
+        set_tracer,
+    )
+
+    prev_r, prev_t = get_registry(), get_tracer()
+    prev_j, prev_rec = get_events(), get_recorder()
+    was_running = prev_rec is not None and prev_rec.running
+    obs.disable()
+    yield get_registry()
+    set_registry(prev_r)
+    set_tracer(prev_t)
+    set_events(prev_j)
+    set_recorder(prev_rec)
+    if was_running:
+        prev_rec.start()
 
 
 def pytest_sessionfinish(session, exitstatus):
@@ -82,3 +130,18 @@ def pytest_sessionfinish(session, exitstatus):
     with open(os.path.join(_OBS_OUT, "tier1_healthz.json"), "w") as f:
         json.dump(report, f, indent=2)
     _OBS_SERVER.stop()
+    # freeze the session's flight-recorder state as a bundle: on a
+    # health-gate failure this is the postmortem CI ships — series
+    # lead-up, event tail, span tail, final health/registry snapshots
+    _OBS_RECORDER.stop()
+    try:
+        _OBS_RECORDER.sample()  # one last point so the bundle is current
+        _OBS_RECORDER.dump(
+            trigger="session_end", detail={"exitstatus": int(exitstatus),
+                                           "healthz": report.get("status")},
+            directory=os.path.join(_OBS_OUT, "tier1_bundle"),
+            health_report=report)
+    except Exception as e:  # the suite's verdict must not die on its
+        with open(os.path.join(_OBS_OUT,  # own black box
+                               "tier1_bundle_error.txt"), "w") as f:
+            f.write(repr(e))

@@ -1,0 +1,258 @@
+"""Anomaly detection: EWMA mean/variance z-score pinned against a numpy
+reference, rate-of-change semantics, AnomalyCheck verdict mapping, and
+the acceptance path — an injected throughput collapse flips ``/healthz``
+through an ``AnomalyCheck`` with NO static threshold configured.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from large_scale_recommendation_tpu import obs
+from large_scale_recommendation_tpu.obs.anomaly import (
+    AnomalyCheck,
+    ewma_mean_var,
+    ewma_zscore,
+    rate_of_change,
+)
+from large_scale_recommendation_tpu.obs.events import get_events, set_events
+from large_scale_recommendation_tpu.obs.health import (
+    CRITICAL,
+    DEGRADED,
+    OK,
+    HealthMonitor,
+)
+from large_scale_recommendation_tpu.obs.recorder import (
+    get_recorder,
+    set_recorder,
+)
+from large_scale_recommendation_tpu.obs.registry import (
+    get_registry,
+    set_registry,
+)
+from large_scale_recommendation_tpu.obs.trace import get_tracer, set_tracer
+
+
+@pytest.fixture
+def flight_obs():
+    prev = (get_registry(), get_tracer(), get_events(), get_recorder())
+    reg, tracer = obs.enable()
+    recorder, journal = obs.enable_flight_recorder(start=False)
+    yield reg, tracer, recorder, journal
+    recorder.stop()
+    set_registry(prev[0])
+    set_tracer(prev[1])
+    set_events(prev[2])
+    set_recorder(prev[3])
+
+
+def _reference_ewma(values, alpha):
+    """Independent loop form of the exponentially weighted mean/variance
+    (West 1979 incremental update) — the pin ewma_mean_var must match."""
+    means, variances = [], []
+    m = var = 0.0
+    for i, x in enumerate(np.asarray(values, float)):
+        if i == 0:
+            m, var = x, 0.0
+        else:
+            diff = x - m
+            incr = alpha * diff
+            m = m + incr
+            var = (1.0 - alpha) * (var + diff * incr)
+        means.append(m)
+        variances.append(var)
+    return np.asarray(means), np.asarray(variances)
+
+
+class TestEwmaMath:
+    @pytest.mark.parametrize("alpha", [0.05, 0.25, 0.9])
+    def test_mean_var_match_numpy_reference(self, alpha):
+        rng = np.random.default_rng(0)
+        values = rng.normal(5.0, 2.0, size=300)
+        means, variances = ewma_mean_var(values, alpha)
+        ref_m, ref_v = _reference_ewma(values, alpha)
+        np.testing.assert_allclose(means, ref_m, rtol=1e-12)
+        np.testing.assert_allclose(variances, ref_v, rtol=1e-12)
+
+    def test_mean_converges_to_level_var_to_noise(self):
+        rng = np.random.default_rng(1)
+        values = 100.0 + rng.normal(0, 3.0, size=2000)
+        means, variances = ewma_mean_var(values, alpha=0.1)
+        assert abs(means[-1] - 100.0) < 1.0
+        # EWMA variance of iid noise approaches the true variance
+        assert 0.5 * 9.0 < variances[-1] < 2.0 * 9.0
+
+    def test_zscore_zero_on_flat_and_signed_on_steps(self):
+        flat = [10.0] * 50
+        assert ewma_zscore(flat) == 0.0
+        rng = np.random.default_rng(2)
+        noisy = list(100.0 + rng.normal(0, 1.0, 60))
+        z_drop = ewma_zscore(noisy + [50.0])
+        z_spike = ewma_zscore(noisy + [150.0])
+        assert z_drop < -6.0
+        assert z_spike > 6.0
+        # last value never contaminates its own baseline: appending a
+        # huge value yields the same z as judging it against the prefix
+        assert ewma_zscore(noisy + [1e6]) > 100.0
+
+    def test_zscore_finite_on_step_off_flat_baseline(self):
+        z = ewma_zscore([10.0] * 30 + [20.0])
+        assert np.isfinite(z) and z > 100.0
+
+    def test_rate_of_change(self):
+        assert rate_of_change([100.0, 50.0]) == pytest.approx(-0.5)
+        assert rate_of_change([100.0, 90.0, 80.0],
+                              span=2) == pytest.approx(-0.2)
+        assert rate_of_change([5.0]) == 0.0
+        with pytest.raises(ValueError):
+            rate_of_change([1.0, 2.0], span=0)
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            ewma_mean_var([1.0], alpha=0.0)
+        with pytest.raises(ValueError):
+            ewma_mean_var([1.0], alpha=1.5)
+
+
+class TestAnomalyCheck:
+    def _fill(self, reg, rec, name, values):
+        g = reg.gauge(name)
+        for v in values:
+            g.set(v)
+            rec.sample()
+
+    def test_warming_then_ok_then_critical_on_collapse(self, flight_obs):
+        reg, _, rec, _ = flight_obs
+        check = AnomalyCheck(rec, "tput", direction="drop")
+        assert check().status == OK  # missing series = warming, not an
+        assert "warming" in check().detail["note"]  # incident
+        rng = np.random.default_rng(3)
+        self._fill(reg, rec, "tput", 1000.0 + rng.normal(0, 10, 60))
+        res = check()
+        assert res.status == OK
+        assert abs(res.detail["z"]) < 3.0
+        self._fill(reg, rec, "tput", [12.0])  # collapse
+        res = check()
+        assert res.status == CRITICAL
+        assert res.detail["z"] < -6.0
+        assert res.detail["rate_of_change"] < -0.9
+
+    def test_nan_last_value_is_critical_not_silent_ok(self, flight_obs):
+        # z=NaN compares False against every threshold — without the
+        # explicit guard a NaN gauge (the classic incident precursor)
+        # would read as ok and leak a bare NaN token into /healthz JSON
+        reg, _, rec, _ = flight_obs
+        rng = np.random.default_rng(11)
+        self._fill(reg, rec, "sig", 1000.0 + rng.normal(0, 10, 40))
+        check = AnomalyCheck(rec, "sig", direction="drop")
+        assert check().status == OK
+        self._fill(reg, rec, "sig", [float("nan")])
+        res = check()
+        assert res.status == CRITICAL
+        assert res.detail["reason"] == "non_finite_value"
+        json.dumps(res.detail, allow_nan=False)  # strict-JSON safe
+
+    def test_nan_in_window_does_not_mask_later_collapse(self, flight_obs):
+        reg, _, rec, _ = flight_obs
+        rng = np.random.default_rng(12)
+        self._fill(reg, rec, "sig2", 1000.0 + rng.normal(0, 10, 30))
+        self._fill(reg, rec, "sig2", [float("nan")])  # transient NaN
+        self._fill(reg, rec, "sig2", 1000.0 + rng.normal(0, 10, 10))
+        check = AnomalyCheck(rec, "sig2", direction="drop")
+        res = check()
+        assert res.status == OK  # recovered: the NaN is filtered out...
+        assert res.detail["non_finite_dropped"] == 1
+        json.dumps(res.detail, allow_nan=False)
+        self._fill(reg, rec, "sig2", [12.0])  # ...so a real collapse
+        res = check()                         # still pages
+        assert res.status == CRITICAL
+        assert res.detail["z"] < -6.0
+
+    def test_direction_filter(self, flight_obs):
+        reg, _, rec, _ = flight_obs
+        rng = np.random.default_rng(4)
+        self._fill(reg, rec, "lat", 0.01 + rng.normal(0, 0.0005, 40))
+        spike_watch = AnomalyCheck(rec, "lat", direction="spike")
+        drop_watch = AnomalyCheck(rec, "lat", direction="drop")
+        assert spike_watch().status == OK
+        self._fill(reg, rec, "lat", [0.5])  # latency explosion
+        assert spike_watch().status == CRITICAL
+        # a drop-watcher must NOT page on a spike
+        assert drop_watch().status == OK
+
+    def test_degraded_band(self, flight_obs):
+        reg, _, rec, _ = flight_obs
+        rng = np.random.default_rng(5)
+        base = 100.0 + rng.normal(0, 2.0, 80)
+        self._fill(reg, rec, "mid", base)
+        check = AnomalyCheck(rec, "mid", direction="both")
+        z_ok = check()
+        assert z_ok.status == OK
+        # a ~4-sigma move lands between degraded_z (3) and critical_z (6)
+        sd = float(np.std(base))
+        self._fill(reg, rec, "mid", [float(np.mean(base) + 4.3 * sd)])
+        res = check()
+        assert res.status == DEGRADED, res.detail
+
+    def test_delta_mode_turns_counter_into_rate_signal(self, flight_obs):
+        reg, _, rec, _ = flight_obs
+        c = reg.counter("reqs_total")
+        rng = np.random.default_rng(6)
+        for _ in range(50):  # steady ~1000/sample
+            c.inc(1000 + int(rng.normal(0, 20)))
+            rec.sample()
+        check = AnomalyCheck(rec, "reqs_total", mode="delta",
+                             direction="drop")
+        assert check().status == OK
+        c.inc(5)  # throughput collapse: the counter still RISES
+        rec.sample()
+        res = check()
+        assert res.status == CRITICAL
+        # a value-mode check on the raw monotonic counter can't see it
+        raw = AnomalyCheck(rec, "reqs_total", direction="drop")
+        assert raw().status == OK
+
+    def test_config_validation(self, flight_obs):
+        _, _, rec, _ = flight_obs
+        with pytest.raises(ValueError):
+            AnomalyCheck(rec, "x", direction="sideways")
+        with pytest.raises(ValueError):
+            AnomalyCheck(rec, "x", mode="wavelet")
+        with pytest.raises(ValueError):
+            AnomalyCheck(rec, "x", warmup=1)
+        with pytest.raises(ValueError):
+            AnomalyCheck(rec, "x", degraded_z=5, critical_z=3)
+
+
+class TestHealthzFlipsOnCollapse:
+    def test_throughput_collapse_503s_healthz_with_no_static_threshold(
+            self, flight_obs):
+        """The acceptance pin: a collapse flips /healthz to 503 through
+        the anomaly check ALONE — no degraded_lag, no critical_burn, no
+        absolute number anywhere in the wiring."""
+        from large_scale_recommendation_tpu.obs.server import (
+            ObsServer,
+            http_get,
+        )
+
+        reg, _, rec, _ = flight_obs
+        monitor = HealthMonitor()
+        monitor.watch_series(rec, "stream_tput", direction="drop")
+        g = reg.gauge("stream_tput")
+        rng = np.random.default_rng(7)
+        for v in 5000.0 + rng.normal(0, 40, 64):
+            g.set(v)
+            rec.sample()
+        with ObsServer(monitor=monitor) as server:
+            code, body = http_get(server.url + "/healthz")
+            assert code == 200, body
+            assert json.loads(body)["status"] == OK
+            g.set(3.0)  # the collapse
+            rec.sample()
+            code, body = http_get(server.url + "/healthz")
+        assert code == 503, body
+        report = json.loads(body)
+        check = report["checks"]["anomaly:stream_tput"]
+        assert check["status"] == CRITICAL
+        assert check["detail"]["z"] < -6.0

@@ -1,0 +1,370 @@
+"""Structured event journal: ring bounds, severity taxonomy, span-id
+correlation against the exported trace, the /eventz endpoint, and every
+instrumented emission site (catalog swaps, checkpoints, retrains,
+watchdog findings, dead-letter quarantines, WAL segment rolls).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from large_scale_recommendation_tpu import obs
+from large_scale_recommendation_tpu.core.types import Ratings
+from large_scale_recommendation_tpu.models.online import (
+    OnlineMF,
+    OnlineMFConfig,
+)
+from large_scale_recommendation_tpu.obs.events import (
+    EventJournal,
+    get_events,
+    set_events,
+)
+from large_scale_recommendation_tpu.obs.recorder import (
+    get_recorder,
+    set_recorder,
+)
+from large_scale_recommendation_tpu.obs.registry import (
+    get_registry,
+    set_registry,
+)
+from large_scale_recommendation_tpu.obs.trace import get_tracer, set_tracer
+
+
+@pytest.fixture
+def flight_obs():
+    prev = (get_registry(), get_tracer(), get_events(), get_recorder())
+    reg, tracer = obs.enable()
+    recorder, journal = obs.enable_flight_recorder(start=False)
+    yield reg, tracer, recorder, journal
+    recorder.stop()
+    set_registry(prev[0])
+    set_tracer(prev[1])
+    set_events(prev[2])
+    set_recorder(prev[3])
+
+
+def _ratings(n=200, users=80, items=30, seed=0):
+    rng = np.random.default_rng(seed)
+    return Ratings.from_arrays(
+        rng.integers(0, users, n).astype(np.int64),
+        rng.integers(0, items, n).astype(np.int64),
+        rng.normal(size=n).astype(np.float32))
+
+
+class TestEventJournal:
+    def test_ring_bound_and_drop_accounting(self, flight_obs):
+        journal = EventJournal(capacity=16)
+        for i in range(40):
+            journal.emit("k", idx=i)
+        assert len(journal) == 16
+        assert journal.total == 40
+        assert journal.dropped == 24
+        evs = journal.events()
+        assert [e["detail"]["idx"] for e in evs] == list(range(24, 40))
+        assert [e["seq"] for e in evs] == list(range(25, 41))
+
+    def test_severity_validated_and_counted(self, flight_obs):
+        reg, _, _, journal = flight_obs
+        journal.emit("a", severity="warning")
+        journal.emit("b", severity="critical")
+        with pytest.raises(ValueError, match="unknown severity"):
+            journal.emit("c", severity="loud")
+        assert reg.counter("obs_events_total",
+                           severity="warning").value == 1
+        assert reg.counter("obs_events_total",
+                           severity="critical").value == 1
+
+    def test_filters(self, flight_obs):
+        _, _, _, journal = flight_obs
+        journal.emit("stream.checkpoint")
+        journal.emit("stream.dead_letter", severity="warning")
+        journal.emit("watchdog.trip", severity="critical")
+        assert [e["kind"] for e in journal.events(kind="stream.")] == [
+            "stream.checkpoint", "stream.dead_letter"]
+        assert [e["kind"] for e in
+                journal.events(min_severity="warning")] == [
+            "stream.dead_letter", "watchdog.trip"]
+        assert [e["kind"] for e in journal.events(limit=1)] == [
+            "watchdog.trip"]
+
+    def test_jsonl_sink(self, flight_obs, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        journal = EventJournal(capacity=4, jsonl_path=path)
+        for i in range(6):  # more than the ring holds
+            journal.emit("k", idx=i)
+        lines = [json.loads(ln) for ln in open(path)]
+        # the durable sink keeps what the ring evicted
+        assert [e["detail"]["idx"] for e in lines] == list(range(6))
+
+    def test_non_finite_detail_stays_strict_json(self, flight_obs,
+                                                 tmp_path):
+        """The incident path is exactly where NaN/Inf appear (a trip
+        carries the non-finite loss that caused it) — payloads must
+        stay RFC-8259 parseable on /eventz and in the JSONL mirror,
+        not python-only NaN tokens."""
+        import math
+
+        path = str(tmp_path / "ev.jsonl")
+        journal = EventJournal(capacity=8, jsonl_path=path)
+        ev = journal.emit("watchdog.trip", severity="critical",
+                          loss=float("nan"),
+                          window=[1.0, float("inf"), 2.0],
+                          nested={"rise": float("-inf")})
+        assert ev["detail"]["loss"] == "nan"
+        assert ev["detail"]["window"][1] == "inf"
+        assert ev["detail"]["nested"]["rise"] == "-inf"
+        body = json.dumps(journal.snapshot())
+        assert "NaN" not in body and "Infinity" not in body
+        (line,) = open(path).read().splitlines()
+        assert "NaN" not in line  # strict parsers can read the mirror
+        assert not any(isinstance(v, float) and not math.isfinite(v)
+                       for v in json.loads(line)["detail"]["window"]
+                       if isinstance(v, float))
+        # an unserializable payload is dropped by the mirror, not raised
+        # into the emitting hot path
+        journal.emit("k", weird=object())
+        assert len(journal) == 2  # ring still took it (repr fallback
+        assert len(open(path).read().splitlines()) == 2  # mirror too)
+
+    def test_span_id_correlates_with_exported_trace(self, flight_obs):
+        """The correlation contract: an event emitted inside a span
+        carries that span's id, and the id appears in the exported
+        Chrome trace's args — a join key that works from the artifacts
+        alone."""
+        _, tracer, _, journal = flight_obs
+        assert journal.emit("outside")["span_id"] is None
+        with tracer.span("work/outer"):
+            with tracer.span("work/inner") as inner:
+                ev = journal.emit("inside", what="x")
+        assert ev["span_id"] == inner.id
+        spans = {e["args"].get("span_id"): e
+                 for e in tracer.chrome_trace()["traceEvents"]}
+        assert spans[ev["span_id"]]["name"] == "work/inner"
+        # instant markers carry the ENCLOSING span's id too — every
+        # exported trace event is joinable, not just complete spans
+        with tracer.span("work/outer2") as outer2:
+            tracer.instant("marker", note="x")
+        marker = [e for e in tracer.events() if e["name"] == "marker"]
+        assert marker[0]["args"]["span_id"] == outer2.id
+
+    def test_span_ids_are_process_unique_across_tracers(self,
+                                                        flight_obs):
+        """An enable/disable/enable cycle installs a fresh Tracer; its
+        span ids must CONTINUE the sequence, or a journal/bundle
+        spanning both cycles joins events against the wrong spans."""
+        from large_scale_recommendation_tpu.obs.trace import Tracer
+
+        _, tracer, _, _ = flight_obs
+        with tracer.span("a") as a:
+            pass
+        with Tracer().span("b") as b:  # a "re-enabled" tracer
+            pass
+        assert b.id > a.id
+
+    def test_eventz_endpoint(self, flight_obs):
+        from large_scale_recommendation_tpu.obs.server import (
+            ObsServer,
+            http_get,
+        )
+
+        _, _, _, journal = flight_obs
+        journal.emit("serving.catalog_swap", version=3)
+        with ObsServer() as server:
+            code, body = http_get(server.url + "/eventz")
+            root_code, root_body = http_get(server.url + "/")
+        assert code == 200
+        doc = json.loads(body)
+        assert doc["recent"][-1]["kind"] == "serving.catalog_swap"
+        assert doc["total"] == 1
+        assert root_code == 200
+        assert "/eventz" in json.loads(root_body)["routes"]
+        assert "/seriesz" in json.loads(root_body)["routes"]
+
+
+class TestEmissionSites:
+    def test_serving_catalog_swap(self, flight_obs):
+        import jax.numpy as jnp
+
+        from large_scale_recommendation_tpu.data.blocking import flat_index
+        from large_scale_recommendation_tpu.models.mf import MFModel
+        from large_scale_recommendation_tpu.serving.engine import (
+            ServingEngine,
+        )
+
+        _, _, _, journal = flight_obs
+        rng = np.random.default_rng(0)
+
+        def tiny(seed):
+            r = np.random.default_rng(seed)
+            return MFModel(
+                U=jnp.asarray(r.normal(size=(50, 4)).astype(np.float32)),
+                V=jnp.asarray(r.normal(size=(20, 4)).astype(np.float32)),
+                users=flat_index(np.arange(50, dtype=np.int64)),
+                items=flat_index(np.arange(20, dtype=np.int64)))
+
+        engine = ServingEngine(tiny(0), k=3, max_batch=32)
+        engine.refresh(tiny(1))
+        swaps = journal.events(kind="serving.catalog_swap")
+        assert len(swaps) == 2  # construction bind + refresh
+        assert swaps[-1]["detail"]["version"] == engine.version
+        assert swaps[-1]["detail"]["refreshes"] == 2
+
+    def test_stream_checkpoint_and_segment_roll(self, flight_obs,
+                                                tmp_path):
+        from large_scale_recommendation_tpu.streams.driver import (
+            StreamingDriver,
+            StreamingDriverConfig,
+        )
+        from large_scale_recommendation_tpu.streams.log import EventLog
+
+        _, _, _, journal = flight_obs
+        log = EventLog(str(tmp_path / "log"), segment_records=300)
+        ru, ri, rv, _ = _ratings(900).to_numpy()
+        log.append_arrays(0, ru, ri, rv)  # 900 records → 2 rolls
+        rolls = journal.events(kind="wal.segment_roll")
+        assert len(rolls) == 2
+        assert rolls[0]["detail"]["new_base"] == 300
+        model = OnlineMF(OnlineMFConfig(num_factors=4, minibatch_size=64))
+        driver = StreamingDriver(
+            model, log, str(tmp_path / "ckpt"),
+            config=StreamingDriverConfig(batch_records=300))
+        applied = driver.run()
+        ckpts = journal.events(kind="stream.checkpoint")
+        assert len(ckpts) == applied == 3
+        assert ckpts[-1]["detail"]["offset"] == 900
+        assert ckpts[-1]["detail"]["partition"] == 0
+
+    def test_dead_letter_quarantine_events(self, flight_obs):
+        from large_scale_recommendation_tpu.streams.sources import (
+            IngestQueue,
+            QueuedSource,
+            StreamBatch,
+        )
+
+        _, _, _, journal = flight_obs
+        # poison path: NaN ratings quarantined by the feeder
+        bad = StreamBatch(
+            ratings=Ratings.from_arrays(
+                np.arange(8, dtype=np.int64),
+                np.arange(8, dtype=np.int64),
+                np.array([1, np.nan, 2, np.nan, 3, 4, 5, np.nan],
+                         np.float32)),
+            partition=0, start_offset=0, end_offset=8)
+        qs = QueuedSource(iter([bad]), capacity=4)
+        batches = list(qs)
+        assert len(batches) == 1 and batches[0].ratings.n == 5
+        (poison,) = journal.events(kind="stream.dead_letter")
+        assert poison["severity"] == "warning"
+        assert poison["detail"] == {"reason": "poison", "records": 3,
+                                    "partition": 0, "start_offset": 0,
+                                    "end_offset": 8}
+        # backpressure shed path
+        q = IngestQueue(capacity=1, policy="dead_letter")
+        good = StreamBatch(ratings=_ratings(16), partition=2,
+                           start_offset=0, end_offset=16)
+        assert q.put(good)
+        assert not q.put(good)  # full → quarantined
+        shed = journal.events(kind="stream.dead_letter")[-1]
+        assert shed["detail"]["reason"] == "backpressure_shed"
+        assert shed["detail"]["partition"] == 2
+
+    def test_online_table_growth(self, flight_obs):
+        _, _, _, journal = flight_obs
+        model = OnlineMF(OnlineMFConfig(num_factors=4, minibatch_size=64,
+                                        init_capacity=16))
+        model.partial_fit(_ratings(n=400, users=300, items=200))
+        (growth,) = journal.events(kind="online.table_growth")
+        assert growth["detail"]["users_capacity"] > 16
+        assert growth["detail"]["items_capacity"] > 16
+
+    def test_adaptive_retrain_start_install_abort(self, flight_obs):
+        import jax.numpy as jnp
+
+        from large_scale_recommendation_tpu.models.adaptive import (
+            AdaptiveMF,
+            AdaptiveMFConfig,
+        )
+        from large_scale_recommendation_tpu.obs.health import (
+            TrainingDivergedError,
+            TrainingWatchdog,
+        )
+
+        _, _, _, journal = flight_obs
+        ad = AdaptiveMF(AdaptiveMFConfig(
+            num_factors=4, minibatch_size=64, offline_every=2,
+            offline_iterations=1))
+        for i in range(2):
+            ad.process(_ratings(seed=i))
+        assert ad.retrain_count == 1
+        starts = journal.events(kind="adaptive.retrain_start")
+        installs = journal.events(kind="adaptive.retrain_install")
+        assert len(starts) == len(installs) == 1
+        assert starts[0]["detail"]["algorithm"] == "dsgd"
+        assert installs[0]["detail"]["retrain_count"] == 1
+
+        # abort: a poisoned retrained model must journal the abort and
+        # never install
+        ad.watchdog = TrainingWatchdog(policy="halt")
+        poisoned = ad.to_model()
+        poisoned = type(poisoned)(
+            U=jnp.asarray(np.full_like(np.asarray(poisoned.U), np.nan)),
+            V=poisoned.V, users=poisoned.users, items=poisoned.items)
+        with pytest.raises(TrainingDivergedError):
+            ad._install(poisoned)
+        (abort,) = journal.events(kind="adaptive.retrain_abort")
+        assert abort["severity"] == "error"
+        assert journal.events(kind="adaptive.retrain_install")[-1] is \
+            installs[0]  # no new install
+
+    def test_dsgd_segment_and_checkpoint_events(self, flight_obs,
+                                                tmp_path):
+        from large_scale_recommendation_tpu.models.dsgd import (
+            DSGD,
+            DSGDConfig,
+        )
+        from large_scale_recommendation_tpu.utils.checkpoint import (
+            CheckpointManager,
+        )
+
+        _, _, _, journal = flight_obs
+        solver = DSGD(DSGDConfig(num_factors=4, iterations=2,
+                                 minibatch_size=256, learning_rate=0.05))
+        solver.fit(_ratings(n=2000, users=60, items=25),
+                   checkpoint_manager=CheckpointManager(str(tmp_path)),
+                   checkpoint_every=1)
+        segs = journal.events(kind="train.segment")
+        assert [e["detail"]["done"] for e in segs] == [1, 2]
+        ckpts = journal.events(kind="train.checkpoint")
+        assert [e["detail"]["step"] for e in ckpts] == [1, 2]
+
+    def test_watchdog_trip_and_rollback_events(self, flight_obs,
+                                               tmp_path):
+        from large_scale_recommendation_tpu.obs.health import (
+            TrainingDivergedError,
+            TrainingWatchdog,
+        )
+        from large_scale_recommendation_tpu.utils.checkpoint import (
+            CheckpointManager,
+            save_online_state,
+        )
+
+        _, _, _, journal = flight_obs
+        model = OnlineMF(OnlineMFConfig(num_factors=4, minibatch_size=64))
+        model.partial_fit(_ratings(seed=1))
+        manager = CheckpointManager(str(tmp_path))
+        save_online_state(manager, model, model.step)
+        model.watchdog = TrainingWatchdog(policy="rollback",
+                                          manager=manager)
+        bad = Ratings.from_arrays(
+            np.arange(4, dtype=np.int64), np.arange(4, dtype=np.int64),
+            np.full(4, np.inf, np.float32))
+        with pytest.raises(TrainingDivergedError) as exc:
+            model.partial_fit(bad)
+        assert exc.value.rolled_back
+        (trip,) = journal.events(kind="watchdog.trip")
+        assert trip["severity"] == "critical"
+        assert trip["detail"]["reason"] == "non_finite_factors"
+        assert trip["detail"]["policy"] == "rollback"
+        (rb,) = journal.events(kind="watchdog.rollback")
+        assert rb["detail"]["restored_step"] == 1

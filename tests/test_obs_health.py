@@ -59,13 +59,8 @@ def live_obs():
     set_tracer(prev_t)
 
 
-@pytest.fixture
-def null_obs():
-    prev_r, prev_t = get_registry(), get_tracer()
-    obs.disable()
-    yield get_registry()
-    set_registry(prev_r)
-    set_tracer(prev_t)
+# null_obs comes from tests/conftest.py: ONE copy of the full-layer
+# save/disable/restore-and-restart invariant, shared by every obs file
 
 
 def _ratings(n=64, users=16, items=12, seed=0):

@@ -47,13 +47,8 @@ def live_obs():
     set_tracer(prev_t)
 
 
-@pytest.fixture
-def null_obs():
-    prev_r, prev_t = get_registry(), get_tracer()
-    obs.disable()
-    yield get_registry()
-    set_registry(prev_r)
-    set_tracer(prev_t)
+# null_obs comes from tests/conftest.py: ONE copy of the full-layer
+# save/disable/restore-and-restart invariant, shared by every obs file
 
 
 def _tiny_model(num_users=300, num_items=128, rank=8, seed=0):
@@ -190,6 +185,75 @@ class TestNullPathZeroWork:
         assert model._obs_on is False
         driver.run()
         assert driver.telemetry()["lag_records"] == 0
+        assert null_obs.names() == set()
+
+    def test_flight_recorder_and_events_default_off_everywhere(
+            self, null_obs, tmp_path):
+        """The flight-recorder extension of the zero-cost pin: with
+        nothing installed, get_events()/get_recorder() are None (not
+        null objects), every emitting component binds that None — one
+        pointer test per hook — and no sampler thread, journal ring, or
+        bundle machinery exists anywhere."""
+        from large_scale_recommendation_tpu.obs.events import (
+            get_events,
+            set_events,
+        )
+        from large_scale_recommendation_tpu.obs.recorder import (
+            get_recorder,
+            set_recorder,
+        )
+
+        # force the true disabled state (an OBS_OUT session conftest may
+        # have a journal/recorder installed for the whole suite)
+        prev_j, prev_r = get_events(), get_recorder()
+        set_events(None)
+        set_recorder(None)
+        try:
+            self._assert_null_everywhere(null_obs, tmp_path)
+        finally:
+            set_events(prev_j)
+            set_recorder(prev_r)
+
+    def _assert_null_everywhere(self, null_obs, tmp_path):
+        from large_scale_recommendation_tpu.models.adaptive import (
+            AdaptiveMF,
+            AdaptiveMFConfig,
+        )
+        from large_scale_recommendation_tpu.models.dsgd import DSGD
+        from large_scale_recommendation_tpu.obs.events import get_events
+        from large_scale_recommendation_tpu.obs.health import (
+            TrainingWatchdog,
+        )
+        from large_scale_recommendation_tpu.obs.recorder import (
+            get_recorder,
+        )
+        from large_scale_recommendation_tpu.serving.engine import (
+            ServingEngine,
+        )
+        from large_scale_recommendation_tpu.streams.sources import (
+            IngestQueue,
+        )
+
+        assert get_events() is None
+        assert get_recorder() is None
+        engine = ServingEngine(_tiny_model(), k=3, max_batch=32)
+        assert engine._events is None
+        model = OnlineMF(OnlineMFConfig(num_factors=4, minibatch_size=64))
+        assert model._events is None
+        assert DSGD()._events is None
+        assert AdaptiveMF(AdaptiveMFConfig(num_factors=4))._events is None
+        assert IngestQueue()._events is None
+        log = EventLog(str(tmp_path / "log"))
+        assert log._parts[0]._events is None
+        driver = StreamingDriver(model, log, str(tmp_path / "ckpt"))
+        assert driver._events is None
+        # the uninstrumented hot paths still run clean end to end,
+        # recording nothing anywhere
+        _fill_log(log, n_batches=1)
+        driver.run()
+        wd = TrainingWatchdog(policy="observe")
+        wd.observe_loss(float("nan"))  # trip: no journal, no bundle
+        assert wd.tripped and wd.last_bundle is None
         assert null_obs.names() == set()
 
 

@@ -1,0 +1,481 @@
+"""Flight recorder: bounded ring/downsampling memory, registry sampling,
+the /seriesz endpoint, and postmortem bundles — including the golden
+path: a forced watchdog trip in a real ``OnlineMF`` run freezes a
+schema-valid bundle holding the lead-up series/events/spans/health.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from large_scale_recommendation_tpu import obs
+from large_scale_recommendation_tpu.core.types import Ratings
+from large_scale_recommendation_tpu.models.online import (
+    OnlineMF,
+    OnlineMFConfig,
+)
+from large_scale_recommendation_tpu.obs.events import (
+    EventJournal,
+    get_events,
+    set_events,
+)
+from large_scale_recommendation_tpu.obs.health import (
+    CRITICAL,
+    HealthMonitor,
+    PeriodicTask,
+    TrainingDivergedError,
+    TrainingWatchdog,
+    critical,
+    ensure_periodic,
+    ok,
+)
+from large_scale_recommendation_tpu.obs.recorder import (
+    FlightRecorder,
+    SeriesRing,
+    get_recorder,
+    series_key,
+    set_recorder,
+    validate_bundle,
+    write_bundle,
+)
+from large_scale_recommendation_tpu.obs.registry import (
+    get_registry,
+    set_registry,
+)
+from large_scale_recommendation_tpu.obs.trace import get_tracer, set_tracer
+
+
+@pytest.fixture
+def flight_obs():
+    """Live registry/tracer/journal/recorder installed for the test,
+    with whatever was installed before restored after."""
+    prev = (get_registry(), get_tracer(), get_events(), get_recorder())
+    reg, tracer = obs.enable()
+    recorder, journal = obs.enable_flight_recorder(start=False)
+    yield reg, tracer, recorder, journal
+    recorder.stop()
+    set_registry(prev[0])
+    set_tracer(prev[1])
+    set_events(prev[2])
+    set_recorder(prev[3])
+
+
+def _ratings(n=256, users=100, items=40, seed=0):
+    rng = np.random.default_rng(seed)
+    return Ratings.from_arrays(
+        rng.integers(0, users, n).astype(np.int64),
+        rng.integers(0, items, n).astype(np.int64),
+        rng.normal(size=n).astype(np.float32))
+
+
+class TestSeriesRing:
+    def test_memory_is_hard_capped(self):
+        ring = SeriesRing(recent_points=64, decimated_points=32,
+                          decimation=4)
+        for i in range(100_000):
+            ring.append(float(i), float(i))
+        assert len(ring) <= 64 + 32
+        pts = ring.points()
+        assert len(pts) == len(ring)
+        # points stay time-ordered across the tier join
+        ts = [t for t, _ in pts]
+        assert ts == sorted(ts)
+
+    def test_recent_tier_is_dense(self):
+        ring = SeriesRing(recent_points=16, decimated_points=8,
+                          decimation=4)
+        for i in range(100):
+            ring.append(float(i), float(i))
+        # the newest recent_points samples are ALL present
+        vals = [v for _, v in ring.points()]
+        assert vals[-16:] == [float(i) for i in range(84, 100)]
+
+    def test_old_tier_is_every_nth_evicted_point(self):
+        ring = SeriesRing(recent_points=10, decimated_points=100,
+                          decimation=5)
+        for i in range(60):
+            ring.append(float(i), float(i))
+        # evicted stream is 0..49; survivors are every 5th of it
+        old = [v for _, v in ring.points()][:-10]
+        assert old == [0.0, 5.0, 10.0, 15.0, 20.0, 25.0, 30.0, 35.0,
+                       40.0, 45.0]
+
+    def test_decimation_one_keeps_everything_up_to_cap(self):
+        ring = SeriesRing(recent_points=8, decimated_points=8, decimation=1)
+        for i in range(16):
+            ring.append(float(i), float(i))
+        assert [v for _, v in ring.points()] == [float(i)
+                                                 for i in range(16)]
+
+    def test_no_old_tier_when_decimated_points_zero(self):
+        ring = SeriesRing(recent_points=4, decimated_points=0)
+        for i in range(20):
+            ring.append(float(i), float(i))
+        assert [v for _, v in ring.points()] == [16.0, 17.0, 18.0, 19.0]
+
+
+class TestFlightRecorder:
+    def test_samples_counters_gauges_and_histogram_quantiles(self,
+                                                             flight_obs):
+        reg, _, rec, _ = flight_obs
+        reg.counter("c_total", kind="a").inc(3)
+        reg.gauge("g_now").set(7.5)
+        h = reg.histogram("h_s")
+        for v in (0.01, 0.02, 0.04):
+            h.observe(v)
+        rec.sample()
+        names = rec.series_names()
+        assert series_key("c_total", {"kind": "a"}) in names
+        assert "g_now" in names
+        for field in ("count", "p50", "p99"):
+            assert f"h_s:{field}" in names
+        assert rec.series_values("g_now") == [7.5]
+        assert rec.series_values("h_s:count") == [3]
+
+    def test_series_memory_stays_bounded_over_many_samples(self,
+                                                           flight_obs):
+        reg, _, _, _ = flight_obs
+        rec = FlightRecorder(registry=reg, recent_points=32,
+                             decimated_points=16, decimation=4,
+                             max_series=8)
+        g = reg.gauge("bounded")
+        for i in range(5_000):
+            g.set(i)
+            rec.sample()
+        assert len(rec.series_values("bounded")) <= 32 + 16
+        assert rec.samples == 5_000
+
+    def test_series_count_capped_and_overflow_counted(self):
+        from large_scale_recommendation_tpu.obs.registry import (
+            MetricsRegistry,
+        )
+
+        reg = MetricsRegistry()  # isolated: no journal counters in it
+        rec = FlightRecorder(registry=reg, max_series=5)
+        for i in range(9):
+            reg.gauge("g", idx=str(i)).set(i)
+        rec.sample()
+        assert len(rec.series_names()) == 5
+        assert rec.dropped_series == 4
+        rec.sample()  # DISTINCT refused keys, not refusals-per-tick
+        assert rec.dropped_series == 4
+        assert rec.snapshot()["dropped_series"] == 4
+        # the overflow accounting is itself bounded: unbounded label
+        # cardinality cannot grow the recorder's heap through it
+        for i in range(9, 9 + 2 * rec.max_series):
+            reg.gauge("g", idx=str(i)).set(i)
+        rec.sample()
+        assert rec.dropped_series <= rec.max_series
+
+    def test_start_with_new_interval_restarts_cadence(self, flight_obs):
+        _, _, rec, _ = flight_obs
+        rec.start(interval_s=30.0)
+        task = rec._task
+        rec.start(interval_s=5.0)  # advertised cadence must be real
+        assert rec._task is not task
+        assert rec._task.interval_s == rec.interval_s == 5.0
+        rec.stop()
+
+    def test_start_uses_shared_periodic_task_and_is_idempotent(
+            self, flight_obs):
+        _, _, rec, _ = flight_obs
+        rec.start(interval_s=30.0)
+        task = rec._task
+        assert isinstance(task, PeriodicTask)  # the ONE shared cadence
+        assert rec.running
+        assert rec.start()._task is task  # idempotent: same live task
+        rec.stop()
+        assert not rec.running
+
+    def test_ensure_periodic_reuses_live_replaces_dead(self):
+        calls = []
+        t1 = ensure_periodic(None, lambda: calls.append(1), 30.0, "t")
+        try:
+            assert t1.running
+            assert ensure_periodic(t1, lambda: None, 30.0, "t") is t1
+        finally:
+            t1.stop()
+        t2 = ensure_periodic(t1, lambda: None, 30.0, "t")
+        try:
+            assert t2 is not t1 and t2.running
+        finally:
+            t2.stop()
+
+    def test_seriesz_endpoint_serves_history(self, flight_obs):
+        from large_scale_recommendation_tpu.obs.server import (
+            ObsServer,
+            http_get,
+        )
+
+        reg, _, rec, _ = flight_obs
+        g = reg.gauge("served_gauge")
+        for i in range(5):
+            g.set(i)
+            rec.sample()
+        with ObsServer() as server:
+            code, body = http_get(server.url + "/seriesz")
+        assert code == 200
+        doc = json.loads(body)
+        pts = doc["series"]["served_gauge"]["points"]
+        assert [v for _, v in pts] == [0, 1, 2, 3, 4]
+        assert doc["samples"] == 5
+        assert doc["tiering"]["decimation"] == rec.decimation
+
+
+class TestPostmortemBundles:
+    def test_forced_watchdog_trip_freezes_validating_bundle(
+            self, flight_obs, tmp_path):
+        """The golden acceptance path: a NaN batch in a REAL OnlineMF
+        run trips the watchdog, and the auto-frozen bundle validates —
+        holding series, events, spans, and health state from before
+        the trip."""
+        reg, tracer, rec, journal = flight_obs
+        rec.bundle_dir = str(tmp_path / "postmortem")
+        model = OnlineMF(OnlineMFConfig(num_factors=4, minibatch_size=64,
+                                        init_capacity=32))
+        wd = TrainingWatchdog(policy="halt")
+        model.watchdog = wd
+        monitor = HealthMonitor()
+        monitor.watch_watchdog(wd)
+        for i in range(4):  # the healthy lead-up the bundle must hold
+            model.partial_fit(_ratings(seed=i))
+            rec.sample()
+        healthy_events = len(journal)
+        bad = Ratings.from_arrays(
+            np.arange(8, dtype=np.int64),
+            np.arange(8, dtype=np.int64),
+            np.full(8, np.nan, np.float32))
+        with pytest.raises(TrainingDivergedError):
+            model.partial_fit(bad)
+
+        path = wd.last_bundle
+        assert path is not None and os.path.isdir(path)
+        manifest = validate_bundle(path)
+        assert manifest["trigger"] == "watchdog_trip"
+        assert manifest["detail"]["reason"] == "non_finite_factors"
+
+        series = json.load(open(os.path.join(path, "series.json")))
+        batch_pts = series["series"]["online_batch_s:count"]["points"]
+        assert [v for _, v in batch_pts] == [1, 2, 3, 4]  # the lead-up
+        events = [json.loads(ln) for ln in
+                  open(os.path.join(path, "events.jsonl"))]
+        kinds = [e["kind"] for e in events]
+        assert kinds[-1] == "watchdog.trip"
+        assert len(events) > healthy_events - 1  # lead-up events kept
+        trace = json.load(open(os.path.join(path, "trace.json")))
+        assert any(e["name"] == "online/partial_fit"
+                   for e in trace["traceEvents"])
+        # /healthz state reflects the incident (the monitor ran at dump)
+        # only if a monitor was passed — here the watchdog's own detail
+        # is the health record; metrics.json must carry the trip counter
+        metrics = json.load(open(os.path.join(path, "metrics.json")))
+        names = {m["name"] for m in metrics["metrics"]}
+        assert "online_batch_s" in names
+
+    def test_nan_trip_bundle_is_strict_json_everywhere(self, flight_obs,
+                                                       tmp_path):
+        """A NaN-loss trip puts non-finite values in the trip detail
+        (and possibly gauges) — every bundle file must still parse
+        under a strict RFC-8259 reader (no NaN/Infinity tokens): the
+        bundle exists FOR external tooling."""
+        reg, _, rec, _ = flight_obs
+        rec.bundle_dir = str(tmp_path / "pm")
+        reg.gauge("poisoned").set(float("nan"))
+        rec.sample()
+        wd = TrainingWatchdog(policy="observe")
+        wd.observe_loss(float("nan"))  # trips; detail carries the NaN
+        assert wd.tripped and wd.last_bundle is not None
+
+        def strict(tok):
+            raise AssertionError(f"non-strict JSON token {tok}")
+
+        for name in os.listdir(wd.last_bundle):
+            with open(os.path.join(wd.last_bundle, name)) as f:
+                for line in (f.read().splitlines()
+                             if name.endswith(".jsonl") else [f.read()]):
+                    if line.strip():
+                        json.loads(line, parse_constant=strict)
+        manifest = validate_bundle(wd.last_bundle)
+        assert manifest["detail"]["loss"] == "nan"  # repr'd, not lost
+
+    def test_explicit_dump_and_validate(self, flight_obs, tmp_path):
+        reg, _, rec, journal = flight_obs
+        reg.gauge("g").set(1)
+        rec.sample()
+        journal.emit("test.marker", note="hello")
+        path = rec.dump(trigger="manual",
+                        directory=str(tmp_path / "bundle"))
+        manifest = validate_bundle(path)
+        assert manifest["trigger"] == "manual"
+        assert manifest["counts"]["events"] == 1
+        assert rec.last_bundle == path
+        # no torn temp directories left behind
+        assert [d for d in os.listdir(tmp_path) if ".tmp-" in d] == []
+
+    def test_restarted_process_never_clobbers_prior_bundles(
+            self, flight_obs, tmp_path):
+        """Auto-named bundles count from zero per process: a fresh
+        recorder (the restarted-after-the-incident case) must skip past
+        existing names, not rmtree the very bundle that explains the
+        restart."""
+        _, _, _, _ = flight_obs
+        first = FlightRecorder(bundle_dir=str(tmp_path / "pm"))
+        p0 = first.dump(trigger="watchdog_trip")
+        marker = os.path.join(p0, "manifest.json")
+        created0 = json.load(open(marker))["created"]
+        restarted = FlightRecorder(bundle_dir=str(tmp_path / "pm"))
+        p1 = restarted.dump(trigger="watchdog_trip")
+        assert p1 != p0
+        assert json.load(open(marker))["created"] == created0  # intact
+        assert sorted(os.listdir(tmp_path / "pm")) == [
+            "bundle_watchdog_trip_000", "bundle_watchdog_trip_001"]
+
+    def test_first_run_already_critical_still_dumps(self, flight_obs,
+                                                    tmp_path):
+        """A monitor started after the incident began (first evaluation
+        is CRITICAL) must still journal the transition and freeze a
+        bundle — an unobserved monitor counts as OK."""
+        _, _, rec, journal = flight_obs
+        rec.bundle_dir = str(tmp_path / "pm")
+        monitor = HealthMonitor()
+        monitor.register("born_bad", lambda: critical(note="from boot"))
+        assert monitor.run()["status"] == CRITICAL
+        assert rec.bundles_written == 1
+        assert validate_bundle(rec.last_bundle)["trigger"] == \
+            "health_critical"
+        trans = journal.events(kind="health.transition")
+        assert trans[-1]["detail"] == {
+            "from_status": "ok", "to_status": CRITICAL,
+            "failing_checks": {"born_bad": "critical"}}
+
+    def test_dump_with_monitor_mid_transition_does_not_deadlock(
+            self, flight_obs, tmp_path):
+        """dump(monitor=...) runs the monitor OUTSIDE the bundle lock:
+        if that very run detects the ok→CRITICAL transition, the
+        auto-dump it triggers must complete instead of deadlocking the
+        incident thread on the non-reentrant lock."""
+        _, _, rec, _ = flight_obs
+        rec.bundle_dir = str(tmp_path / "pm")
+        state = {"bad": False}
+        monitor = HealthMonitor()
+        monitor.register(
+            "c", lambda: critical() if state["bad"] else ok())
+        monitor.run()  # baseline ok
+        state["bad"] = True
+        done = {}
+
+        def dump():
+            done["path"] = rec.dump(trigger="manual", monitor=monitor)
+
+        t = threading.Thread(target=dump, daemon=True)
+        t.start()
+        t.join(timeout=20)
+        assert not t.is_alive(), "dump(monitor=) deadlocked"
+        # both bundles landed: the transition's auto-dump AND ours
+        names = sorted(os.listdir(tmp_path / "pm"))
+        assert any("health_critical" in n for n in names)
+        assert any("manual" in n for n in names)
+        for n in names:
+            validate_bundle(str(tmp_path / "pm" / n))
+
+    def test_reenabling_flight_recorder_stops_old_sampler(self,
+                                                          flight_obs):
+        _, _, _, _ = flight_obs
+        first, _ = obs.enable_flight_recorder(interval_s=30.0)
+        assert first.running
+        second, _ = obs.enable_flight_recorder(start=False)
+        assert not first.running  # old daemon thread was stopped
+        assert get_recorder() is second
+        second.stop()
+
+    def test_dump_without_destination_raises(self, flight_obs):
+        _, _, rec, _ = flight_obs
+        with pytest.raises(ValueError, match="bundle destination"):
+            rec.dump()
+        assert rec.maybe_dump("watchdog_trip") is None  # hook form: skip
+
+    def test_validate_bundle_rejects_missing_and_corrupt_files(
+            self, flight_obs, tmp_path):
+        _, _, rec, _ = flight_obs
+        path = rec.dump(trigger="manual", directory=str(tmp_path / "b"))
+        validate_bundle(path)
+        os.remove(os.path.join(path, "health.json"))
+        with pytest.raises(ValueError, match="missing health.json"):
+            validate_bundle(path)
+        with open(os.path.join(path, "health.json"), "w") as f:
+            f.write("{not json")
+        with pytest.raises(ValueError, match="not valid JSON"):
+            validate_bundle(path)
+        with open(os.path.join(path, "manifest.json"), "w") as f:
+            json.dump({"bundle_version": 99}, f)
+        with pytest.raises(ValueError, match="bundle_version"):
+            validate_bundle(path)
+
+    def test_critical_health_transition_dumps_once(self, flight_obs,
+                                                   tmp_path):
+        """Entering CRITICAL freezes one bundle at the TRANSITION;
+        staying critical across later scrapes does not write more."""
+        _, _, rec, journal = flight_obs
+        rec.bundle_dir = str(tmp_path / "pm")
+        state = {"status": "ok"}
+        monitor = HealthMonitor()
+        monitor.register(
+            "flappy",
+            lambda: ok() if state["status"] == "ok" else critical())
+        assert monitor.run()["status"] == "ok"
+        state["status"] = "bad"
+        report = monitor.run()
+        assert report["status"] == CRITICAL
+        assert rec.bundles_written == 1
+        manifest = validate_bundle(rec.last_bundle)
+        assert manifest["trigger"] == "health_critical"
+        assert manifest["detail"]["failing_checks"] == {
+            "flappy": "critical"}
+        # the bundle's health.json is the transition report itself
+        health = json.load(
+            open(os.path.join(rec.last_bundle, "health.json")))
+        assert health["status"] == CRITICAL
+        monitor.run()  # still critical — no new bundle
+        assert rec.bundles_written == 1
+        # the transition itself was journaled
+        trans = journal.events(kind="health.transition")
+        assert trans[-1]["severity"] == "critical"
+        assert trans[-1]["detail"]["to_status"] == CRITICAL
+        # recovery journals the ok transition too
+        state["status"] = "ok"
+        monitor.run()
+        assert journal.events(
+            kind="health.transition")[-1]["detail"]["to_status"] == "ok"
+        assert rec.bundles_written == 1
+
+    def test_write_bundle_is_atomic_under_concurrent_dumps(
+            self, flight_obs, tmp_path):
+        _, _, rec, _ = flight_obs
+        rec.bundle_dir = str(tmp_path / "pm")
+        errors = []
+
+        def dump():
+            try:
+                rec.dump(trigger="race")
+            except Exception as e:  # pragma: no cover - failure detail
+                errors.append(e)
+
+        threads = [threading.Thread(target=dump) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors
+        bundles = sorted(os.listdir(tmp_path / "pm"))
+        assert len(bundles) == 4
+        for b in bundles:
+            validate_bundle(str(tmp_path / "pm" / b))
+
+    def test_write_bundle_standalone_without_recorder(self, flight_obs,
+                                                      tmp_path):
+        path = write_bundle(str(tmp_path / "bare"), trigger="manual")
+        manifest = validate_bundle(path)
+        assert manifest["counts"]["series"] == 0

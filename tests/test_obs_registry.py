@@ -137,6 +137,28 @@ class TestExporters:
         assert 'lat_s_count{route="a"} 1' in text
         assert text.endswith("\n")
 
+    def test_prometheus_escapes_label_values(self, reg):
+        # the health gauge's `check` label carries check NAMES, and
+        # watch_series defaults those to recorder series keys like
+        # 'lag{partition="0"}' — unescaped, the nested quotes abort the
+        # whole /metrics parse
+        reg.gauge("health_check_status",
+                  check='anomaly:lag{partition="0"}').set(0)
+        reg.counter("weird_total", path="a\\b\nc").inc()
+        text = reg.to_prometheus()
+        assert ('health_check_status{check='
+                '"anomaly:lag{partition=\\"0\\"}"} 0') in text
+        assert 'weird_total{path="a\\\\b\\nc"} 1' in text
+        # every metric line is valid exposition: name{escaped-labels} value
+        import re
+        body = re.compile(r'[a-zA-Z_:][a-zA-Z0-9_:]*'
+                          r'(\{([a-zA-Z_][a-zA-Z0-9_]*='
+                          r'"(\\.|[^"\\])*",?)*\})? \S+')
+        for line in text.splitlines():
+            if line.startswith("#") or not line:
+                continue
+            assert body.fullmatch(line), line
+
 
 class TestThreadSafety:
     def test_concurrent_updates_are_exact(self, reg):
@@ -196,21 +218,22 @@ class TestNullLayer:
         NULL_REGISTRY.append_jsonl(str(path))
         assert not path.exists()
 
-    def test_enable_disable_roundtrip(self):
-        from large_scale_recommendation_tpu.obs.trace import (
-            get_tracer,
-            set_tracer,
+    def test_enable_disable_roundtrip(self, null_obs):
+        # null_obs (tests/conftest.py) restores the WHOLE layer after,
+        # so enabling/disabling freely here is safe even under OBS_OUT
+        from large_scale_recommendation_tpu.obs.events import get_events
+        from large_scale_recommendation_tpu.obs.recorder import (
+            get_recorder,
         )
+        from large_scale_recommendation_tpu.obs.trace import get_tracer
 
-        prev_r, prev_t = get_registry(), get_tracer()
-        try:
-            reg, tracer = obs.enable()
-            assert get_registry() is reg
-            assert get_tracer() is tracer
-            assert obs.enabled()
-            obs.disable()
-            assert isinstance(get_registry(), NullRegistry)
-            assert not obs.enabled()
-        finally:
-            set_registry(prev_r)
-            set_tracer(prev_t)
+        reg, tracer = obs.enable()
+        assert get_registry() is reg
+        assert get_tracer() is tracer
+        assert obs.enabled()
+        rec, journal = obs.enable_flight_recorder(start=False)
+        assert get_recorder() is rec and get_events() is journal
+        obs.disable()  # also uninstalls the recorder/journal
+        assert isinstance(get_registry(), NullRegistry)
+        assert not obs.enabled()
+        assert get_events() is None and get_recorder() is None

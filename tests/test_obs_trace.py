@@ -42,7 +42,12 @@ class TestSpans:
         # child interval strictly inside the parent interval
         assert outer["ts"] <= inner["ts"]
         assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"]
-        assert outer["args"] == {"kind": "a"}
+        # args carry the user kwargs PLUS the span id (the event-journal
+        # correlation token, unique per span)
+        assert outer["args"] == {"kind": "a", "span_id": outer["args"]
+                                 ["span_id"]}
+        ids = {e["args"]["span_id"] for e in events}
+        assert len(ids) == 3 and all(isinstance(i, int) for i in ids)
         assert outer["tid"] == inner["tid"]
 
     def test_threads_get_independent_stacks(self, tracer):
@@ -93,7 +98,10 @@ class TestSpans:
         doc = tracer.chrome_trace()
         validate_chrome_trace(doc)
         (e,) = doc["traceEvents"]
-        assert e["ph"] == "i" and e["args"] == {"version": 3}
+        # args = user kwargs + the correlation token (None outside any
+        # open span — instants are joinable, same as complete events)
+        assert e["ph"] == "i" and e["args"] == {"version": 3,
+                                                "span_id": None}
 
     def test_max_events_cap_counts_drops(self):
         tracer = Tracer(max_events=2)
