@@ -72,6 +72,14 @@ class DSGDConfig:
     # requires the default RegularizedSGDUpdater family,
     # collision_mode="mean" and precompute_collisions=True.
     kernel: str = "xla"
+    # factor table storage dtype: "float32" | "bfloat16" (the ALX
+    # recipe, training half — ISSUE 6). bf16 halves the tables' HBM
+    # footprint and per-sweep factor traffic; BOTH kernels accumulate
+    # gradients in f32 (dsgd_train upcasts once per segment, the Pallas
+    # kernels upcast the VMEM-resident slice), so duplicate-row scatter
+    # semantics stay exact. Checkpoints round-trip the dtype
+    # (utils.checkpoint bit-view encoding).
+    factor_dtype: str = "float32"
 
     def schedule_fn(self):
         return schedule_from_name(self.lr_schedule, self.lambda_)
@@ -186,6 +194,13 @@ class DSGD:
         )
 
         cfg = self.config
+        if cfg.factor_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"factor_dtype {cfg.factor_dtype!r} unsupported; "
+                "float32 or bfloat16")
+        fdt = jnp.dtype(cfg.factor_dtype)
+        U = jnp.asarray(U).astype(fdt)
+        V = jnp.asarray(V).astype(fdt)
         done = 0
         if resume:
             if checkpoint_manager is None:
@@ -223,7 +238,12 @@ class DSGD:
                 if self._events is not None:
                     self._events.emit("train.checkpoint", model="dsgd",
                                       kind=kind, step=int(done))
-        timer.finish(n_ratings)
+        timer.finish(n_ratings, bytes_per_iteration=(
+            None if n_ratings is None else sgd_ops.dsgd_bytes_per_sweep(
+                n_ratings, int(np.shape(U)[-1]), kernel=cfg.kernel,
+                num_blocks=k, rows_u=int(np.shape(U)[0]),
+                rows_v=int(np.shape(V)[0]),
+                factor_bytes=jnp.dtype(cfg.factor_dtype).itemsize)))
         return U, V
 
     def _train_fn(self, args):

@@ -77,23 +77,33 @@ class TrainSegmentTimer:
             self._segments.inc()
             self._walls.append((int(iterations), wall))
 
-    def finish(self, units_per_iteration: int | float | None) -> None:
+    def finish(self, units_per_iteration: int | float | None,
+               bytes_per_iteration: int | float | None = None) -> None:
         """Publish throughput gauges: ``phase="all"`` over every segment,
         ``phase="steady"`` excluding the first (compile-carrying) one —
         only when at least two segments ran, so a single-segment fit
-        never reports a compile-polluted number as steady-state."""
+        never reports a compile-polluted number as steady-state.
+
+        ``bytes_per_iteration`` (the roofline model's HBM bytes one
+        sweep moves — ``ops.sgd.dsgd_bytes_per_sweep``) additionally
+        publishes ``train_hbm_gbs`` gauges with the same phase split,
+        so achieved bandwidth shows up in /metrics and the flight
+        recorder next to ratings/s (ISSUE 6)."""
         if not self._on or not self._walls or not units_per_iteration:
             return
 
-        def rate(walls):
+        def rate(walls, units):
             iters = sum(i for i, _ in walls)
             wall = sum(w for _, w in walls)
-            return units_per_iteration * iters / wall if wall > 0 else 0.0
+            return units * iters / wall if wall > 0 else 0.0
 
-        self._obs.gauge("train_throughput_ratings_per_s",
-                        model=self.label, phase="all").set(
-            rate(self._walls))
-        if len(self._walls) > 1:
-            self._obs.gauge("train_throughput_ratings_per_s",
-                            model=self.label, phase="steady").set(
-                rate(self._walls[1:]))
+        def publish(name, units, scale=1.0):
+            self._obs.gauge(name, model=self.label, phase="all").set(
+                rate(self._walls, units) * scale)
+            if len(self._walls) > 1:
+                self._obs.gauge(name, model=self.label, phase="steady").set(
+                    rate(self._walls[1:], units) * scale)
+
+        publish("train_throughput_ratings_per_s", units_per_iteration)
+        if bytes_per_iteration:
+            publish("train_hbm_gbs", bytes_per_iteration, 1e-9)

@@ -1,4 +1,4 @@
-"""Pallas DSGD block-sweep prototype: VMEM-staged factor slices.
+"""Pallas DSGD kernels: VMEM-staged factor slices, double-buffered.
 
 The measured ceiling of the XLA kernel is the per-row HBM gather/scatter:
 random 512-byte rows stream at ~5 GB/s effective (~0.6% of HBM peak,
@@ -43,8 +43,9 @@ lowering verdicts"):
 - ``gather="loop"`` (default): per-entry row copies ref→ref through a
   VMEM scratch, with row numbers read as SCALARS from an SMEM copy of
   the index block (dynamic addressing is only lowerable through Refs,
-  never on values). AOT VERDICT: compiles for v5e at the north-star
-  config (k=16, rank 128, mb 2048) — the production path.
+  never on values). AOT VERDICT: compiles for v5e at the k ≥ 32 ML-25M
+  geometries (the historical k=16 point OOM'd this round under the 2×
+  stream buffering, docs/MOSAIC_AOT.json) — the production path.
 
 Scatter is a per-entry read-modify-write ``fori_loop`` on the VMEM slice
 either way — deltas are first stored to VMEM scratch so every dynamic
@@ -71,12 +72,43 @@ probe script).
 
 VMEM budget: U-slice [rpb_u, r] + V-slice [rpb_v, r] + the [mb, r]
 scratch tiles (gathered u, v in loop mode; deltas du, dv always) + the
-full stream arrays (6 f32 + in take mode 2 i32, 4 bytes × e each) must
-fit ~16 MB; at rank 128 that means k=16 blocks for the ML-25M shape
-(5.2 MB + 1.9 MB slices) with mb ≤ 2048. SMEM holds the two full
-row-index copies (2 × e int32) against v5e's 1.0 MB scoped budget,
-capping block-visit nnz at ~115K (k ≥ 16 for ML-25M). The wrapper
+full stream arrays (6 f32 + in take mode 2 i32, 4 bytes × e each —
+DOUBLE-buffered by this jax's pipeline even at a constant index map,
+AOT-measured) must fit ~16 MB; at rank 128 that means k ≥ 32 blocks
+for the ML-25M shape (the historical k=16 point OOMs under the 2×
+stream buffering — recorded negative, docs/MOSAIC_AOT.json). The flat
+row indices ride as single-buffered scalar-prefetch SMEM against v5e's
+1.0 MB scoped budget, capping block-visit nnz at ~115K. The wrapper
 checks both.
+
+Double-buffered stratum pipeline (ISSUE 6 tentpole, the CuMF_SGD
+memory-locality recipe): ``pallas_stratum_sweep`` processes ALL k block
+visits of one stratum in a single ``pallas_call`` with grid
+``(k, n_mb)``, every operand left in HBM (``pl.ANY``) and moved by
+MANUAL ``make_async_copy`` DMAs into two scratch slots — visit p
+computes out of slot p%2 while slot (p+1)%2 receives visit p+1's U/V
+slices, stream block and row indices, and visit p−1's updated slices
+flush back behind the first minibatch of compute. Mosaic's implicit
+operand pipeline cannot express this schedule: its block-tiling rule
+rejects the per-visit SMEM index blocks outright (``(1, 2e)`` blocks of
+a ``[k², 2e]`` array — AOT-measured, docs/MOSAIC_AOT.json) and it
+buffers in+out slices separately (4 slice buffers where the manual RMW
+slots need 2). Slot parity is compiled out: the whole per-visit body is
+emitted once per parity under ``pl.when(p % 2 == par)`` so every
+ref access is statically addressed — only the DMA source/destination
+offsets are runtime values (the Gemulla diagonal: U block p, V block
+(p+s) mod k, driven by the scalar-prefetch stratum id). Within a
+stratum every block is row-disjoint in BOTH users and items (the whole
+point of the Gemulla schedule), so the overlapped fetches/flushes can
+never alias. The serial HBM↔VMEM copy the per-block path pays on every
+visit is hidden behind one minibatch of compute (~4 µs of DMA vs
+≥50 µs of gather/scatter per 2048-entry minibatch at rank 128).
+``dsgd_train_pallas(pipeline=...)`` routes: ``None`` (default)
+auto-selects the pipelined kernel whenever the doubled buffers fit the
+VMEM/SMEM budgets (the price of overlap: 2× the slice footprint plus
+Mosaic's minibatch-scaled vector temporaries — at ML-25M rank 128 the
+AOT-calibrated operating points are k=32 at mb ≤ 1024 or k=64 at
+mb 2048, f32 or bf16; ``stratum_pipeline_budget``).
 """
 
 from __future__ import annotations
@@ -138,7 +170,7 @@ def _gather_rows(tbl_ref, idx_col, mb: int, rank: int):
 
 
 def _sweep_kernel(*refs, lam: float, mb: int, rank: int,
-                  n_mb: int, gather: str):
+                  n_mb: int, gather: str, half: bool):
     """One grid step = one minibatch. u_out/v_out are the VMEM-resident
     block slices, persistent across grid steps (constant index_map).
 
@@ -148,31 +180,41 @@ def _sweep_kernel(*refs, lam: float, mb: int, rank: int,
     stream arrives as a FULL [n_mb, mb] array (block == array shape, which
     the tiling rule exempts) and the kernel slices minibatch g itself — a
     dynamic sublane-start row slice plus a (1, mb)→(mb, 1) relayout, both
-    of which Mosaic lowers. urs/irs are full SMEM copies of the row
-    indices (scalar loop addressing, read as ``ref[g, j]``); urv/irv the
-    VMEM copies (vectorized gather operand); gu/gv/du/dv are [mb, rank]
-    VMEM scratch so every dynamically-indexed access goes through a Ref
-    (value-level dynamic_slice has no Mosaic lowering rule).
+    of which Mosaic lowers. urs/irs are the flat SCALAR-PREFETCH copies of
+    the row indices (read as ``ref[g·mb + j]``): prefetch operands are
+    single-buffered SMEM, where regular SMEM operands are double-buffered
+    by this jax's pipeline — 2× the footprint, measured as the SMEM OOM
+    that broke the k=16 lowering (docs/MOSAIC_AOT.json). urv/irv are the
+    VMEM index copies (vectorized gather operand, take mode only);
+    gu/gv/du/dv are [mb, rank] VMEM scratch so every dynamically-indexed
+    access goes through a Ref (value-level dynamic_slice has no Mosaic
+    lowering rule).
 
-    Mode-conditional operands (the wrapper builds matching specs): the
-    VMEM index copies urv/irv exist only in take mode (loop addresses
-    rows straight from SMEM), and the gu/gv gather scratch exists only in
-    loop mode (take produces the gathered rows as values)."""
+    ``half=True`` (bf16 factor storage, the ALX recipe): u_out/v_out are
+    bf16 — the halved HBM↔VMEM DMA is the point — and uw/vw are f32 work
+    copies of the slices; every gather/delta/scatter runs against the f32
+    work refs so gradient accumulation and duplicate-row semantics stay
+    exact, with ONE downcast back into the bf16 outputs on the last grid
+    step."""
     it = iter(refs)
-    lr_ref = next(it)  # [1, 1] SMEM — the schedule-evaluated η for this
-    # visit (runtime scalar so decaying schedules don't recompile)
-    urs_ref, irs_ref = next(it), next(it)
+    urs_ref, irs_ref = next(it), next(it)  # scalar prefetch (flat [e])
+    lr_ref = next(it)  # [1] scalar prefetch — the schedule-evaluated η
+    # for this visit (runtime scalar so decaying schedules don't
+    # recompile)
     urv_ref, irv_ref = ((next(it), next(it)) if gather == "take"
                         else (None, None))
     (vals_ref, w_ref, icu_ref, icv_ref, ou_ref, ov_ref,
      u_hbm, v_hbm, u_out, v_out) = (next(it) for _ in range(10))
+    uw_ref, vw_ref = ((next(it), next(it)) if half else (u_out, v_out))
     gu_ref, gv_ref = ((next(it), next(it)) if gather != "take"
                       else (None, None))
     du_ref, dv_ref, sems = next(it), next(it), next(it)
 
     g = pl.program_id(0)
 
-    # -- step 0: stage the block's factor slices HBM→VMEM (contiguous) ----
+    # -- step 0: stage the block's factor slices HBM→VMEM (contiguous;
+    # at half width when the tables are bf16), then upcast to the f32
+    # work slices --------------------------------------------------------
     @pl.when(g == 0)
     def _stage():
         cu = pltpu.make_async_copy(u_hbm, u_out, sems.at[0])
@@ -181,18 +223,21 @@ def _sweep_kernel(*refs, lam: float, mb: int, rank: int,
         cv.start()
         cu.wait()
         cv.wait()
+        if half:
+            uw_ref[...] = u_out[...].astype(jnp.float32)
+            vw_ref[...] = v_out[...].astype(jnp.float32)
 
     def col(ref):  # minibatch g's stream as an [mb, 1] sublane column
         return jnp.reshape(ref[pl.ds(g, 1), :], (mb, 1))
 
     if gather == "take":
-        u = _gather_rows(u_out, col(urv_ref), mb, rank)
-        v = _gather_rows(v_out, col(irv_ref), mb, rank)
+        u = _gather_rows(uw_ref, col(urv_ref), mb, rank)
+        v = _gather_rows(vw_ref, col(irv_ref), mb, rank)
     else:  # "loop": per-entry ref→ref row copies, SMEM scalar addressing
 
         def load_rows(j, _):
-            gu_ref[pl.ds(j, 1), :] = u_out[pl.ds(urs_ref[g, j], 1), :]
-            gv_ref[pl.ds(j, 1), :] = v_out[pl.ds(irs_ref[g, j], 1), :]
+            gu_ref[pl.ds(j, 1), :] = uw_ref[pl.ds(urs_ref[g * mb + j], 1), :]
+            gv_ref[pl.ds(j, 1), :] = vw_ref[pl.ds(irs_ref[g * mb + j], 1), :]
             return 0
 
         jax.lax.fori_loop(0, mb, load_rows, 0)
@@ -205,27 +250,33 @@ def _sweep_kernel(*refs, lam: float, mb: int, rank: int,
     # same axis as the gathered rows, so everything is elementwise -------
     w = col(w_ref)
     e = (col(vals_ref) - jnp.sum(u * v, axis=-1, keepdims=True)) * w
-    t_lr = lr_ref[0, 0]
+    t_lr = lr_ref[0]
     gu = jnp.maximum(col(ou_ref), 1.0)
     gv = jnp.maximum(col(ov_ref), 1.0)
     du_ref[...] = (t_lr * (e * v - (lam / gu) * u * w)) * col(icu_ref)
     dv_ref[...] = (t_lr * (e * u - (lam / gv) * v * w)) * col(icv_ref)
 
-    # -- scatter: sequential per-entry RMW on the VMEM slice — duplicates
+    # -- scatter: sequential per-entry RMW on the f32 slice — duplicates
     # accumulate exactly like .at[].add ------------------------------------
     def rmw(j, _):
-        row_u = urs_ref[g, j]
-        u_out[pl.ds(row_u, 1), :] += du_ref[pl.ds(j, 1), :]
-        row_v = irs_ref[g, j]
-        v_out[pl.ds(row_v, 1), :] += dv_ref[pl.ds(j, 1), :]
+        row_u = urs_ref[g * mb + j]
+        uw_ref[pl.ds(row_u, 1), :] += du_ref[pl.ds(j, 1), :]
+        row_v = irs_ref[g * mb + j]
+        vw_ref[pl.ds(row_v, 1), :] += dv_ref[pl.ds(j, 1), :]
         return 0
 
     jax.lax.fori_loop(0, mb, rmw, 0)
 
+    if half:  # one downcast into the bf16 outputs, last grid step only
+        @pl.when(g == n_mb - 1)
+        def _downcast():
+            u_out[...] = uw_ref[...].astype(u_out.dtype)
+            v_out[...] = vw_ref[...].astype(v_out.dtype)
+
 
 def pallas_block_sweep(
-    U_blk: jax.Array,  # f32[rpb_u, r] — the block's contiguous U rows
-    V_blk: jax.Array,  # f32[rpb_v, r]
+    U_blk: jax.Array,  # f32|bf16[rpb_u, r] — the block's contiguous U rows
+    V_blk: jax.Array,  # f32|bf16[rpb_v, r]
     ur_local: jax.Array,  # int32[E] block-LOCAL user rows
     ir_local: jax.Array,
     vals: jax.Array,  # f32[E]
@@ -243,9 +294,12 @@ def pallas_block_sweep(
 ) -> tuple[jax.Array, jax.Array]:
     """Sweep one rating block with VMEM-resident factor slices.
 
-    Returns the updated (U_blk, V_blk). Semantics ≡
-    ``ops.sgd.sgd_block_sweep`` with the RegularizedSGDUpdater(lr, lam)
-    constant-schedule rule and precomputed collision scales.
+    Returns the updated (U_blk, V_blk) in the INPUT dtype. f32 tables
+    reproduce ``ops.sgd.sgd_block_sweep`` exactly (RegularizedSGDUpdater
+    (lr, lam) constant-schedule rule, precomputed collision scales);
+    bf16 tables DMA at half width and compute against an f32 VMEM work
+    copy — the training half of the ALX bf16-storage/f32-accumulation
+    recipe (serving/ALS had it first).
     """
     if pltpu is None:
         # the grid spec / DMA / semaphore APIs below all live in pltpu, so
@@ -256,21 +310,37 @@ def pallas_block_sweep(
     e = ur_local.shape[0]
     if e % minibatch != 0:
         raise ValueError(f"block nnz {e} not divisible by mb {minibatch}")
+    if U_blk.dtype != V_blk.dtype:
+        raise ValueError(
+            f"U/V dtype mismatch: {U_blk.dtype} vs {V_blk.dtype}")
+    if U_blk.dtype not in (jnp.float32, jnp.bfloat16):
+        raise ValueError(
+            f"factor dtype {U_blk.dtype} unsupported; float32 or bfloat16")
+    half = U_blk.dtype == jnp.bfloat16
+    fac_bytes = 2 if half else 4
     rank = int(U_blk.shape[-1])
     n_mb = e // minibatch
-    # VMEM budget (ADVICE r4): resident slices + [mb, rank] scratch tiles
-    # + the full f32 stream arrays (delivered whole — block == array, so
-    # no double buffering) + the take-only extras.
+    rows_uv = int(U_blk.shape[0]) + int(V_blk.shape[0])
+    # VMEM budget (ADVICE r4, re-measured on this jax): resident slices
+    # (+ the f32 work copies in bf16 mode) + [mb, rank] scratch tiles +
+    # the full stream arrays — which this jax's pipeline DOUBLE-BUFFERS
+    # even at a constant index map (the ×2 below; measured via AOT SMEM
+    # accounting, docs/MOSAIC_AOT.json) — + the take-only extras.
     rpb_max = max(int(U_blk.shape[0]), int(V_blk.shape[0]))
     take = gather == "take"
     # take: + 2 idx streams in VMEM + the transient padded [rpb, rank]
     # index/output pair (larger side only — the two gathers are
     # sequential); loop: + 2 gather scratch tiles (du/dv counted always)
-    transient = (2 * rpb_max * rank + 2 * e) if take else 0
+    transient = (2 * rpb_max * rank + 2 * e) * 4 if take else 0
     n_scratch = 2 if take else 4
-    vmem_mb = (U_blk.size + V_blk.size + n_scratch * minibatch * rank
-               + 6 * e + transient) * 4 / 2**20
-    if vmem_mb > 15 and not interpret:
+    slices = rows_uv * rank * fac_bytes + (
+        rows_uv * rank * 4 if half else 0)
+    vmem_mb = (slices + (n_scratch * minibatch * rank + 2 * 6 * e) * 4
+               + transient) / 2**20
+    # threshold 14, not 15: the k=16 ML-25M geometry modeled at 14.98 MB
+    # and still OOM'd the v5e VMEM stack (AOT-measured, the 2× stream
+    # buffering plus Mosaic's vector temporaries) — reject it up front
+    if vmem_mb > 14 and not interpret:
         raise ValueError(
             f"~{vmem_mb:.1f} MB of VMEM-resident state (slices + scratch "
             "tiles + stream arrays"
@@ -278,8 +348,10 @@ def pallas_block_sweep(
             + ") exceeds the ~16 MB budget; use more blocks (smaller row "
             "slices), a smaller minibatch, a smaller rank, or "
             "gather='loop'")
-    # SMEM budget (AOT-measured: v5e exposes 1.0 MB of scoped SMEM, and
-    # the two full row-index copies live there for scalar addressing)
+    # SMEM budget (AOT-measured: v5e exposes 1.0 MB of scoped SMEM). The
+    # row indices ride as SCALAR-PREFETCH operands — single-buffered,
+    # unlike regular SMEM operands which this jax double-buffers (the
+    # regression that broke the k=16 lowering, docs/MOSAIC_AOT.json).
     smem_kb = 2 * e * 4 / 1024
     if smem_kb > 900 and not interpret:
         raise ValueError(
@@ -301,23 +373,19 @@ def pallas_block_sweep(
     def rows(a, dt):
         return jnp.asarray(a, dt).reshape(n_mb, minibatch)
 
-    fullspec = lambda: pl.BlockSpec((n_mb, minibatch), lambda g: (0, 0))
-    smemspec = lambda: pl.BlockSpec(memory_space=pltpu.SMEM)
+    fullspec = lambda: pl.BlockSpec((n_mb, minibatch),
+                                    lambda g, *_: (0, 0))
     kernel = functools.partial(
         _sweep_kernel, lam=lam, mb=minibatch, rank=rank,
-        n_mb=n_mb, gather=gather)
+        n_mb=n_mb, gather=gather, half=half)
     ur32 = jnp.asarray(ur_local, jnp.int32)
     ir32 = jnp.asarray(ir_local, jnp.int32)
-    # lr arrives as a runtime SMEM scalar: a python float stays one compile,
-    # and a schedule-evaluated traced scalar (dsgd_train_pallas) reuses the
-    # SAME compiled kernel across sweeps
-    in_specs = [smemspec(),  # lr
-                smemspec(), smemspec()]  # ur, ir (scalar loop addressing)
-    operands = [jnp.full((1, 1), lr, jnp.float32)
-                if not isinstance(lr, jax.Array)
-                else jnp.asarray(lr, jnp.float32).reshape(1, 1),
-                ur32.reshape(n_mb, minibatch),
-                ir32.reshape(n_mb, minibatch)]
+    # scalar-prefetch operands: flat row indices + the runtime η (a
+    # python float stays one compile; a schedule-evaluated traced scalar
+    # (dsgd_train_pallas) reuses the SAME compiled kernel across sweeps)
+    operands = [ur32.reshape(e), ir32.reshape(e),
+                jnp.asarray(lr, jnp.float32).reshape(1)]
+    in_specs = []
     if take:  # VMEM index copies: the vectorized gather operand
         in_specs += [fullspec(), fullspec()]
         operands += [rows(ur32, jnp.int32), rows(ir32, jnp.int32)]
@@ -331,21 +399,23 @@ def pallas_block_sweep(
         rows(ou_entry, jnp.float32), rows(ov_entry, jnp.float32),
         U_blk, V_blk,
     ]
-    scratch = ([] if take else
-               [pltpu.VMEM((minibatch, rank), jnp.float32),  # gathered u
-                pltpu.VMEM((minibatch, rank), jnp.float32)])  # gathered v
+    scratch = ([pltpu.VMEM(U_blk.shape, jnp.float32),  # f32 work slices
+                pltpu.VMEM(V_blk.shape, jnp.float32)] if half else [])
+    scratch += ([] if take else
+                [pltpu.VMEM((minibatch, rank), jnp.float32),  # gathered u
+                 pltpu.VMEM((minibatch, rank), jnp.float32)])  # gathered v
     scratch += [
         pltpu.VMEM((minibatch, rank), jnp.float32),  # du
         pltpu.VMEM((minibatch, rank), jnp.float32),  # dv
         pltpu.SemaphoreType.DMA((2,)),
     ]
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=0,
+        num_scalar_prefetch=3,
         grid=(n_mb,),
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec(U_blk.shape, lambda g: (0, 0)),  # persistent VMEM
-            pl.BlockSpec(V_blk.shape, lambda g: (0, 0)),
+            pl.BlockSpec(U_blk.shape, lambda g, *_: (0, 0)),  # VMEM,
+            pl.BlockSpec(V_blk.shape, lambda g, *_: (0, 0)),  # persistent
         ],
         scratch_shapes=scratch,
     )
@@ -356,8 +426,8 @@ def pallas_block_sweep(
         typeof = getattr(jax, "typeof", None)  # jax < 0.6 has no typeof
         vma = getattr(typeof(a), "vma", None) if typeof else None
         if vma is None:  # older jax: ShapeDtypeStruct has no vma kwarg
-            return jax.ShapeDtypeStruct(a.shape, jnp.float32)
-        return jax.ShapeDtypeStruct(a.shape, jnp.float32, vma=vma)
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+        return jax.ShapeDtypeStruct(a.shape, a.dtype, vma=vma)
 
     return pl.pallas_call(
         kernel,
@@ -365,6 +435,393 @@ def pallas_block_sweep(
         out_shape=[out(U_blk), out(V_blk)],
         interpret=interpret,
     )(*operands)
+
+
+def _stratum_kernel(*refs, lam: float, mb: int, rank: int, n_mb: int,
+                    k: int, half: bool):
+    """One grid step = minibatch g of block visit p (grid ``(k, n_mb)``).
+
+    Every operand lives in HBM (``pl.ANY``); the kernel moves bytes with
+    MANUAL double-buffered DMAs (the guide's canonical pattern — two
+    scratch slots, visit p computes out of slot p%2):
+
+    - at (p, 0): wait slot p%2's fetch (started one visit ago; visit 0
+      warm-starts its own), then — in bf16 mode — upcast the slice pair
+      into the f32 work refs;
+    - at (p, min(1, n_mb−1)): wait visit p−1's flush of the OTHER slot
+      (it had minibatch 0 of compute to drain), then start visit p+1's
+      fetch into it — U block p+1, V block (p+1+s) mod k, stream block
+      and row indices, all sliced from HBM at runtime offsets driven by
+      the scalar-prefetch stratum id;
+    - at (p, n_mb−1): downcast (bf16) back into the slot pair and start
+      its flush VMEM→HBM; the LAST visit also drains it so no DMA
+      outlives the kernel.
+
+    Within a stratum every visit is row-disjoint in BOTH tables
+    (Gemulla), so overlapped fetches/flushes never alias in HBM; slot
+    reuse hazards are exactly the two semaphore waits above.
+
+    Slot parity is static: the whole per-visit body is emitted once per
+    parity under ``pl.when(p % 2 == par)``, so every VMEM/SMEM access is
+    statically addressed (the same restriction the per-block kernel
+    obeys: dynamic addressing only ever through ``pl.ds`` row slices).
+
+    Row indices land in SMEM scratch as the visit's whole [2, e] plane
+    (scalar loop addressing, read as ``idx[0|1, g·mb + j]``); the stream
+    block in VMEM (vals/w/icu/icv/ωu/ωv stacked on the sublane axis —
+    minibatch g of stream c is the dynamic row slice at c·n_mb+g, the
+    same relayout the per-block kernel uses).
+
+    ``half=True``: bf16 slot buffers (the halved HBM↔VMEM DMA is the
+    point) with ONE f32 work pair uw/vw seeded at g==0 and downcast at
+    g==n_mb−1 — gradient accumulation and duplicate-row scatter stay
+    exact f32. f32 mode computes in the slot buffers directly."""
+    it = iter(refs)
+    s_ref, lr_ref = next(it), next(it)  # scalar prefetch
+    idx_hbm, str_hbm, u_hbm, v_hbm, u_out, v_out = (next(it)
+                                                    for _ in range(6))
+    u_bufs = (next(it), next(it))  # per-slot factor slices (store dtype)
+    v_bufs = (next(it), next(it))
+    s_bufs = (next(it), next(it))  # per-slot stream blocks
+    i_bufs = (next(it), next(it))  # per-slot SMEM [2, e] row indices
+    uw_ref, vw_ref = ((next(it), next(it)) if half else (None, None))
+    gu_ref, gv_ref, du_ref, dv_ref = (next(it) for _ in range(4))
+    fetch_sems, flush_sems = next(it), next(it)
+
+    s = s_ref[0]
+    p = pl.program_id(0)
+    g = pl.program_id(1)
+    # the step at which the look-ahead fetch starts: after one minibatch
+    # of compute (so visit p−1's flush has had work to hide behind) —
+    # except at n_mb == 1, where step 0 is all there is
+    ahead_g = min(1, n_mb - 1)
+
+    # Every DMA moves a FULL leading-dim plane of a ≥3-D HBM operand
+    # (tables arrive as [k, rpb, r], indices as [k², 2, e], streams as
+    # [k², 6·n_mb, mb]): full-plane slices start on tile boundaries for
+    # any rpb/e, where row-range slices of a 2-D table (and single-row
+    # slices of the [2, e] index plane) are misaligned whenever the
+    # offset is not a tile multiple — both Mosaic-rejected, AOT-measured
+    # (docs/MOSAIC_AOT.json "Slice shape must be aligned"/"DMA source
+    # and target shape mismatch" rounds).
+    def fetch(pv, sl):
+        """The 4 DMAs that stage visit ``pv`` into slot ``sl``."""
+        q = (pv + s) % k
+        vrow = s * k + pv
+        return (
+            pltpu.make_async_copy(u_hbm.at[pv], u_bufs[sl],
+                                  fetch_sems.at[sl, 0]),
+            pltpu.make_async_copy(v_hbm.at[q], v_bufs[sl],
+                                  fetch_sems.at[sl, 1]),
+            pltpu.make_async_copy(str_hbm.at[vrow], s_bufs[sl],
+                                  fetch_sems.at[sl, 2]),
+            pltpu.make_async_copy(idx_hbm.at[vrow], i_bufs[sl],
+                                  fetch_sems.at[sl, 3]),
+        )
+
+    def flush(pv, sl):
+        """The 2 DMAs that write slot ``sl``'s updated slices back to
+        visit ``pv``'s HBM planes."""
+        q = (pv + s) % k
+        return (
+            pltpu.make_async_copy(u_bufs[sl], u_out.at[pv],
+                                  flush_sems.at[sl, 0]),
+            pltpu.make_async_copy(v_bufs[sl], v_out.at[q],
+                                  flush_sems.at[sl, 1]),
+        )
+
+    for par in (0, 1):
+
+        @pl.when(jax.lax.rem(p, 2) == par)
+        def _visit(par=par):
+            ub, vb = u_bufs[par], v_bufs[par]
+            sb = s_bufs[par]
+            idx = i_bufs[par]
+            uwr = uw_ref if half else ub
+            vwr = vw_ref if half else vb
+
+            @pl.when(g == 0)
+            def _arrive():
+                @pl.when(p == 0)
+                def _warm():  # visit 0 fetches for itself (no overlap)
+                    for c in fetch(0, 0):
+                        c.start()
+
+                for c in fetch(p, par):
+                    c.wait()
+                if half:
+                    uwr[...] = ub[...].astype(jnp.float32)
+                    vwr[...] = vb[...].astype(jnp.float32)
+
+            @pl.when(g == ahead_g)
+            def _ahead():
+                # slot 1−par is free only once visit p−1's flush drained
+                # (it had minibatch 0 of this visit to overlap with)
+                @pl.when(p >= 1)
+                def _reclaim():
+                    for c in flush(p - 1, 1 - par):
+                        c.wait()
+
+                @pl.when(p + 1 < k)
+                def _prefetch():
+                    for c in fetch(p + 1, 1 - par):
+                        c.start()
+
+            def col(c):  # stream c, minibatch g, as [mb, 1] column
+                return jnp.reshape(sb[pl.ds(c * n_mb + g, 1), :], (mb, 1))
+
+            # -- gather: per-entry ref→ref row copies, SMEM scalars ------
+            def load_rows(j, _):
+                gu_ref[pl.ds(j, 1), :] = uwr[pl.ds(idx[0, g * mb + j], 1), :]
+                gv_ref[pl.ds(j, 1), :] = vwr[pl.ds(idx[1, g * mb + j], 1), :]
+                return 0
+
+            jax.lax.fori_loop(0, mb, load_rows, 0)
+            u = gu_ref[...]
+            v = gv_ref[...]
+
+            # -- delta: the λ/ω rule, identical to _sweep_kernel ---------
+            w = col(1)
+            err = (col(0) - jnp.sum(u * v, axis=-1, keepdims=True)) * w
+            t_lr = lr_ref[0]
+            gu = jnp.maximum(col(4), 1.0)
+            gv = jnp.maximum(col(5), 1.0)
+            du_ref[...] = (t_lr * (err * v - (lam / gu) * u * w)) * col(2)
+            dv_ref[...] = (t_lr * (err * u - (lam / gv) * v * w)) * col(3)
+
+            # -- scatter: sequential per-entry RMW — duplicates add ------
+            def rmw(j, _):
+                uwr[pl.ds(idx[0, g * mb + j], 1), :] += \
+                    du_ref[pl.ds(j, 1), :]
+                vwr[pl.ds(idx[1, g * mb + j], 1), :] += \
+                    dv_ref[pl.ds(j, 1), :]
+                return 0
+
+            jax.lax.fori_loop(0, mb, rmw, 0)
+
+            @pl.when(g == n_mb - 1)
+            def _depart():
+                if half:  # one downcast into the slot pair per visit
+                    ub[...] = uwr[...].astype(ub.dtype)
+                    vb[...] = vwr[...].astype(vb.dtype)
+                for c in flush(p, par):
+                    c.start()
+
+                @pl.when(p == k - 1)
+                def _drain():  # no DMA may outlive the kernel
+                    for c in flush(p, par):
+                        c.wait()
+
+
+def stratum_pipeline_budget(rpb_u: int, rpb_v: int, rank: int, e: int,
+                            minibatch: int,
+                            fac_bytes: int) -> tuple[float, float]:
+    """(vmem_mb, smem_kb) the pipelined stratum kernel needs.
+
+    Manual double buffering: two slots, each holding one U/V slice pair
+    (store dtype — the slot is both DMA landing zone and RMW target, so
+    there is no separate in/out copy) + one stream block; the row
+    indices land in SMEM (two slots × two streams). The f32 work pair
+    exists only at fac_bytes == 2."""
+    half = fac_bytes == 2
+    align = 16 if half else 8
+
+    def pad(n, m):
+        return -(-n // m) * m
+
+    rows = pad(rpb_u, align) + pad(rpb_v, align)  # DMA tile alignment
+    rows6 = pad(6 * (e // minibatch), 8)          # stream sublanes
+    vmem = (2 * rows * rank * fac_bytes          # 2 slot slice pairs
+            + (rows * rank * 4 if half else 0)   # f32 work pair
+            + 2 * rows6 * minibatch * 4          # 2 slot stream blocks
+            + 4 * minibatch * rank * 4           # gu/gv/du/dv tiles
+            # Mosaic's live vector temporaries in the delta math,
+            # calibrated by AOT bisection: ML-25M k=32 modeled 11.9 MB
+            # sans this term yet OOM'd the 16 MB VMEM stack at mb 2048,
+            # while mb 1024 (9.9 MB sans) compiled — the overhead scales
+            # with the minibatch tile, ~2 live [mb, rank] f32 values in
+            # EACH of the two parity-duplicated visit bodies
+            + 4 * minibatch * rank * 4)
+    smem = 2 * 2 * e * 4                         # 2 slots × [2, e]
+    return vmem / 2**20, smem / 1024
+
+
+def pallas_stratum_sweep(
+    U: jax.Array,  # f32|bf16[k·rpb_u, r] — the FULL user table
+    V: jax.Array,  # f32|bf16[k·rpb_v, r]
+    idx: jax.Array,  # int32[k·k, 2, e] visit-major block-LOCAL rows
+    #                  (row s·k+p = visit p of stratum s: [u rows, i rows])
+    streams: jax.Array,  # f32[k·k, 6·n_mb, mb] stacked per-entry streams
+    #                      (vals, w, icu, icv, ωu, ωv on the sublane axis)
+    s: jax.Array | int,  # stratum id (runtime scalar — one compile)
+    *,
+    lr: float | jax.Array,
+    lam: float,
+    minibatch: int,
+    num_blocks: int,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """Sweep ONE stratum — all k row-disjoint block visits — in a single
+    pallas_call with double-buffered HBM↔VMEM slice/stream pipelining.
+
+    Semantics ≡ k sequential ``pallas_block_sweep`` calls on the
+    stratum's blocks (the per-visit order p = 0..k−1 of
+    ``dsgd_train_pallas``); the difference is purely WHEN bytes move:
+    visit p+1's operands are in flight while visit p computes. Returns
+    the updated full (U, V) in the input dtype — every table row is
+    copied through VMEM exactly once per stratum (touched or not),
+    which is the contiguous-traffic model ``dsgd_bytes_per_sweep``
+    prices; every U block and every V block is visited exactly once per
+    stratum, so the outputs are fully written. Loop gather only (the
+    take path is dead on current Mosaic).
+    """
+    if pltpu is None:
+        raise RuntimeError(
+            "jax.experimental.pallas.tpu is unavailable in this jax build; "
+            "the Pallas DSGD kernel cannot run (even interpreted)")
+    k = num_blocks
+    rank = int(U.shape[-1])
+    if U.dtype != V.dtype:
+        raise ValueError(f"U/V dtype mismatch: {U.dtype} vs {V.dtype}")
+    if U.dtype not in (jnp.float32, jnp.bfloat16):
+        raise ValueError(
+            f"factor dtype {U.dtype} unsupported; float32 or bfloat16")
+    half = U.dtype == jnp.bfloat16
+    fac_bytes = 2 if half else 4
+    if int(U.shape[0]) % k or int(V.shape[0]) % k:
+        raise ValueError(
+            f"table rows ({U.shape[0]}, {V.shape[0]}) must be divisible "
+            f"by num_blocks={k}")
+    rpb_u = int(U.shape[0]) // k
+    rpb_v = int(V.shape[0]) // k
+    e = int(idx.shape[-1])
+    if e % minibatch != 0:
+        raise ValueError(f"visit nnz {e} not divisible by mb {minibatch}")
+    n_mb = e // minibatch
+    rows6 = -(-6 * n_mb // 8) * 8  # stream sublanes, f32-tile padded
+    if tuple(idx.shape) != (k * k, 2, e):
+        raise ValueError(f"idx shape {idx.shape} != ({k * k}, 2, {e})")
+    if tuple(streams.shape) != (k * k, rows6, minibatch):
+        raise ValueError(
+            f"streams shape {streams.shape} != "
+            f"({k * k}, {rows6}, {minibatch}) — build the operands with "
+            "build_stratum_operands")
+    # slot buffers are whole VMEM memrefs and the DMA endpoints must
+    # match shapes EXACTLY, so the per-block row counts must land on
+    # sublane-tile boundaries ((8, 128) f32 / (16, 128) bf16 — Mosaic
+    # rounds the scratch memref up otherwise, AOT-measured);
+    # dsgd_train_pallas pads the tables before calling
+    align = 16 if half else 8
+    if (rpb_u % align or rpb_v % align) and not interpret:
+        raise ValueError(
+            f"rows-per-block ({rpb_u}, {rpb_v}) must be multiples of "
+            f"{align} for the {U.dtype} pipelined kernel (DMA tile "
+            "alignment) — pad the tables (dsgd_train_pallas does)")
+    vmem_mb, smem_kb = stratum_pipeline_budget(
+        rpb_u, rpb_v, rank, e, minibatch, fac_bytes)
+    if vmem_mb > 14 and not interpret:
+        raise ValueError(
+            f"~{vmem_mb:.1f} MB of double-buffered VMEM state (2 slot "
+            "slice pairs + 2 slot stream blocks + scratch tiles) exceeds "
+            "the ~14 MB pipelined budget; use more blocks, a smaller "
+            "minibatch, a smaller rank, or bf16 factors "
+            "(factor_dtype='bfloat16')")
+    if smem_kb > 900 and not interpret:
+        raise ValueError(
+            f"~{smem_kb:.0f} KB of double-buffered SMEM row indices "
+            f"(2 slots × 2 × [{e}] int32) exceeds the ~1 MB v5e scoped "
+            "budget; use more blocks (fewer ratings per visit)")
+
+    kernel = functools.partial(
+        _stratum_kernel, lam=lam, mb=minibatch, rank=rank, n_mb=n_mb,
+        k=k, half=half)
+    # every operand stays in HBM; the kernel's manual DMAs slice one
+    # FULL leading-dim plane per visit (the diagonal rotation: U block
+    # p, V block (p+s) mod k, stream/index row s·k+p) — the tables go
+    # in as [k, rpb, r] so those planes are tile-aligned for ANY rpb
+    # (row-range slices of the 2-D layout are not; AOT-measured).
+    # pltpu.ANY, not pl.ANY: with the generic marker XLA allocated the
+    # full output TABLES on the VMEM stack (83 MB — instant
+    # RESOURCE_EXHAUSTED, AOT-measured); the TPU-specific space keeps
+    # unblocked operands in HBM
+    any_spec = pl.BlockSpec(memory_space=pltpu.ANY)
+    store = jnp.bfloat16 if half else jnp.float32
+    scratch = [
+        pltpu.VMEM((rpb_u, rank), store),  # slot-0/1 factor slices
+        pltpu.VMEM((rpb_u, rank), store),
+        pltpu.VMEM((rpb_v, rank), store),
+        pltpu.VMEM((rpb_v, rank), store),
+        pltpu.VMEM((rows6, minibatch), jnp.float32),  # slot streams
+        pltpu.VMEM((rows6, minibatch), jnp.float32),
+        pltpu.SMEM((2, e), jnp.int32),  # slot row indices (u row 0, i 1)
+        pltpu.SMEM((2, e), jnp.int32),
+    ]
+    scratch += ([pltpu.VMEM((rpb_u, rank), jnp.float32),  # f32 work pair
+                 pltpu.VMEM((rpb_v, rank), jnp.float32)] if half else [])
+    scratch += [
+        pltpu.VMEM((minibatch, rank), jnp.float32),  # gathered u
+        pltpu.VMEM((minibatch, rank), jnp.float32),  # gathered v
+        pltpu.VMEM((minibatch, rank), jnp.float32),  # du
+        pltpu.VMEM((minibatch, rank), jnp.float32),  # dv
+        pltpu.SemaphoreType.DMA((2, 4)),  # per-slot fetch semaphores
+        pltpu.SemaphoreType.DMA((2, 2)),  # per-slot flush semaphores
+    ]
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(k, n_mb),
+        in_specs=[any_spec] * 4,
+        out_specs=[any_spec] * 2,
+        scratch_shapes=scratch,
+    )
+    U3, V3 = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((k, rpb_u, rank), U.dtype),
+                   jax.ShapeDtypeStruct((k, rpb_v, rank), V.dtype)],
+        interpret=interpret,
+    )(jnp.asarray(s, jnp.int32).reshape(1),
+      jnp.asarray(lr, jnp.float32).reshape(1),
+      idx, streams,
+      U.reshape(k, rpb_u, rank), V.reshape(k, rpb_v, rank))
+    return U3.reshape(U.shape), V3.reshape(V.shape)
+
+
+def build_stratum_operands(su, si, sv, sw, icu, icv, omega_u, omega_v,
+                           *, num_blocks: int, rpb_u: int, rpb_v: int,
+                           minibatch: int):
+    """The visit-major operand layout of ``pallas_stratum_sweep`` from
+    the standard stratum-major arrays: block-LOCAL clamped row indices
+    ``[k², 2e]`` and the stacked per-entry streams ``[k², 6·n_mb, mb]``.
+    Built once per jitted training call (outside the stratum scan), so
+    per-sweep HBM traffic is exactly the slices + one stream read."""
+    k = num_blocks
+    b = int(su.shape[-1])
+    n_mb = b // minibatch
+    p_arr = jnp.arange(k, dtype=jnp.int32)
+    q_arr = (p_arr[None, :] + jnp.arange(k, dtype=jnp.int32)[:, None]) % k
+    # clamp: weight-0 PADDING entries carry global row 0 → negative local
+    # index for blocks p>0; their deltas are zero but a negative dynamic
+    # store is unspecified in Mosaic (same rule as dsgd_train_pallas)
+    ur_l = jnp.maximum(su - (p_arr * rpb_u)[None, :, None], 0)
+    ir_l = jnp.maximum(si - (q_arr * rpb_v)[:, :, None], 0)
+    idx = jnp.stack(
+        [ur_l.reshape(k * k, b), ir_l.reshape(k * k, b)],
+        axis=1).astype(jnp.int32)
+    ou_e = jnp.asarray(omega_u, jnp.float32)[su]
+    ov_e = jnp.asarray(omega_v, jnp.float32)[si]
+    streams = jnp.stack(
+        [jnp.asarray(a, jnp.float32) for a in
+         (sv, sw, icu, icv, ou_e, ov_e)], axis=2)  # [k, k, 6, b]
+    streams = streams.reshape(k * k, 6 * n_mb, minibatch)
+    # pad the sublane dim to the f32 tile multiple: the VMEM slot buffer
+    # is rounded up to whole (8, 128) tiles as a memref, and a manual
+    # DMA needs both endpoint shapes EQUAL (AOT-measured "DMA source and
+    # target shape mismatch")
+    rows6 = -(-6 * n_mb // 8) * 8
+    if rows6 != 6 * n_mb:
+        streams = jnp.pad(
+            streams, ((0, 0), (0, rows6 - 6 * n_mb), (0, 0)))
+    return idx, streams
 
 
 @functools.partial(jax.jit, static_argnames=("rank", "mb", "rpb_u",
@@ -407,8 +864,8 @@ def _probe_inputs(key, rank: int, mb: int, rpb_u: int, rpb_v: int,
             ou, ov, U, V)
 
 
-def probe_variants(rank: int = 128, mb: int = 2048, rpb_u: int = 10160,
-                   rpb_v: int = 3696, nnz: int = 92160, reps: int = 5,
+def probe_variants(rank: int = 128, mb: int = 2048, rpb_u: int = 5080,
+                   rpb_v: int = 1848, nnz: int = 24576, reps: int = 5,
                    seed: int = 0, sort: bool = False,
                    interpret: bool | None = None,
                    sweeps: int = 1,
@@ -421,6 +878,9 @@ def probe_variants(rank: int = 128, mb: int = 2048, rpb_u: int = 10160,
     experiment runs whenever a real chip is reachable — a Mosaic lowering
     failure is recorded as a measured negative, not hidden. All inputs
     are generated on device: only the PRNG key crosses the link.
+    Defaults model one ML-25M block visit at k=32 — the production
+    operating point since the k=16 geometry OOM'd under this jax's 2×
+    stream buffering (docs/MOSAIC_AOT.json).
 
     ``sweeps`` repeats the block sweep INSIDE one jitted call
     (fori_loop-carried factors). On the tunneled bench device a single
@@ -518,7 +978,7 @@ def probe_variants(rank: int = 128, mb: int = 2048, rpb_u: int = 10160,
 
 @functools.partial(jax.jit, static_argnames=(
     "lr", "lam", "minibatch", "num_blocks", "iterations", "gather",
-    "interpret", "schedule"))
+    "interpret", "schedule", "pipeline"))
 def dsgd_train_pallas(
     U: jax.Array,  # f32[k*rpb_u, r]
     V: jax.Array,  # f32[k*rpb_v, r]
@@ -540,11 +1000,22 @@ def dsgd_train_pallas(
     interpret: bool = False,
     schedule=None,
     t0: jax.Array | int = 0,
+    pipeline: bool | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Full DSGD training through the VMEM-staged Pallas kernel — the
     drop-in twin of ``ops.sgd.dsgd_train`` (same stratum-major layout from
     ``data.blocking`` / ``data.device_blocking``), so a measured kernel win
     on hardware can be exercised on the WHOLE training loop immediately.
+
+    ``pipeline`` selects the double-buffered stratum kernel
+    (``pallas_stratum_sweep``: one pallas_call per stratum, visit p+1's
+    slices/streams in flight while visit p computes). ``None`` (default)
+    auto-selects it whenever gather == "loop" and the doubled buffers
+    fit the VMEM/SMEM budgets, falling back to the sequential per-block
+    path otherwise; ``True`` requires it (budget violations raise);
+    ``False`` forces the per-block path. Both orders are numerically
+    IDENTICAL — pinned by tests — because strata are processed in the
+    same p = 0..k−1 visit order; only the copy/compute overlap differs.
 
     Visit order: for each sweep, strata s = 0..k-1; within a stratum the
     k disjoint blocks run sequentially p = 0..k-1. Because the blocked
@@ -575,6 +1046,65 @@ def dsgd_train_pallas(
             "data.device_blocking layouts")
     rpb_u = int(U.shape[0]) // k
     rpb_v = int(V.shape[0]) // k
+
+    e_blk = int(su.shape[-1])
+    if pipeline is None:
+        fac_bytes = 2 if U.dtype == jnp.bfloat16 else 4
+        vmem_mb, smem_kb = stratum_pipeline_budget(
+            rpb_u, rpb_v, rank, e_blk, minibatch, fac_bytes)
+        pipeline = (gather == "loop"
+                    and (interpret or (vmem_mb <= 14 and smem_kb <= 900)))
+    if pipeline:
+        if gather != "loop":
+            raise ValueError(
+                "pipeline=True supports gather='loop' only (the take "
+                "path is dead on current Mosaic)")
+        idx, streams = build_stratum_operands(
+            su, si, sv, sw, icu, icv, omega_u, omega_v,
+            num_blocks=k, rpb_u=rpb_u, rpb_v=rpb_v, minibatch=minibatch)
+        # pad each block's rows up to the sublane-tile multiple (8 f32 /
+        # 16 bf16): the kernel's DMA endpoints must match the VMEM slot
+        # memref exactly, and Mosaic rounds that memref up to whole
+        # tiles. Pad rows are streamed through VMEM untouched (local
+        # indices never reach them) and stripped after the scan — once
+        # per jitted call, not per sweep.
+        align = 16 if U.dtype == jnp.bfloat16 else 8
+        rpb_u2 = -(-rpb_u // align) * align
+        rpb_v2 = -(-rpb_v // align) * align
+
+        def pad_blocks(T, rpb, rpb2):
+            if rpb2 == rpb:
+                return T
+            return jnp.pad(T.reshape(k, rpb, rank),
+                           ((0, 0), (0, rpb2 - rpb),
+                            (0, 0))).reshape(k * rpb2, rank)
+
+        Up = pad_blocks(U, rpb_u, rpb_u2)
+        Vp = pad_blocks(V, rpb_v, rpb_v2)
+
+        def stratum(carry, sv_idx):
+            U, V = carry
+            s, v_idx = sv_idx[0], sv_idx[1]
+            t = v_idx // k + 1 + jnp.asarray(t0, jnp.int32)
+            lr_t = (jnp.float32(lr) if schedule is None
+                    else schedule(jnp.float32(lr), t))
+            U, V = pallas_stratum_sweep(
+                U, V, idx, streams, s, lr=lr_t, lam=lam,
+                minibatch=minibatch, num_blocks=k, interpret=interpret)
+            return (U, V), None
+
+        ss = jnp.tile(jnp.arange(k, dtype=jnp.int32), iterations)
+        vs = jnp.arange(iterations * k, dtype=jnp.int32)
+        (Up, Vp), _ = jax.lax.scan(
+            stratum, (Up, Vp), jnp.stack([ss, vs], axis=1))
+
+        def strip(T, rpb, rpb2):
+            if rpb2 == rpb:
+                return T
+            return T.reshape(k, rpb2, rank)[:, :rpb, :].reshape(
+                k * rpb, rank)
+
+        return strip(Up, rpb_u, rpb_u2), strip(Vp, rpb_v, rpb_v2)
 
     def visit(carry, sp):
         U, V = carry

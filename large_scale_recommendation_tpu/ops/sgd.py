@@ -27,6 +27,34 @@ import jax
 import jax.numpy as jnp
 
 
+def dsgd_bytes_per_sweep(nnz: int, rank: int, *, kernel: str = "xla",
+                         num_blocks: int = 1, rows_u: int = 0,
+                         rows_v: int = 0, factor_bytes: int = 4) -> int:
+    """Bytes of HBM traffic one full DSGD sweep moves, per kernel.
+
+    The shared roofline model behind every ``effective_hbm_gbs`` number
+    (bench.py headline, the probe variants, and the ``train_hbm_gbs``
+    obs gauge) — one copy so the accounting cannot drift between them.
+
+    - ``kernel="xla"`` (the gather path): every rating pays ~4 row
+      transactions (read+write of a u row and a v row) of
+      ``rank × factor_bytes`` plus ~16 B of COO stream. This is the
+      historical bench model (4·rank·4 + 16 at f32).
+    - ``kernel="pallas"`` (the VMEM-staged path): factor traffic is
+      CONTIGUOUS — each of the k strata reads+writes every factor row
+      once per sweep (k² block visits × rows-per-block), plus the
+      per-entry streams (2 int32 rows + 6 f32
+      vals/w/icu/icv/ωu/ωv ⇒ 32 B/rating).
+    """
+    if kernel == "pallas":
+        if not rows_u or not rows_v:
+            raise ValueError(
+                "pallas traffic model needs rows_u/rows_v (table heights)")
+        factor = num_blocks * (rows_u + rows_v) * rank * factor_bytes * 2
+        return int(factor + nnz * 32)
+    return int(nnz * (4 * rank * factor_bytes + 16))
+
+
 def sgd_minibatch_update(
     U: jax.Array,
     V: jax.Array,
@@ -185,7 +213,21 @@ def dsgd_train(
 
     On one device the k blocks of a stratum are disjoint in both users and
     items, so the whole stratum is swept as one flat block.
+
+    bf16 factor storage (ISSUE 6, the ALX recipe): ``U``/``V`` may arrive
+    as ``bfloat16`` tables — the whole sweep then runs on ONE f32 upcast
+    of each table (gradient accumulation and duplicate-row scatter
+    semantics stay exact f32) and the result is rounded back to the
+    storage dtype on exit, all inside this jitted computation. The
+    tables at rest (HBM between segments, checkpoints, host↔device
+    transfers) are half-width; XLA cannot express the per-block-visit
+    staging the Pallas kernel uses, so this is the fallback's honest
+    share of the optimization.
     """
+    store_dtype = U.dtype
+    if store_dtype != jnp.float32:
+        U = U.astype(jnp.float32)
+        V = V.astype(jnp.float32)
     k = num_blocks
     b = su.shape[-1]
     flat = (k, k * b)
@@ -211,6 +253,9 @@ def dsgd_train(
     (U, V), _ = jax.lax.scan(
         step, (U, V), jnp.arange(iterations * k, dtype=jnp.int32)
     )
+    if store_dtype != jnp.float32:
+        U = U.astype(store_dtype)
+        V = V.astype(store_dtype)
     return U, V
 
 
@@ -317,8 +362,10 @@ def pad_minibatches(
 def predict_rows(U: jax.Array, V: jax.Array, u_rows: jax.Array,
                  i_rows: jax.Array) -> jax.Array:
     """Batched score: r̂ = u·v. ≙ ``blas.ddot`` in predict
-    (MatrixFactorization.scala:258-265), as one einsum."""
-    return jnp.einsum("bk,bk->b", U[u_rows], V[i_rows])
+    (MatrixFactorization.scala:258-265), as one einsum. Gathered rows
+    are upcast so bf16-stored tables score with f32 dot products."""
+    return jnp.einsum("bk,bk->b", U[u_rows].astype(jnp.float32),
+                      V[i_rows].astype(jnp.float32))
 
 
 @jax.jit
@@ -335,8 +382,8 @@ def empirical_risk_rows(
     residual² + λ(‖u‖² + ‖v‖²), summed
     (MatrixFactorization.scala:133-192 — the norms are added once per
     *rating occurrence*, not once per factor)."""
-    u = U[u_rows]
-    v = V[i_rows]
+    u = U[u_rows].astype(jnp.float32)
+    v = V[i_rows].astype(jnp.float32)
     res = values - jnp.einsum("bk,bk->b", u, v)
     per_point = res * res + lambda_ * (
         jnp.sum(u * u, axis=-1) + jnp.sum(v * v, axis=-1)

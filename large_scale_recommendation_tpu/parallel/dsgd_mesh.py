@@ -109,10 +109,12 @@ def build_mesh_dsgd_step(
         mesh=mesh,
         in_specs=(spec,) * n_sharded + (P(),),
         out_specs=(spec, spec),
-        # the Pallas interpreter's internal scan drops varying-axis
-        # metadata on index arrays (jax hlo_interpreter.py suggests this
-        # exact workaround); the XLA route keeps the checker on
-        check_vma=kernel != "pallas" or not pallas_interpret,
+        # the replication checker has no rule for pallas_call at all on
+        # this jax ("No replication rule for pallas_call" — AOT-measured,
+        # docs/MOSAIC_AOT.json), and the Pallas interpreter's internal
+        # scan additionally drops varying-axis metadata on index arrays;
+        # the XLA route keeps the checker on
+        check_vma=kernel != "pallas",
     )
     def run(U_l, V_l, ru_l, ri_l, rv_l, rw_l, ou_l, ov_l, *rest):
         # shard_map gives [1, k, b] for the device-major strata; drop the
@@ -123,6 +125,24 @@ def build_mesh_dsgd_step(
             icu, icv, t0 = rest[0][0], rest[1][0], rest[2]
         else:
             icu, icv, t0 = None, None, rest[0]
+
+        # bf16 factor shards on the XLA route: ONE f32 upcast per jitted
+        # segment (this whole scan), rounded back on exit — the same
+        # cadence as ops.sgd.dsgd_train, so gradient accumulation stays
+        # exact across every sweep of the segment. Rounding per block
+        # sweep instead stalls convergence at small learning rates (the
+        # update magnitude drops below bf16's ~8-bit mantissa and every
+        # sweep's work is rounded away — measured: mesh bf16 RMSE froze
+        # while f32 kept converging). The in-segment ppermute therefore
+        # carries f32 shards; half-width applies AT REST (HBM between
+        # segments, checkpoints, host↔device). The Pallas route keeps
+        # store-dtype tables instead: per-visit VMEM rounding is
+        # intrinsic to its halved-HBM-DMA design (matching its
+        # single-device twin dsgd_train_pallas).
+        fdt = U_l.dtype
+        if fdt == jnp.bfloat16 and kernel != "pallas":
+            U_l = U_l.astype(jnp.float32)
+            V_l = V_l.astype(jnp.float32)
 
         def step(carry, idx):
             U, V, ov = carry
@@ -165,6 +185,9 @@ def build_mesh_dsgd_step(
             step, (U_l, V_l, ov_l),
             jnp.arange(iterations * k, dtype=jnp.int32),
         )
+        if fdt == jnp.bfloat16 and kernel != "pallas":
+            U_l = U_l.astype(fdt)
+            V_l = V_l.astype(fdt)
         return U_l, V_l
 
     return jax.jit(run)
@@ -186,6 +209,10 @@ class MeshDSGDConfig:
     precompute_collisions: bool = True  # see DSGDConfig
     minibatch_sort: str | None = None  # see DSGDConfig
     kernel: str = "xla"  # "xla" | "pallas" — see DSGDConfig.kernel
+    # "float32" | "bfloat16" — see DSGDConfig.factor_dtype: half-width
+    # factor shards at rest (HBM, checkpoints, the ppermute ring) with
+    # f32 accumulation inside both kernels
+    factor_dtype: str = "float32"
 
 
 class MeshDSGD:
@@ -358,6 +385,13 @@ class MeshDSGD:
         cfg = self.config
         k = self.num_blocks
         done = 0
+        if cfg.factor_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"factor_dtype {cfg.factor_dtype!r} unsupported; "
+                "float32 or bfloat16")
+        fdt = jnp.dtype(cfg.factor_dtype)
+        U = jnp.asarray(U).astype(fdt)
+        V = jnp.asarray(V).astype(fdt)
 
         shard = block_sharding(self.mesh)
         put = lambda x: jax.device_put(jnp.asarray(x), shard)
@@ -409,5 +443,9 @@ class MeshDSGD:
                     done, {"U": U, "V": V},
                     {"kind": kind, "iterations": cfg.iterations},
                 )
-        timer.finish(n_ratings)
+        timer.finish(n_ratings, bytes_per_iteration=(
+            None if n_ratings is None else sgd_ops.dsgd_bytes_per_sweep(
+                n_ratings, int(np.shape(U)[-1]), kernel=cfg.kernel,
+                num_blocks=k, rows_u=int(np.shape(U)[0]),
+                rows_v=int(np.shape(V)[0]), factor_bytes=fdt.itemsize)))
         return U, V

@@ -32,6 +32,32 @@ import tempfile
 
 import numpy as np
 
+# -- non-native dtype round-tripping ------------------------------------------
+# ``np.savez`` silently degrades ml_dtypes arrays (bfloat16 → a void
+# "|V2" dtype on reload — measured, not assumed), so bf16 factor tables
+# (DSGDConfig.factor_dtype="bfloat16", ISSUE 6) are stored as a uint16
+# bit-view plus a dtype tag and re-viewed on restore. One encode/decode
+# pair shared by the monolithic and sharded managers.
+
+_DTYPE_ENCODINGS = {"bfloat16": np.uint16}
+
+
+def _encode_array(arr: np.ndarray) -> tuple[np.ndarray, str | None]:
+    """(savez-safe array, dtype-tag-or-None)."""
+    name = arr.dtype.name
+    view_as = _DTYPE_ENCODINGS.get(name)
+    if view_as is None:
+        return arr, None
+    return arr.view(view_as), name
+
+
+def _decode_array(arr: np.ndarray, tag: str | None) -> np.ndarray:
+    if not tag:
+        return arr
+    import ml_dtypes  # jax dependency — always present
+
+    return arr.view(np.dtype(getattr(ml_dtypes, tag)))
+
 
 @dataclasses.dataclass(frozen=True)
 class Checkpoint:
@@ -64,11 +90,24 @@ class CheckpointManager:
 
     def save(self, step: int, arrays: dict[str, np.ndarray],
              meta: dict | None = None) -> str:
-        """Atomic snapshot: tmp file + rename, then retention sweep."""
+        """Atomic snapshot: tmp file + rename, then retention sweep.
+
+        Non-native dtypes (bfloat16 factor tables) are stored as bit
+        views with a dtype tag in the meta and re-viewed on restore —
+        ``factor_dtype`` round-trips exactly."""
         path = os.path.join(self.directory, f"ckpt_{step}.npz")
-        payload = {k: np.asarray(v) for k, v in arrays.items()}
+        payload = {}
+        dtype_tags: dict[str, str] = {}
+        for k, v in arrays.items():
+            enc, tag = _encode_array(np.asarray(v))
+            payload[k] = enc
+            if tag:
+                dtype_tags[k] = tag
+        meta = dict(meta or {})
+        if dtype_tags:
+            meta["__dtypes__"] = dtype_tags
         payload["__meta__"] = np.frombuffer(
-            json.dumps(meta or {}).encode(), dtype=np.uint8
+            json.dumps(meta).encode(), dtype=np.uint8
         )
         fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".tmp")
         try:
@@ -112,6 +151,10 @@ class CheckpointManager:
             arrays = {k: z[k] for k in z.files if k != "__meta__"}
             meta = json.loads(bytes(z["__meta__"].tobytes()).decode()) \
                 if "__meta__" in z.files else {}
+        tags = meta.pop("__dtypes__", {})
+        if tags:
+            arrays = {k: _decode_array(v, tags.get(k))
+                      for k, v in arrays.items()}
         return Checkpoint(step=step, arrays=arrays, meta=meta)
 
 
@@ -144,7 +187,11 @@ def restore_segment_state(manager: CheckpointManager, kind: str, U, V):
             "checkpoint shape mismatch — resumed fit must use the same "
             "ratings, seed, rank and block count"
         )
-    return jnp.asarray(ck["U"]), jnp.asarray(ck["V"]), latest
+    # cast to the resuming run's factor dtype: a bf16 snapshot resumed
+    # at f32 (or vice versa) is semantically the same model — the cast
+    # is the same rounding the storage dtype already applied
+    return (jnp.asarray(ck["U"]).astype(U.dtype),
+            jnp.asarray(ck["V"]).astype(V.dtype), latest)
 
 
 # -- sharded (mesh / multi-host) checkpoints ---------------------------------
@@ -212,7 +259,9 @@ class ShardedCheckpointManager:
             payload[f"{key}__lens"] = np.asarray(
                 [len(pieces[s]) for s in starts], np.int64)
             for j, s in enumerate(starts):
-                payload[f"{key}__p{j}"] = pieces[s]
+                # bit-view non-native dtypes (bf16) — the manifest's
+                # per-array dtype string drives the re-view on restore
+                payload[f"{key}__p{j}"], _ = _encode_array(pieces[s])
         shard_name = f"ckpt_{step}.shard{pid}of{nproc}.npz"
         fd, tmp = tempfile.mkstemp(dir=self.directory, suffix=".tmp")
         try:
@@ -342,6 +391,8 @@ class ShardedCheckpointManager:
         def overlaps(lo: int, hi: int) -> bool:
             return any(lo < b and a < hi for a, b in mine)
 
+        saved_tag = (want["dtype"]
+                     if want["dtype"] in _DTYPE_ENCODINGS else None)
         pieces: list[tuple[int, np.ndarray]] = []
         for name in m["shards"]:
             with np.load(os.path.join(self.directory, name)) as z:
@@ -351,7 +402,8 @@ class ShardedCheckpointManager:
                 lens = z[f"{key}__lens"]
                 for j, (s, ln) in enumerate(zip(starts, lens)):
                     if overlaps(int(s), int(s) + int(ln)):
-                        pieces.append((int(s), z[f"{key}__p{j}"]))
+                        pieces.append((int(s), _decode_array(
+                            z[f"{key}__p{j}"], saved_tag)))
         pieces.sort(key=lambda p: p[0])
 
         def cb(index):

@@ -51,7 +51,21 @@ DEFAULT_KEYS: dict[str, float] = {
     "online_ratings_per_s_steady": 30.0,
     "ps_ratings_per_s": 30.0,
     "als_rank32_rows_per_s": 30.0,
+    # achieved-bandwidth gate (ISSUE 6): the DSGD hot loop's whole perf
+    # story is effective HBM throughput — a regression here is a kernel
+    # regression even when ratings/s noise hides it
+    "effective_hbm_gbs": 30.0,
+    "pct_of_hbm_peak": 30.0,
 }
+
+# keys where HIGHER is explicitly better (throughputs, achieved
+# bandwidth). These win over any accidental DEFAULT_LOWER substring
+# match — a throughput key must NEVER be gated as lower-is-better, and
+# before this list only ``*_wall_s``-style keys had an explicit rule
+# while every rate relied on the absence of a pattern collision.
+DEFAULT_HIGHER = ("_ratings_per_s", "_rows_per_s", "_users_per_s",
+                  "_per_s", "effective_hbm_gbs", "pct_of_hbm_peak",
+                  "_hbm_gbs", "_tflops", "_mbps")
 
 # keys where LOWER is better (walls, latencies) when watched explicitly
 DEFAULT_LOWER = ("_wall_s", "_ms_", "time_to_", "_s_p")
@@ -122,7 +136,11 @@ def find_rounds(directory: str = REPO) -> list[str]:
 
 
 def is_lower_better(key: str, lower_flags: set[str]) -> bool:
-    return key in lower_flags or any(pat in key for pat in DEFAULT_LOWER)
+    if key in lower_flags:
+        return True  # an explicit --lower flag always wins
+    if any(pat in key for pat in DEFAULT_HIGHER):
+        return False  # rates/bandwidths are higher-is-better, full stop
+    return any(pat in key for pat in DEFAULT_LOWER)
 
 
 def compare(baseline: dict[str, float], current: dict[str, float],

@@ -21,15 +21,18 @@ A Mosaic lowering failure is itself a result: it prints as
 
 Usage:
     python scripts/pallas_probe.py                    # current device
-    PROBE_RANK=64 PROBE_MB=4096 python scripts/pallas_probe.py
+    PROBE_RANK=64 PROBE_MB=1024 python scripts/pallas_probe.py
     PROBE_CPU=1 python scripts/pallas_probe.py        # interpret fallback
 
-Defaults model one ML-25M block visit at k=16 (rpb_u 10160, rpb_v 3696,
-~92K ratings) — VMEM-sized for v5e at rank 128.
+Defaults model one ML-25M block visit at k=32 (rpb_u 5080, rpb_v 1848,
+~24K ratings) — the production operating point since the k=16 visit
+OOM'd under this jax's 2× stream buffering (docs/MOSAIC_AOT.json);
+VMEM-sized for v5e at rank 128.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import sys
 import time
@@ -48,10 +51,10 @@ def main() -> None:
     dev = jax.devices()[0]
     on_tpu = dev.platform == "tpu"
     rank = int(os.environ.get("PROBE_RANK", 128))
-    mb = int(os.environ.get("PROBE_MB", 4096))
-    rpb_u = int(os.environ.get("PROBE_RPB_U", 10160))
-    rpb_v = int(os.environ.get("PROBE_RPB_V", 3696))
-    e = int(os.environ.get("PROBE_NNZ", 92160))
+    mb = int(os.environ.get("PROBE_MB", 2048))
+    rpb_u = int(os.environ.get("PROBE_RPB_U", 5080))
+    rpb_v = int(os.environ.get("PROBE_RPB_V", 1848))
+    e = int(os.environ.get("PROBE_NNZ", 24576))
     e -= e % mb
     reps = int(os.environ.get("PROBE_REPS", 5))
     lr, lam = 0.1, 0.1
@@ -59,17 +62,38 @@ def main() -> None:
     print(f"# device={dev} rank={rank} mb={mb} rpb_u={rpb_u} "
           f"rpb_v={rpb_v} nnz={e}", flush=True)
 
+    from large_scale_recommendation_tpu.ops import sgd as sgd_ops
     from large_scale_recommendation_tpu.ops.pallas_sgd import probe_variants
 
     res = probe_variants(rank=rank, mb=mb, rpb_u=rpb_u, rpb_v=rpb_v,
                          nnz=e, reps=reps,
                          sort=os.environ.get("PROBE_SORT") == "1",
                          interpret=not on_tpu)
+    summary = {
+        "device": str(dev), "tpu": on_tpu, "rank": rank, "mb": mb,
+        "rpb_u": rpb_u, "rpb_v": rpb_v, "nnz": e, "reps": reps,
+    }
     for label, val in res.items():
         if isinstance(val, str):
             print(f"{label:12s} {val}", flush=True)
+            summary[label] = val
         else:
-            print(f"{label:12s} ratings_per_s={val:14.0f}", flush=True)
+            kern = "pallas" if label.startswith("pallas") else "xla"
+            bpv = sgd_ops.dsgd_bytes_per_sweep(
+                e, rank, kernel=kern, num_blocks=1,
+                rows_u=rpb_u, rows_v=rpb_v)
+            gbs = round(val / e * bpv / 1e9, 1)
+            print(f"{label:12s} ratings_per_s={val:14.0f} "
+                  f"effective_hbm_gbs={gbs:8.1f}", flush=True)
+            summary[f"{label}_ratings_per_s"] = val
+            summary[f"{label}_effective_hbm_gbs"] = gbs
+
+    # machine-readable contract (same as bench.py::_emit_final): flush
+    # stderr FIRST so a 2>&1-merging wrapper still sees the JSON summary
+    # as the genuinely last line, diffable across rounds like BENCH
+    # artifacts
+    sys.stderr.flush()
+    print(json.dumps(summary), flush=True)
 
 
 if __name__ == "__main__":

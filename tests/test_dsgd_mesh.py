@@ -147,6 +147,33 @@ class TestMeshDSGD:
         np.testing.assert_allclose(np.asarray(mm.V), np.asarray(sm.V),
                                    rtol=2e-3, atol=2e-4)
 
+    def test_bf16_tracks_f32_at_small_lr(self, gen):
+        """factor_dtype='bfloat16' on the mesh XLA route must CONVERGE
+        like f32, not just run: the route upcasts once per jitted
+        segment (the whole scan), so gradient accumulation is exact
+        across every sweep. The regression this pins: rounding to bf16
+        after every block sweep silently swallows small-lr updates
+        (below bf16's ~8-bit mantissa) — measured as RMSE frozen at the
+        init plateau while f32 kept converging. Small lr on purpose."""
+        train = gen.generate(10000)
+        test = gen.generate(2000)
+        mesh = make_block_mesh(4)
+
+        def run(dt):
+            cfg = MeshDSGDConfig(num_factors=8, lambda_=0.02,
+                                 iterations=12, learning_rate=0.02,
+                                 lr_schedule="constant", seed=0,
+                                 minibatch_size=256, init_scale=0.3,
+                                 factor_dtype=dt)
+            return MeshDSGD(cfg, mesh=mesh).fit(train)
+
+        mf, mh = run("float32"), run("bfloat16")
+        assert str(mh.U.dtype) == "bfloat16"
+        rf, rh = mf.rmse(test), mh.rmse(test)
+        # segment-cadence rounding: one bf16 round on exit — the RMSE
+        # gap is quantization noise, not a convergence gap
+        assert abs(rf - rh) < 0.02, (rf, rh)
+
     def test_convergence_8_devices(self):
         # fresh generator: the shared module fixture's RNG position depends
         # on which tests ran before (order-dependent data)

@@ -96,6 +96,36 @@ class TestCompare:
                        {"dsgd_train_wall_s": 10.0})
         assert rows[0]["verdict"] == "ok"
 
+    def test_higher_is_better_keys_explicit(self):
+        """Throughputs and achieved bandwidth (the ISSUE-6 gate keys) are
+        EXPLICITLY higher-is-better: a drop regresses, growth never does —
+        even for keys that also contain a lower-better substring."""
+        from scripts.bench_regress import is_lower_better
+
+        for key in ("effective_hbm_gbs", "pct_of_hbm_peak",
+                    "online_ratings_per_s", "als_rank32_rows_per_s",
+                    "serving_users_per_s", "train_hbm_gbs",
+                    "kernel_pallas_loop_effective_hbm_gbs"):
+            assert not is_lower_better(key, set()), key
+            rows = compare({key: 100.0}, {key: 60.0}, {key: 10.0})
+            assert rows[0]["verdict"] == "REGRESSION", key
+            rows = compare({key: 100.0}, {key: 300.0}, {key: 10.0})
+            assert rows[0]["verdict"] == "ok", key
+        # the explicit rule wins over an accidental DEFAULT_LOWER
+        # substring collision ("time_to_" is lower-better, but a rate
+        # named around it must stay higher-better)
+        assert not is_lower_better("time_to_target_ratings_per_s", set())
+        # an explicit --lower flag still wins over everything
+        assert is_lower_better("effective_hbm_gbs",
+                               {"effective_hbm_gbs"})
+
+    def test_hbm_gate_keys_in_default_watch_set(self):
+        """The ISSUE-6 bandwidth keys are gated by DEFAULT (no flags)."""
+        from scripts.bench_regress import DEFAULT_KEYS
+
+        assert "effective_hbm_gbs" in DEFAULT_KEYS
+        assert "pct_of_hbm_peak" in DEFAULT_KEYS
+
 
 class TestGateEndToEnd:
     def _write(self, tmp_path, name, value, extra=None):
