@@ -42,11 +42,11 @@ def main() -> None:
 
     force_cpu(n_devices=N_LOCAL_DEVICES)
 
-    from large_scale_recommendation_tpu.parallel.distributed import (
+    from large_scale_recommendation_tpu.parallel import (
         DistributedConfig,
-        initialize_distributed,
+        Partitioner,
         host_rating_shard,
-        make_global_array,
+        initialize_distributed,
     )
 
     cfg = DistributedConfig.from_env()
@@ -55,14 +55,16 @@ def main() -> None:
     import jax
     import jax.numpy as jnp
     import numpy as np
-    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
     pid = jax.process_index()
     nproc = jax.process_count()
     assert multi == (nproc > 1)
-    devices = np.asarray(jax.devices())  # global, all processes
-    k = len(devices)
-    mesh = Mesh(devices, ("blocks",))
+    # ONE partitioner over the GLOBAL device set: every sharding below —
+    # training strata, factor tables, the proof-of-tiling counts, the
+    # checkpoint re-shard — resolves through its logical-axis rules
+    # table; the identical construction runs single-process on a laptop
+    part = Partitioner()
+    k = part.num_blocks
     print(f"[p{pid}] {nproc} processes, global devices: {k}", flush=True)
 
     # -- per-host ingest (every host range-reads the same seeded synthetic
@@ -79,12 +81,10 @@ def main() -> None:
     mu, mi, mv = host_rating_shard(ru, ri, rv, pid, nproc)
 
     # cross-process sum proves the shards tile the dataset exactly
-    spec = P("blocks")
-    counts = make_global_array(
-        np.full(k, len(mu) / N_LOCAL_DEVICES, np.float32), mesh, spec
-    )
+    counts = part.make_global_array(
+        np.full(k, len(mu) / N_LOCAL_DEVICES, np.float32), "ratings")
     total = jax.jit(
-        lambda c: jnp.sum(c), out_shardings=NamedSharding(mesh, P())
+        lambda c: jnp.sum(c), out_shardings=part.replicated()
     )(counts)
     # each process wrote its count spread over its local shard entries
     print(f"[p{pid}] local={len(mu)}", flush=True)
@@ -109,20 +109,20 @@ def main() -> None:
     U0, V0 = DSGD(DSGDConfig(num_factors=8, seed=0, init_scale=0.3)
                   )._init_factors(problem)
 
-    ga = lambda x: make_global_array(np.asarray(x), mesh, spec)
-    U = ga(U0)
-    V = ga(V0)
-    args = tuple(ga(x) for x in (sru, sri, srv, srw))
-    ou = ga(problem.users.omega)
-    ov = ga(problem.items.omega)
+    U = part.make_global_array(np.asarray(U0), "users", "rank")
+    V = part.make_global_array(np.asarray(V0), "items", "rank")
+    args = tuple(part.make_global_array(x, "ratings")
+                 for x in (sru, sri, srv, srw))
+    ou = part.make_global_array(problem.users.omega, "users")
+    ov = part.make_global_array(problem.items.omega, "items")
 
     updater = RegularizedSGDUpdater(learning_rate=0.1, lambda_=0.01,
                                     schedule=constant_lr)
-    step = build_mesh_dsgd_step(mesh, updater, mb, k, iterations=20)
+    step = build_mesh_dsgd_step(part, updater, mb, k, iterations=20)
     U, V = step(U, V, *args, ou, ov, jnp.asarray(0, jnp.int32))
 
     # gather the trained tables to every host for scoring
-    rep = NamedSharding(mesh, P())
+    rep = part.replicated()
     Uh = np.asarray(jax.jit(lambda x: x, out_shardings=rep)(U))
     Vh = np.asarray(jax.jit(lambda x: x, out_shardings=rep)(V))
 
@@ -157,9 +157,9 @@ def main() -> None:
         [a, np.zeros(n_pad - len(a), a.dtype)])
     g = global_device_blocked(
         pad1(mu), pad1(mi), pad1(mv.astype(np.float32)), wz,
-        400, 200, mesh, minibatch_multiple=mb, seed=0, rank=8,
+        400, 200, part, minibatch_multiple=mb, seed=0, rank=8,
         init_scale=0.3)
-    gstep = build_mesh_dsgd_step(mesh, updater, mb, k, iterations=20,
+    gstep = build_mesh_dsgd_step(part, updater, mb, k, iterations=20,
                                  with_inv=True)
     Ug, Vg = gstep(g.U, g.V, g.ru, g.ri, g.rv, g.rw, g.omega_u, g.omega_v,
                    g.icu, g.icv, jnp.asarray(0, jnp.int32))
@@ -186,7 +186,7 @@ def main() -> None:
         )
 
         mgr = ShardedCheckpointManager(ckdir)
-        half = build_mesh_dsgd_step(mesh, updater, mb, k, iterations=10,
+        half = build_mesh_dsgd_step(part, updater, mb, k, iterations=10,
                                     with_inv=True)
         Us, Vs = half(g.U, g.V, g.ru, g.ri, g.rv, g.rw, g.omega_u,
                       g.omega_v, g.icu, g.icv, jnp.asarray(0, jnp.int32))
@@ -194,7 +194,8 @@ def main() -> None:
         mgr.save(10, {"U": Us, "V": Vs}, {"kind": "demo"})
         # both processes must finish writing before anyone restores
         multihost_utils.sync_global_devices("sharded-ckpt-written")
-        Ur, Vr, done = restore_segment_state_sharded(mgr, "demo", g.U, g.V)
+        Ur, Vr, done = restore_segment_state_sharded(mgr, "demo", g.U, g.V,
+                                                     partitioner=part)
         assert done == 10
         Us2, Vs2 = half(Ur, Vr, g.ru, g.ri, g.rv, g.rw, g.omega_u,
                         g.omega_v, g.icu, g.icv,
@@ -213,7 +214,7 @@ def main() -> None:
 
     acfg = ALSConfig(num_factors=8, iterations=3, lambda_=0.02,
                      reg_mode="als_wr", seed=0)
-    mals = MeshALS(acfg, mesh=mesh).fit(ratings)
+    mals = MeshALS(acfg, partitioner=part).fit(ratings)
     Uma = np.asarray(jax.jit(lambda x: x, out_shardings=rep)(mals.U))
     Vma = np.asarray(jax.jit(lambda x: x, out_shardings=rep)(mals.V))
     armse = score(Uma, Vma, *mals.users.rows_for(tu),

@@ -8,8 +8,12 @@ TPU-native rebuild of the reference's two DSGD implementations:
 Architecture: blocking is a one-time host pass (``data.blocking``), the whole
 ``iterations × k`` superstep loop is ONE jitted XLA computation
 (``ops.sgd.dsgd_train``) — no per-superstep network shuffle, no host
-round-trips. On a device mesh the same schedule runs with U/V sharded and
-``lax.ppermute`` rotating item shards (``parallel.dsgd_mesh``).
+round-trips. On a device mesh the same schedule runs with U/V sharded per
+the unified logical-axis rules table (``parallel.partitioner.Partitioner``:
+U = ``('users', 'rank')``, V = ``('items', 'rank')``) and ``lax.ppermute``
+rotating item shards around the partitioner's data axis
+(``parallel.dsgd_mesh``); on a multi-host pod the identical code runs over
+the ``Partitioner.create()`` global mesh.
 
 Config parity (reference defaults in FlinkML parameter objects,
 MatrixFactorization.scala:201-211, DSGDforMF.scala:161-169):

@@ -207,10 +207,13 @@ class MFModel:
         excludes each user's already-interacted items — the standard
         serving contract (recommend only NEW items).
 
-        ``mesh`` (a ``jax.sharding.Mesh``) serves over an item-sharded
-        catalog: per-shard MXU scoring + local top-k, then a candidate
-        all_gather and exact merge (``parallel.serving``) — for catalogs
-        too large for one chip, or to parallelize the scoring FLOPs.
+        ``mesh`` (a ``jax.sharding.Mesh`` or a
+        ``parallel.partitioner.Partitioner``) serves over an
+        item-sharded catalog: per-shard MXU scoring + local top-k, then
+        a candidate all_gather and exact merge (``parallel.serving``) —
+        for catalogs too large for one chip, or to parallelize the
+        scoring FLOPs. Shardings resolve through the partitioner's
+        logical-axis rules table either way.
 
         Returns ``(item_ids int64 [n, k], scores float32 [n, k])`` sorted
         by descending score. Users never seen in training get item_ids
@@ -228,23 +231,28 @@ class MFModel:
         tu, ti = self._train_rows(train)
         item_ids_of_row = np.asarray(self.items.ids)
         if mesh is not None:
+            from large_scale_recommendation_tpu.parallel.partitioner import (
+                as_partitioner,
+            )
             from large_scale_recommendation_tpu.parallel.serving import (
                 catalog_version,
                 mesh_top_k_recommend,
                 shard_catalog,
             )
 
+            part = as_partitioner(mesh)
             # the sharded catalog is per-(model, mesh) state — build it
             # once and reuse across requests (a serving loop's whole
-            # point). The cached build is version-checked against the
-            # LIVE V: reassigning model.V (a retrain swap) invalidates
-            # it, so this surface can never serve stale factors while
-            # recommend() serves fresh ones.
+            # point; keyed on the interned Mesh so a raw-mesh caller and
+            # a partitioner caller share the build). The cached build is
+            # version-checked against the LIVE V: reassigning model.V (a
+            # retrain swap) invalidates it, so this surface can never
+            # serve stale factors while recommend() serves fresh ones.
             cache = self.__dict__.setdefault("_serving_catalogs", {})
-            cat = cache.get(mesh)
+            cat = cache.get(part.mesh)
             if cat is None or cat.version != catalog_version(self.V):
-                cat = cache[mesh] = shard_catalog(
-                    self.V, mesh, item_mask=item_ids_of_row >= 0)
+                cat = cache[part.mesh] = shard_catalog(
+                    self.V, part, item_mask=item_ids_of_row >= 0)
             top_rows, top_scores = mesh_top_k_recommend(
                 self.U, None, u_rows[known], k=k, train_u=tu,
                 train_i=ti, chunk=chunk, catalog=cat)

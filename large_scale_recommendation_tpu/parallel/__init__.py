@@ -1,0 +1,83 @@
+"""Distributed execution package: one logical-axis Partitioner over a
+``('data', 'model')`` device mesh, plus the mesh solvers and serving
+scatter expressed on top of it.
+
+Public surface (import from HERE, not the submodules):
+
+- ``Partitioner`` / ``as_partitioner`` / ``DEFAULT_RULES`` /
+  ``DATA_AXIS`` / ``MODEL_AXIS`` / ``make_data_model_mesh`` — the one
+  sharding layer (``partitioner``);
+- ``DistributedConfig`` / ``initialize_distributed`` /
+  ``host_rating_shard`` / ``make_global_array`` /
+  ``global_device_blocked`` — multi-host bring-up + per-host ingest
+  (``distributed``);
+- ``make_block_mesh`` / ``block_sharding`` / ``ring_backward`` /
+  ``shard_map`` / ``BLOCK_AXIS`` — legacy 1D-ring mesh helpers
+  (``mesh``);
+- ``MeshDSGD`` / ``MeshDSGDConfig`` / ``build_mesh_dsgd_step``,
+  ``MeshALS`` / ``build_mesh_als_step`` — the mesh solvers;
+- ``ShardedCatalog`` / ``shard_catalog`` / ``mesh_top_k_recommend`` /
+  ``catalog_version`` — mesh serving.
+
+Attributes resolve lazily (PEP 562) so importing the package costs
+nothing until a symbol is touched — entry points that must control
+backend discovery (``utils.platform.force_cpu``) stay in charge.
+"""
+
+from __future__ import annotations
+
+import importlib
+
+_EXPORTS = {
+    # partitioner — the unified sharding layer
+    "Partitioner": "partitioner",
+    "as_partitioner": "partitioner",
+    "make_data_model_mesh": "partitioner",
+    "DEFAULT_RULES": "partitioner",
+    "DATA_AXIS": "partitioner",
+    "MODEL_AXIS": "partitioner",
+    # multi-host bring-up + ingest
+    "DistributedConfig": "distributed",
+    "initialize_distributed": "distributed",
+    "host_rating_shard": "distributed",
+    "make_global_array": "distributed",
+    "global_device_blocked": "distributed",
+    "GlobalBlockedArrays": "distributed",
+    # legacy mesh helpers
+    "BLOCK_AXIS": "mesh",
+    "shard_map": "mesh",
+    "select_devices": "mesh",
+    "make_block_mesh": "mesh",
+    "block_sharding": "mesh",
+    "replicated": "mesh",
+    "ring_backward": "mesh",
+    # solvers
+    "MeshDSGD": "dsgd_mesh",
+    "MeshDSGDConfig": "dsgd_mesh",
+    "build_mesh_dsgd_step": "dsgd_mesh",
+    "device_major_local_strata": "dsgd_mesh",
+    "MeshALS": "als_mesh",
+    "build_mesh_als_step": "als_mesh",
+    # serving
+    "ShardedCatalog": "serving",
+    "shard_catalog": "serving",
+    "mesh_top_k_recommend": "serving",
+    "catalog_version": "serving",
+    "mesh_supports_donation": "serving",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    module = _EXPORTS.get(name)
+    if module is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    value = getattr(importlib.import_module(f".{module}", __name__), name)
+    globals()[name] = value  # cache: next access skips __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

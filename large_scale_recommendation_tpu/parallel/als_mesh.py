@@ -17,6 +17,11 @@ and symmetrically for V with ratings partitioned by item block. MLlib routes
 factor blocks between executors through the block manager each half-step;
 here the only communication is the two ``all_gather`` collectives per round,
 riding ICI inside one jitted computation.
+
+Shardings, placement (single-process reshard vs multi-process global
+assembly) and the gather axis all resolve through the unified
+``parallel.partitioner.Partitioner`` rules table — this module
+constructs no ``NamedSharding`` of its own.
 """
 
 from __future__ import annotations
@@ -26,23 +31,22 @@ from functools import lru_cache, partial
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh  # noqa: F401 — annotation surface
 
 from large_scale_recommendation_tpu.core.types import Ratings
 from large_scale_recommendation_tpu.data import blocking
 from large_scale_recommendation_tpu.models.als import ALSConfig
 from large_scale_recommendation_tpu.models.mf import MFModel
 from large_scale_recommendation_tpu.ops import als as als_ops
-from large_scale_recommendation_tpu.parallel.mesh import (
-    BLOCK_AXIS,
-    make_block_mesh,
-    shard_map,
+from large_scale_recommendation_tpu.parallel.mesh import shard_map
+from large_scale_recommendation_tpu.parallel.partitioner import (
+    Partitioner,
+    as_partitioner,
 )
 
 
-@lru_cache(maxsize=32)
 def build_mesh_als_step(
-    mesh: Mesh,
+    mesh: "Mesh | Partitioner",
     lambda_: float,
     reg_mode: str,
     iterations: int,
@@ -53,18 +57,40 @@ def build_mesh_als_step(
 ):
     """Jitted distributed ALS round loop over bucketed solve plans.
 
+    ``mesh`` may be a raw ``Mesh`` (legacy) or a ``Partitioner``; every
+    sharding and the two per-round ``all_gather`` collectives resolve
+    through the partitioner's rules table.
+
     Inputs (all 0-dim-sharded): U, V, omegas, then ``n_user_buckets`` ×
     4 arrays of the user-side plan followed by ``n_item_buckets`` × 4 of the
     item side (``ops.als.build_sharded_plans`` layouts). Per round: two
     ``all_gather`` collectives + per-shard bucketed gram/solve — the same
     no-scatter matmul formulation as the single-chip path.
     """
-    spec = P(BLOCK_AXIS)
+    return _build_mesh_als_step(
+        as_partitioner(mesh), lambda_, reg_mode, iterations,
+        n_user_buckets, n_item_buckets, implicit, gram_dtype)
+
+
+@lru_cache(maxsize=32)
+def _build_mesh_als_step(
+    part: Partitioner,
+    lambda_: float,
+    reg_mode: str,
+    iterations: int,
+    n_user_buckets: int,
+    n_item_buckets: int,
+    implicit: bool,
+    gram_dtype,
+):
+    part.require_no_model_parallel("mesh ALS")
+    axis = part.data_axis
+    spec = part.spec("ratings")
     n_arrays = 4 + 4 * (n_user_buckets + n_item_buckets)
 
     @partial(
         shard_map,
-        mesh=mesh,
+        mesh=part.mesh,
         in_specs=(spec,) * n_arrays,
         out_specs=(spec, spec),
     )
@@ -86,7 +112,7 @@ def build_mesh_als_step(
             # type system — nothing to annotate, the zeros pass through)
             z = jnp.zeros(shape, jnp.float32)
             pcast = getattr(jax.lax, "pcast", None)
-            return pcast(z, BLOCK_AXIS, to="varying") if pcast else z
+            return pcast(z, axis, to="varying") if pcast else z
 
         def full_gram(F):
             # the shared iALS VᵀV term — the gathered table is replicated,
@@ -105,12 +131,12 @@ def build_mesh_als_step(
 
         def round_(carry, _):
             U_l, V_l = carry
-            V_full = jax.lax.all_gather(cast(V_l), BLOCK_AXIS, tiled=True)
+            V_full = jax.lax.all_gather(cast(V_l), axis, tiled=True)
             Gv = full_gram(V_full) if implicit else None
             U_l = als_ops.solve_side_local(V_full, ub, nu_l, lam, scale_u,
                                            varying_zeros, Gv,
                                            dtype=local_dtype)
-            U_full = jax.lax.all_gather(cast(U_l), BLOCK_AXIS, tiled=True)
+            U_full = jax.lax.all_gather(cast(U_l), axis, tiled=True)
             Gu = full_gram(U_full) if implicit else None
             V_l = als_ops.solve_side_local(U_full, ib, ni_l, lam, scale_v,
                                            varying_zeros, Gu,
@@ -128,14 +154,16 @@ class MeshALS:
     """Distributed ALS over a block mesh — same surface as ``MeshDSGD``."""
 
     def __init__(self, config: ALSConfig | None = None,
-                 mesh: Mesh | None = None):
+                 mesh=None, partitioner: Partitioner | None = None):
         self.config = config or ALSConfig()
-        self.mesh = mesh or make_block_mesh()
+        self.partitioner = (partitioner if partitioner is not None
+                            else as_partitioner(mesh))
+        self.mesh = self.partitioner.mesh
         self.model: MFModel | None = None
 
     @property
     def num_blocks(self) -> int:
-        return self.mesh.shape[BLOCK_AXIS]
+        return self.partitioner.num_blocks
 
     def fit(self, ratings: Ratings) -> MFModel:
         from large_scale_recommendation_tpu.models.als import ALS
@@ -207,36 +235,23 @@ class MeshALS:
 
         U, V = solver._init_factors(users, items)
 
-        # placement: single-process uses a device-side reshard (no host
-        # round-trip — np.asarray on the device-resident U/V would pull
-        # the full tables across the narrow host link just to re-upload
-        # them); multi-process assembles globally, each process supplying
-        # the shards of its OWN devices from its host copy (the host
-        # blocking above is deterministic + digest-checked identical).
-        if jax.process_count() > 1:
-            from large_scale_recommendation_tpu.parallel.distributed import (
-                make_global_array,
-            )
-
-            put = lambda x: make_global_array(np.asarray(x), self.mesh,
-                                              P(BLOCK_AXIS))
-        else:
-            from large_scale_recommendation_tpu.parallel.mesh import (
-                block_sharding,
-            )
-
-            shard = block_sharding(self.mesh)
-            put = lambda x: jax.device_put(jnp.asarray(x), shard)
+        # placement: Partitioner.place is the ONE copy of the
+        # single-process-reshard vs multi-process-global-assembly branch
+        # (the host blocking above is deterministic + digest-checked
+        # identical, so every host's copy can serve its devices' shards)
+        part = self.partitioner
         step_fn = build_mesh_als_step(
-            self.mesh, cfg.lambda_, cfg.reg_mode, cfg.iterations,
+            part, cfg.lambda_, cfg.reg_mode, cfg.iterations,
             len(user_plan), len(item_plan),
             implicit=cfg.implicit_alpha is not None,
             gram_dtype=gram_dtype,
         )
         U, V = step_fn(
-            put(U), put(V), put(users.omega), put(items.omega),
-            *(put(a) for b in user_plan for a in b),
-            *(put(a) for b in item_plan for a in b),
+            part.place(U, "users", "rank"), part.place(V, "items", "rank"),
+            part.place(users.omega, "users"),
+            part.place(items.omega, "items"),
+            *(part.place(a, "ratings") for b in user_plan for a in b),
+            *(part.place(a, "ratings") for b in item_plan for a in b),
         )
         self.model = MFModel(U=U, V=V, users=users, items=items)
         return self.model

@@ -18,9 +18,14 @@ What maps where:
 | reference                                  | here                        |
 |--------------------------------------------|-----------------------------|
 | Flink/Spark cluster bring-up               | ``initialize_distributed()``|
+|                                            | (``Partitioner.create()``)  |
 | partitionCustom shipping ratings to workers| ``host_rating_shard``       |
 | per-worker factor blocks                   | mesh-sharded U/V (dsgd_mesh)|
 | engine network shuffles between supersteps | ``lax.ppermute`` on the ring|
+
+Array layout decisions live in ``parallel.partitioner.Partitioner`` —
+the one logical-axis rules table; this module provides the process-group
+bring-up and the process-local→global assembly primitives it builds on.
 
 Single-process fallback: every function degrades to the local-only behavior
 when ``num_processes == 1``, so the same driver script runs on a laptop, a
@@ -69,6 +74,17 @@ def initialize_distributed(config: DistributedConfig | None = None) -> bool:
         return False
     import jax
 
+    # XLA:CPU runs a computation spanning processes only through an
+    # explicit cross-process collectives layer; gloo ships with jaxlib
+    # but is NOT the default here — without it every cross-host jit dies
+    # with "Multiprocess computations aren't implemented on the CPU
+    # backend" (measured on the 2-process local cluster). Accelerator
+    # backends ignore the knob, so set it unconditionally; tolerate jax
+    # versions that renamed/removed it.
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
     jax.distributed.initialize(
         coordinator_address=cfg.coordinator_address,
         num_processes=cfg.num_processes,
@@ -104,6 +120,9 @@ def make_global_array(host_data: np.ndarray, mesh, spec):
     shards — for the dense block layouts here, passing the full logical
     array on every host (tests) or a host-local view with global indexing
     (real pods) both work.
+
+    Legacy raw-spec surface; ``Partitioner.make_global_array`` /
+    ``Partitioner.place`` are the rules-table spellings new code uses.
     """
     import jax
     from jax.sharding import NamedSharding
@@ -181,19 +200,21 @@ def global_device_blocked(
     """
     import jax
     import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
     from large_scale_recommendation_tpu.data import device_blocking as db
-    from large_scale_recommendation_tpu.parallel.mesh import BLOCK_AXIS
+    from large_scale_recommendation_tpu.parallel.partitioner import (
+        as_partitioner,
+    )
 
-    k = mesh.shape[BLOCK_AXIS]
-    shard = NamedSharding(mesh, P(BLOCK_AXIS))
-    rep = NamedSharding(mesh, P())
-    dm3 = NamedSharding(mesh, P(BLOCK_AXIS, None, None))
+    part = as_partitioner(mesh)
+    mesh = part.mesh
+    k = part.num_blocks
+    shard = part.sharding("ratings")
+    rep = part.replicated()
+    dm3 = part.sharding("ratings")  # [k, k, b] device-major: dim 0 only
 
     def glob(a, dt):
-        return jax.make_array_from_process_local_data(
-            shard, np.ascontiguousarray(np.asarray(a, dt)))
+        return part.from_process_local(np.asarray(a, dt), "ratings")
 
     gu = glob(u_local, np.int32)
     gi = glob(i_local, np.int32)
@@ -253,9 +274,12 @@ def global_device_blocked(
         return (_keyed_uniform_rows_padded(key, id_u, rank, s),
                 _keyed_uniform_rows_padded(key, id_v, rank, s))
 
-    U, V = jax.jit(init_fn, out_shardings=(shard, shard))(id_of_ur, id_of_ir)
-    ou, ov = jax.jit(lambda a, b: (a, b),
-                     out_shardings=(shard, shard))(omega_u, omega_v)
+    U, V = jax.jit(init_fn, out_shardings=(
+        part.sharding("users", "rank"), part.sharding("items", "rank"),
+    ))(id_of_ur, id_of_ir)
+    ou, ov = jax.jit(lambda a, b: (a, b), out_shardings=(
+        part.sharding("users"), part.sharding("items"),
+    ))(omega_u, omega_v)
 
     return GlobalBlockedArrays(
         U=U, V=V, ru=ru, ri=ri, rv=rv, rw=rw, icu=icu, icv=icv,

@@ -8,7 +8,13 @@ Here the rotation is a ``lax.ppermute`` of the item shard around the ICI
 ring — pure device-to-device transfer inside ONE jitted computation, no host
 involvement for the entire ``iterations × k`` superstep loop.
 
-Layout (k devices on the ``blocks`` mesh axis):
+All shardings and collective axes resolve through the unified
+``parallel.partitioner.Partitioner`` rules table (U is logical
+``('users', 'rank')``, V ``('items', 'rank')``, strata ``('ratings',)``;
+the ring is the partitioner's ``data`` axis) — this module constructs no
+``NamedSharding`` of its own.
+
+Layout (k devices on the partitioner's data axis):
 - U: [k·rows_per_ublock, r] sharded on dim 0 — device p owns user block p
   (blocks are equal-size contiguous row ranges by construction,
   ``data.blocking.build_id_index``).
@@ -35,18 +41,16 @@ from typing import Any
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh  # noqa: F401 — annotation surface
 
 from large_scale_recommendation_tpu.core.types import Ratings
 from large_scale_recommendation_tpu.data import blocking
 from large_scale_recommendation_tpu.models.mf import MFModel
 from large_scale_recommendation_tpu.ops import sgd as sgd_ops
-from large_scale_recommendation_tpu.parallel.mesh import (
-    BLOCK_AXIS,
-    block_sharding,
-    make_block_mesh,
-    ring_backward,
-    shard_map,
+from large_scale_recommendation_tpu.parallel.mesh import shard_map
+from large_scale_recommendation_tpu.parallel.partitioner import (
+    Partitioner,
+    as_partitioner,
 )
 
 
@@ -69,9 +73,8 @@ def device_major_local_strata(
             v.astype(np.float32), w.astype(np.float32))
 
 
-@functools.lru_cache(maxsize=32)
 def build_mesh_dsgd_step(
-    mesh: Mesh,
+    mesh: "Mesh | Partitioner",
     updater: Any,
     minibatch: int,
     num_blocks: int,
@@ -83,6 +86,10 @@ def build_mesh_dsgd_step(
 ):
     """Build the jitted multi-chip training function.
 
+    ``mesh`` may be a raw ``jax.sharding.Mesh`` (legacy surface) or a
+    ``Partitioner`` — every sharding/collective axis below resolves
+    through the partitioner's rules table either way.
+
     Returns ``fn(U, V, ru, ri, rv, rw, omega_u, omega_v, t0) -> (U, V)``
     where every array argument is sharded on dim 0 over the block axis and
     ``t0`` is a replicated scalar (iterations already completed). The full
@@ -90,9 +97,28 @@ def build_mesh_dsgd_step(
     ``.iterate(iterations * k)`` bulk iteration, DSGDforMF.scala:337-344)
     runs as one XLA computation with k·iterations ppermutes on the ICI ring.
     """
+    return _build_mesh_dsgd_step(
+        as_partitioner(mesh), updater, minibatch, num_blocks, iterations,
+        collision, with_inv, kernel, pallas_interpret)
+
+
+@functools.lru_cache(maxsize=32)
+def _build_mesh_dsgd_step(
+    part: Partitioner,
+    updater: Any,
+    minibatch: int,
+    num_blocks: int,
+    iterations: int,
+    collision: str,
+    with_inv: bool,
+    kernel: str,
+    pallas_interpret: bool,
+):
+    part.require_no_model_parallel("mesh DSGD")
     k = num_blocks
-    perm = ring_backward(k)
-    spec = P(BLOCK_AXIS)
+    axis = part.data_axis
+    perm = part.ring_backward()
+    spec = part.spec("ratings")
     n_sharded = 10 if with_inv else 8
     if kernel not in ("xla", "pallas"):
         raise ValueError(
@@ -106,8 +132,8 @@ def build_mesh_dsgd_step(
 
     @partial(
         shard_map,
-        mesh=mesh,
-        in_specs=(spec,) * n_sharded + (P(),),
+        mesh=part.mesh,
+        in_specs=(spec,) * n_sharded + (part.spec(),),
         out_specs=(spec, spec),
         # the replication checker has no rule for pallas_call at all on
         # this jax ("No replication rule for pallas_call" — AOT-measured,
@@ -176,9 +202,9 @@ def build_mesh_dsgd_step(
             # Rotate the item shard (and its omegas) one step down the ring
             # — ≙ the reference's inter-superstep shuffle of item blocks
             # (DSGDforMF.scala:611-619 / OfflineSpark.scala:196-201), now an
-            # ICI ppermute.
-            V = jax.lax.ppermute(V, BLOCK_AXIS, perm)
-            ov = jax.lax.ppermute(ov, BLOCK_AXIS, perm)
+            # ICI ppermute on the partitioner's data axis.
+            V = jax.lax.ppermute(V, axis, perm)
+            ov = jax.lax.ppermute(ov, axis, perm)
             return (U, V, ov), None
 
         (U_l, V_l, ov_l), _ = jax.lax.scan(
@@ -219,19 +245,25 @@ class MeshDSGD:
     """Distributed DSGD over a device mesh.
 
     ≙ the reference's multi-worker DSGD deployments (Flink task slots /
-    Spark executors, one factor block pair per worker). ``mesh`` defaults to
-    all local devices on a 1D block ring.
+    Spark executors, one factor block pair per worker). ``mesh`` accepts a
+    raw ``Mesh`` (legacy) or a ``Partitioner``; the default is the global
+    ``('data', 'model')`` partitioner over all devices — which spans
+    processes when ``jax.distributed`` is up, so the same construction
+    runs on a laptop, one TPU VM, or a pod slice.
     """
 
     def __init__(self, config: MeshDSGDConfig | None = None,
-                 mesh: Mesh | None = None, updater: Any = None):
+                 mesh=None, updater: Any = None,
+                 partitioner: Partitioner | None = None):
         from large_scale_recommendation_tpu.core.updaters import (
             RegularizedSGDUpdater,
             schedule_from_name,
         )
 
         self.config = config or MeshDSGDConfig()
-        self.mesh = mesh or make_block_mesh()
+        self.partitioner = (partitioner if partitioner is not None
+                            else as_partitioner(mesh))
+        self.mesh = self.partitioner.mesh
         sched = schedule_from_name(self.config.lr_schedule,
                                    self.config.lambda_)
         self.updater = updater or RegularizedSGDUpdater(
@@ -243,7 +275,7 @@ class MeshDSGD:
 
     @property
     def num_blocks(self) -> int:
-        return self.mesh.shape[BLOCK_AXIS]
+        return self.partitioner.num_blocks
 
     def fit(
         self,
@@ -318,9 +350,10 @@ class MeshDSGD:
 
         Single-process meshes (one host's devices, or the virtual CPU
         mesh). For multi-host runs use
-        ``parallel.distributed.global_device_blocked`` — the same pipeline
-        computed globally on the process-spanning mesh, each host
-        contributing only its shard (examples/distributed_demo.py).
+        ``parallel.distributed.global_device_blocked`` over a
+        ``Partitioner.create()`` global mesh — the same pipeline computed
+        globally on the process-spanning mesh, each host contributing
+        only its shard (examples/distributed_demo.py).
         """
         from large_scale_recommendation_tpu.data.device_blocking import (
             device_block_problem,
@@ -383,6 +416,7 @@ class MeshDSGD:
                 checkpoint_manager.directory, keep=checkpoint_manager.keep)
 
         cfg = self.config
+        part = self.partitioner
         k = self.num_blocks
         done = 0
         if cfg.factor_dtype not in ("float32", "bfloat16"):
@@ -393,8 +427,6 @@ class MeshDSGD:
         U = jnp.asarray(U).astype(fdt)
         V = jnp.asarray(V).astype(fdt)
 
-        shard = block_sharding(self.mesh)
-        put = lambda x: jax.device_put(jnp.asarray(x), shard)
         if resume:
             if checkpoint_manager is None:
                 raise ValueError("resume=True requires a checkpoint_manager")
@@ -402,13 +434,15 @@ class MeshDSGD:
             # shape/dtype are read, so the fresh init tables are never
             # shipped to device just to be discarded
             U, V, done = restore_segment_state_sharded(
-                checkpoint_manager, kind, U, V, sharding=shard)
+                checkpoint_manager, kind, U, V, partitioner=part)
         else:
-            U, V = put(U), put(V)
-        args = tuple(put(x) for x in strata)
-        ou, ov = put(omega_u), put(omega_v)
+            U = part.place(U, "users", "rank")
+            V = part.place(V, "items", "rank")
+        args = tuple(part.place(x, "ratings") for x in strata)
+        ou = part.place(omega_u, "users")
+        ov = part.place(omega_v, "items")
         with_inv = bool(inv_args)
-        inv_args = tuple(put(x) for x in inv_args)
+        inv_args = tuple(part.place(x, "ratings") for x in inv_args)
 
         from large_scale_recommendation_tpu.ops.pallas_sgd import (
             default_interpret,
@@ -426,7 +460,7 @@ class MeshDSGD:
         while done < cfg.iterations:
             seg = min(segment, cfg.iterations - done)
             step_fn = build_mesh_dsgd_step(
-                self.mesh, self.updater, cfg.minibatch_size, k, seg,
+                part, self.updater, cfg.minibatch_size, k, seg,
                 cfg.collision_mode, with_inv, cfg.kernel,
                 default_interpret() if cfg.kernel == "pallas" else False,
             )

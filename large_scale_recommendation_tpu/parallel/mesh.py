@@ -29,21 +29,19 @@ except ImportError:  # older jax: the experimental home, check_rep kwarg
         return _shard_map(f, *args, **kwargs)
 
 __all__ = [
-    "BLOCK_AXIS", "shard_map", "make_block_mesh", "block_sharding",
-    "replicated", "ring_backward",
+    "BLOCK_AXIS", "shard_map", "select_devices", "make_block_mesh",
+    "block_sharding", "replicated", "ring_backward",
 ]
 
 BLOCK_AXIS = "blocks"
 
 
-def make_block_mesh(num_devices: int | None = None,
-                    devices=None) -> Mesh:
-    """1D mesh over the block axis — the DSGD stratum ring.
-
-    The reference's k×k stratum grid runs on k workers (each holds one user
-    block and one rotating item block); here k = mesh size and the rotation
-    is ``lax.ppermute`` around this ring.
-    """
+def select_devices(num_devices: int | None = None, devices=None) -> list:
+    """The device pick every mesh constructor shares (``make_block_mesh``
+    and the Partitioner's ``('data', 'model')`` mesh): global
+    ``jax.devices()`` order with the virtual-CPU fallback, truncated to
+    ``num_devices`` — so rings built by either constructor rotate over
+    the same devices in the same order."""
     if devices is None:
         # NOTE: ``jax.devices()`` initializes every backend the
         # ``jax_platforms`` config names, and a broken accelerator plugin
@@ -68,12 +66,38 @@ def make_block_mesh(num_devices: int | None = None,
                 f"need {num_devices} devices, have {len(devices)}"
             )
         devices = devices[:num_devices]
-    return Mesh(np.array(devices), (BLOCK_AXIS,))
+    return list(devices)
+
+
+def make_block_mesh(num_devices: int | None = None,
+                    devices=None) -> Mesh:
+    """1D mesh over the block axis — the DSGD stratum ring.
+
+    The reference's k×k stratum grid runs on k workers (each holds one user
+    block and one rotating item block); here k = mesh size and the rotation
+    is ``lax.ppermute`` around this ring.
+
+    Legacy surface: new code should go through
+    ``parallel.partitioner.Partitioner`` (which builds the 2D
+    ``('data', 'model')`` mesh); meshes built here are still accepted
+    everywhere — the partitioner adopts the 1D ring's only axis as its
+    data role, producing identical shardings.
+    """
+    return Mesh(np.array(select_devices(num_devices, devices)),
+                (BLOCK_AXIS,))
 
 
 def block_sharding(mesh: Mesh) -> NamedSharding:
-    """Shard dim 0 over the block axis (factor tables, per-device strata)."""
-    return NamedSharding(mesh, PartitionSpec(BLOCK_AXIS))
+    """Shard dim 0 over the block axis (factor tables, per-device strata).
+
+    Legacy spelling of ``Partitioner(mesh).sharding("users", "rank")`` /
+    ``..."ratings")`` — kept for external callers; the mesh solvers now
+    resolve every sharding through the partitioner's rules table."""
+    from large_scale_recommendation_tpu.parallel.partitioner import (
+        as_partitioner,
+    )
+
+    return as_partitioner(mesh).sharding("ratings")
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

@@ -30,6 +30,12 @@ factors. An opt-in bf16 catalog (``dtype="bfloat16"``) halves the HBM
 footprint and the per-shard matmul/all_gather traffic; scores are still
 accumulated in f32 (``preferred_element_type``) and the merge is f32
 end-to-end.
+
+Catalog shardings, the per-shard offset (``axis_index``) and the
+candidate all_gather all resolve through the unified
+``parallel.partitioner.Partitioner`` rules table (catalog = logical
+``('items', 'rank')``, query chunks = replicated ``('queries',)``) —
+this module constructs no ``NamedSharding`` of its own.
 """
 
 from __future__ import annotations
@@ -44,13 +50,11 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh  # noqa: F401 — annotation surface
 
-from large_scale_recommendation_tpu.parallel.mesh import (
-    BLOCK_AXIS,
-    block_sharding,
-    make_block_mesh,
-    shard_map,
+from large_scale_recommendation_tpu.parallel.mesh import shard_map
+from large_scale_recommendation_tpu.parallel.partitioner import (
+    as_partitioner,
 )
 from large_scale_recommendation_tpu.utils.metrics import DEAD_SLOT_OFFSET
 
@@ -109,17 +113,22 @@ class ShardedCatalog:
     dtype: str = "float32"
 
 
-def shard_catalog(V, mesh: Mesh | None = None, item_mask=None,
+def shard_catalog(V, mesh=None, item_mask=None,
                   dtype=None) -> ShardedCatalog:
     """Pad ``V`` to a mesh-divisible height and place it block-sharded.
+
+    ``mesh`` may be a raw ``Mesh`` (legacy), a ``Partitioner``, or None
+    (the default global partitioner); the catalog rows are the logical
+    ``('items', 'rank')`` axes of the unified rules table.
 
     ``dtype`` (default f32) accepts ``"bfloat16"``/``jnp.bfloat16`` to
     store the catalog half-width: the per-shard matmul then reads bf16
     from HBM and the query chunks ride the ICI at half the bytes, while
     scores accumulate in f32 (see ``_mesh_topk_step``)."""
-    mesh = mesh or make_block_mesh()
+    part = as_partitioner(mesh)
+    mesh = part.mesh
     cat_dtype = jnp.dtype(dtype or jnp.float32)
-    n_dev = mesh.shape[BLOCK_AXIS]
+    n_dev = part.num_blocks
     n_rows = int(V.shape[0])
     rpb = -(-n_rows // n_dev)
     item_w = np.zeros(n_dev * rpb, np.float32)
@@ -139,10 +148,9 @@ def shard_catalog(V, mesh: Mesh | None = None, item_mask=None,
         [V_dev,
          jnp.zeros((n_dev * rpb - n_rows, V.shape[1]), cat_dtype)]
     ) if n_dev * rpb != n_rows else V_dev
-    shard = block_sharding(mesh)
     return ShardedCatalog(
-        V_sh=jax.device_put(V_pad, shard),
-        w_sh=jax.device_put(jnp.asarray(item_w), shard),
+        V_sh=part.shard(V_pad, "items", "rank"),
+        w_sh=part.shard(item_w, "items"),
         n_rows=n_rows, rows_per_shard=rpb, mesh=mesh,
         version=version, dtype=cat_dtype.name)
 
@@ -194,11 +202,17 @@ def _mesh_topk_step(mesh: Mesh, k_local: int, k_out: int,
             per_mesh[key] = cached  # re-insert: dict order is LRU order
             return cached
 
+    part = as_partitioner(mesh)
+    part.require_no_model_parallel("mesh serving")
+    axis = part.data_axis
+    cat_spec = part.spec("items", "rank")
+
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(), P(BLOCK_AXIS), P(BLOCK_AXIS), P(), P(), P()),
-        out_specs=(P(), P()),
+        in_specs=(part.spec("queries"), cat_spec, part.spec("items"),
+                  part.spec(), part.spec(), part.spec()),
+        out_specs=(part.spec("queries"), part.spec("queries")),
         # outputs are replicated BY the trailing all_gather+top_k merge;
         # the static VMA checker can't see through the axis_index-derived
         # shard offsets to infer it (the mesh==single parity tests pin
@@ -213,7 +227,7 @@ def _mesh_topk_step(mesh: Mesh, k_local: int, k_out: int,
         scores = scores + item_w_l[None, :]
         # exclusions carry GLOBAL item rows; this shard applies the ones
         # in its range (out-of-range → clamped index, +inf weight: no-op)
-        base = jax.lax.axis_index(BLOCK_AXIS) * rows_per_shard
+        base = jax.lax.axis_index(axis) * rows_per_shard
         local = excl_cols - base
         in_range = (local >= 0) & (local < rows_per_shard)
         local = jnp.clip(local, 0, rows_per_shard - 1)
@@ -222,8 +236,8 @@ def _mesh_topk_step(mesh: Mesh, k_local: int, k_out: int,
         v_loc, r_loc = jax.lax.top_k(scores, k_local)
         r_glob = r_loc + base
         # candidates ride the ICI: [chunk, n_dev·k_local] after the gather
-        v_all = jax.lax.all_gather(v_loc, BLOCK_AXIS, axis=1, tiled=True)
-        r_all = jax.lax.all_gather(r_glob, BLOCK_AXIS, axis=1, tiled=True)
+        v_all = jax.lax.all_gather(v_loc, axis, axis=1, tiled=True)
+        r_all = jax.lax.all_gather(r_glob, axis, axis=1, tiled=True)
         v_top, pos = jax.lax.top_k(v_all, k_out)
         return v_top, jnp.take_along_axis(r_all, pos, axis=1)
 
@@ -318,7 +332,7 @@ def mesh_top_k_recommend(U, V, user_rows, k: int = 10,
     if catalog is None:
         catalog = shard_catalog(V, mesh, item_mask)
     mesh = catalog.mesh
-    n_dev = mesh.shape[BLOCK_AXIS]
+    n_dev = as_partitioner(mesh).num_blocks
     n_rows, rpb = catalog.n_rows, catalog.rows_per_shard
     V_sh, w_sh = catalog.V_sh, catalog.w_sh
     user_rows = np.asarray(user_rows)

@@ -51,9 +51,8 @@ from large_scale_recommendation_tpu.models.mf import MFModel, _assemble_topk
 from large_scale_recommendation_tpu.obs.events import get_events
 from large_scale_recommendation_tpu.obs.registry import get_registry
 from large_scale_recommendation_tpu.obs.trace import get_tracer
-from large_scale_recommendation_tpu.parallel.mesh import (
-    BLOCK_AXIS,
-    make_block_mesh,
+from large_scale_recommendation_tpu.parallel.partitioner import (
+    as_partitioner,
 )
 from large_scale_recommendation_tpu.parallel.serving import (
     _mesh_topk_step,
@@ -101,7 +100,11 @@ class ServingEngine:
             raise ValueError(f"min_bucket must be a power of two in "
                              f"[1, max_batch], got {min_bucket}")
         self.k = int(k)
-        self.mesh = mesh or make_block_mesh()
+        # ``mesh`` accepts a raw Mesh (legacy), a Partitioner, or None
+        # (default global partitioner) — the catalog and the scoring step
+        # resolve their shardings through the partitioner's rules table
+        self.partitioner = as_partitioner(mesh)
+        self.mesh = self.partitioner.mesh
         self.max_batch = int(max_batch)
         self.min_bucket = int(min_bucket)
         # the full static shape family requests can execute against —
@@ -183,13 +186,14 @@ class ServingEngine:
         model = self.model
         self._item_ids_of_row = np.asarray(model.items.ids)
         self._catalog = shard_catalog(
-            model.V, self.mesh, item_mask=self._item_ids_of_row >= 0,
+            model.V, self.partitioner,
+            item_mask=self._item_ids_of_row >= 0,
             dtype=self._dtype)
         U = jnp.asarray(model.U)
         self._U = U.astype(self._dtype) if U.dtype != self._dtype else U
         tu, ti = model._train_rows(self._train)
         self._build_excl = _exclusion_builder(tu, ti, int(U.shape[0]))
-        n_dev = self.mesh.shape[BLOCK_AXIS]
+        n_dev = self.partitioner.num_blocks
         rpb = self._catalog.rows_per_shard
         self._k_local = min(self.k, rpb)
         self._k_out = min(self.k, n_dev * self._k_local)

@@ -427,17 +427,32 @@ class ShardedCheckpointManager:
 
 
 def restore_segment_state_sharded(manager: ShardedCheckpointManager,
-                                  kind: str, U, V, sharding=None):
+                                  kind: str, U, V, sharding=None,
+                                  partitioner=None):
     """Mesh twin of ``restore_segment_state``. ``U``/``V`` may be HOST
     arrays (only shape/dtype are read on the restore path — no wasted
     full-model transfer before the restored tables replace them) with the
-    target ``sharding`` given explicitly, or already-sharded global arrays
+    target placement given explicitly, or already-sharded global arrays
     (``sharding`` defaults to theirs). When no checkpoint exists the
     inputs are placed with the target sharding and ``done=0`` returned.
     Same kind-tag refusal contract (cross-path resume is silently-wrong
-    row permutation, so it errors)."""
+    row permutation, so it errors).
+
+    ``partitioner`` (a ``parallel.partitioner.Partitioner``) is the
+    rules-table spelling: U restores as logical ``('users', 'rank')``
+    and V as ``('items', 'rank')`` — the same shardings training runs
+    under, so resume re-shards each process's rows identically with no
+    hand-rolled ``NamedSharding`` at the call site."""
     import jax
     import jax.numpy as jnp
+
+    shard_u = shard_v = sharding
+    if partitioner is not None:
+        if sharding is not None:
+            raise ValueError("pass either sharding= or partitioner=, "
+                             "not both")
+        shard_u = partitioner.sharding("users", "rank")
+        shard_v = partitioner.sharding("items", "rank")
 
     latest = manager.latest_step()
     broken = [s for s in manager.incomplete_steps()
@@ -466,9 +481,16 @@ def restore_segment_state_sharded(manager: ShardedCheckpointManager,
                 f"({legacy[:3]}...) but no sharded manifest; restore them "
                 "with CheckpointManager.restore() and re-save, or point "
                 "the sharded manager at a fresh directory")
-        if sharding is not None:
-            U = jax.device_put(jnp.asarray(U), sharding)
-            V = jax.device_put(jnp.asarray(V), sharding)
+        if partitioner is not None:
+            # place() handles the multi-process case (global assembly
+            # from the host copy) — a device_put of a host array onto a
+            # process-spanning sharding would raise on the first
+            # multi-host resume-from-empty-directory
+            U = partitioner.place(U, "users", "rank")
+            V = partitioner.place(V, "items", "rank")
+        elif shard_u is not None:
+            U = jax.device_put(jnp.asarray(U), shard_u)
+            V = jax.device_put(jnp.asarray(V), shard_v)
         return U, V, 0
     meta = manager.meta(latest)
     ck_kind = meta.get("kind")
@@ -477,8 +499,8 @@ def restore_segment_state_sharded(manager: ShardedCheckpointManager,
             f"checkpoint kind {ck_kind!r} does not match this fit path "
             f"({kind!r}) — host-blocked (fit) and device-blocked "
             "(fit_device) row layouts are incompatible")
-    shard_u = sharding if sharding is not None else U.sharding
-    shard_v = sharding if sharding is not None else V.sharding
+    if shard_u is None:
+        shard_u, shard_v = U.sharding, V.sharding
     U2 = manager.restore_array(latest, "U", shard_u, np.shape(U), U.dtype)
     V2 = manager.restore_array(latest, "V", shard_v, np.shape(V), V.dtype)
     return U2, V2, latest

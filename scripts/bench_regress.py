@@ -8,6 +8,10 @@ silently as long as tier-1 stays green. This gate closes that gap::
     python scripts/bench_regress.py --baseline BENCH_r03.json
     python scripts/bench_regress.py --key serving_users_per_s=10
     python scripts/bench_regress.py --report out.txt  # also write the table
+    python scripts/bench_regress.py --family multichip  # pod_dryrun rounds
+                                      # (MULTICHIP_r*.json: pad ratio and
+                                      # layout lower-is-better, sharded
+                                      # train/ALS throughput higher)
 
 It loads both rounds, compares the watched keys (higher-is-better rates
 by default; ``--lower`` flags wall-clock-style keys), prints a table,
@@ -58,6 +62,26 @@ DEFAULT_KEYS: dict[str, float] = {
     "pct_of_hbm_peak": 30.0,
 }
 
+# watched keys for the MULTICHIP_r*.json trajectory (the pod_dryrun
+# acceptance harness, ISSUE 7): sharded-training throughput is
+# higher-is-better like every rate; pad ratio and layout bytes are
+# LOWER-is-better — a growing pad ratio is a blocking-layout regression
+# even when throughput noise hides it. Thresholds are tight for the
+# deterministic geometry keys (same code + seed ⇒ same layout) and
+# loose for walls-derived rates (shared machines).
+MULTICHIP_KEYS: dict[str, float] = {
+    "train_ratings_per_s": 30.0,
+    "als_rows_per_s": 30.0,
+    "max_pad_ratio": 10.0,
+    "layout_mb": 10.0,
+}
+
+# per-family round-file prefix + default watch set
+FAMILIES = {
+    "bench": ("BENCH", DEFAULT_KEYS),
+    "multichip": ("MULTICHIP", MULTICHIP_KEYS),
+}
+
 # keys where HIGHER is explicitly better (throughputs, achieved
 # bandwidth). These win over any accidental DEFAULT_LOWER substring
 # match — a throughput key must NEVER be gated as lower-is-better, and
@@ -67,8 +91,10 @@ DEFAULT_HIGHER = ("_ratings_per_s", "_rows_per_s", "_users_per_s",
                   "_per_s", "effective_hbm_gbs", "pct_of_hbm_peak",
                   "_hbm_gbs", "_tflops", "_mbps")
 
-# keys where LOWER is better (walls, latencies) when watched explicitly
-DEFAULT_LOWER = ("_wall_s", "_ms_", "time_to_", "_s_p")
+# keys where LOWER is better (walls, latencies, pad/layout overheads)
+# when watched explicitly
+DEFAULT_LOWER = ("_wall_s", "_ms_", "time_to_", "_s_p", "_pad_ratio",
+                 "layout_mb", "layout_bytes")
 
 _NUM_PAIR = re.compile(
     r'"([A-Za-z_][A-Za-z0-9_]*)":\s*(-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)')
@@ -124,12 +150,13 @@ def load_result(path: str) -> tuple[dict[str, float], str | None]:
     return flatten_result(doc), (str(err) if err else None)
 
 
-def find_rounds(directory: str = REPO) -> list[str]:
-    """BENCH_r*.json sorted by round number, oldest first."""
-    paths = glob.glob(os.path.join(directory, "BENCH_r*.json"))
+def find_rounds(directory: str = REPO, prefix: str = "BENCH") -> list[str]:
+    """``<prefix>_r*.json`` sorted by round number, oldest first
+    (``BENCH`` bench rounds, ``MULTICHIP`` pod_dryrun rounds)."""
+    paths = glob.glob(os.path.join(directory, f"{prefix}_r*.json"))
 
     def round_no(p: str) -> int:
-        m = re.search(r"BENCH_r(\d+)\.json$", p)
+        m = re.search(rf"{prefix}_r(\d+)\.json$", p)
         return int(m.group(1)) if m else -1
 
     return sorted((p for p in paths if round_no(p) >= 0), key=round_no)
@@ -187,10 +214,17 @@ def render_table(rows: list[dict], baseline_path: str,
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--family", choices=sorted(FAMILIES), default="bench",
+                    help="round family to gate: 'bench' (BENCH_r*.json, "
+                         "default) or 'multichip' (MULTICHIP_r*.json "
+                         "pod_dryrun rounds — pad ratio lower-is-better, "
+                         "sharded throughput higher-is-better)")
     ap.add_argument("--current", default=None,
-                    help="current round file (default: newest BENCH_r*.json)")
+                    help="current round file (default: newest round of "
+                         "the family)")
     ap.add_argument("--baseline", default=None,
-                    help="baseline file (default: previous BENCH_r*.json)")
+                    help="baseline file (default: previous round of the "
+                         "family)")
     ap.add_argument("--key", action="append", default=[],
                     metavar="NAME[=PCT]",
                     help="watch NAME at PCT%% (repeatable; replaces the "
@@ -205,12 +239,13 @@ def main(argv=None) -> int:
                     help="missing watched keys fail too")
     args = ap.parse_args(argv)
 
+    prefix, family_keys = FAMILIES[args.family]
     current, baseline = args.current, args.baseline
     if current is None or baseline is None:
-        rounds = find_rounds()
+        rounds = find_rounds(prefix=prefix)
         if current is None:
             if not rounds:
-                print("no BENCH_r*.json rounds found — nothing to gate")
+                print(f"no {prefix}_r*.json rounds found — nothing to gate")
                 return 2 if args.strict else 0
             current = rounds[-1]
         if baseline is None:
@@ -228,7 +263,7 @@ def main(argv=None) -> int:
             name, _, pct = spec.partition("=")
             keys[name] = float(pct) if pct else 30.0
     else:
-        keys = dict(DEFAULT_KEYS)
+        keys = dict(family_keys)
     if args.threshold is not None:
         keys = {k: args.threshold for k in keys}
 

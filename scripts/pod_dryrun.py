@@ -1,20 +1,36 @@
-"""Pod-shaped virtual-mesh validation past 8 devices (VERDICT r4 #7).
+"""Pod-shaped validation: virtual-mesh suite, at-scale geometry, and a
+2-process local cluster — the acceptance harness for the unified
+Partitioner layer (ISSUE 7; seeded as the VERDICT r4 #7 dryrun).
 
-Two layers, both on N virtual CPU devices (no chip needed):
+Layers, all chip-free:
 
 1. ``dryrun_multichip(N)`` — the full sharded path suite (mesh DSGD via
    both data pipelines, global blocking, mesh ALS, per-shard
-   checkpointing) at tiny shapes.
-2. A POD-SHAPED at-scale pass: the blueprint's 10:1 user:item geometry
+   checkpointing) at tiny shapes on N virtual CPU devices.
+2. Partitioner rules-table resolution at N devices: every logical axis
+   of ``DEFAULT_RULES`` must resolve to a ``NamedSharding`` on the
+   ``('data', 'model')`` mesh — the 16-device half of the rules
+   coverage (in-process tests cover 1/4/8 on the conftest mesh).
+3. A POD-SHAPED at-scale pass: the blueprint's 10:1 user:item geometry
    (SURVEY §6 scales to 10M×1M) at rank 128 with k = N blocks, skewed
-   draws, through ``device_block_problem`` + one mesh-DSGD training
-   segment. This catches exactly the k-scaling pathologies 8 devices
-   cannot: pad-ratio blowup at high k (k² buckets over skewed data),
-   per-shard minibatch divisibility at high k, and the high-k layout
-   memory (k²·bmax·6 arrays).
+   draws, through ``device_block_problem`` + mesh-DSGD training over
+   the Partitioner. Catches the k-scaling pathologies 8 devices cannot:
+   pad-ratio blowup at high k (k² buckets over skewed data), per-shard
+   minibatch divisibility, high-k layout memory — and now also measures
+   training THROUGHPUT (``train_ratings_per_s``) so
+   ``scripts/bench_regress.py --family multichip`` can gate rounds
+   against each other.
+4. A mesh-ALS throughput probe (rank 32) for the second solver family.
+5. A 2-PROCESS LOCAL CLUSTER pass (skippable: ``--no-two-process`` /
+   ``LSR_DRYRUN_NO_2PROC=1``): two real processes coordinate over
+   localhost (``jax.distributed``), the global 4-device ring spans both
+   — proving cross-process global arrays, ppermute across the process
+   boundary, and sharded checkpoint save/restore
+   (examples/distributed_demo.py is the workload).
 
-Prints ONE JSON line with the measured pad ratio, layout bytes, RMSE
-trajectory and walls; asserts the pinned bounds. Driven by
+Prints ONE machine-readable JSON line LAST (stderr flushed first, so
+2>&1-merged wrappers always parse it) with pad-ratio, layout-bytes and
+throughput fields; asserts the pinned bounds. Driven by
 ``tests/test_pod_scale.py`` in a 16-device subprocess; run standalone as
 
     python scripts/pod_dryrun.py 16        # or 32
@@ -26,13 +42,85 @@ from __future__ import annotations
 
 import json
 import os
+import socket
+import subprocess
 import sys
 import time
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-def main(n_devices: int = 16) -> dict:
-    sys.path.insert(0, os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
+
+def run_two_process_pass(timeout_s: float = 420.0) -> dict:
+    """The 2-process local-cluster smoke: launch the distributed demo as
+    two coordinated processes (own env — the parent's virtual-device
+    XLA flags must not leak) and report pass/fail + the markers that
+    prove each multi-host piece ran."""
+    import tempfile
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env_base = {k: v for k, v in os.environ.items()
+                if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    out: dict = {"n_processes": 2}
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory() as ckdir:
+        env_base.update({
+            "LSR_COORDINATOR": f"127.0.0.1:{port}",
+            "LSR_NUM_PROCESSES": "2",
+            "JAX_PLATFORMS": "cpu",
+            "LSR_CKPT_DIR": ckdir,
+        })
+        procs = [
+            subprocess.Popen(
+                [sys.executable,
+                 os.path.join(REPO, "examples", "distributed_demo.py")],
+                env={**env_base, "LSR_PROCESS_ID": str(p)},
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, cwd=REPO,
+            )
+            for p in range(2)
+        ]
+        outs = []
+        try:
+            for p in procs:
+                text, _ = p.communicate(timeout=timeout_s)
+                outs.append(text)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            out.update(ok=False, error=f"timeout after {timeout_s}s")
+            return out
+        finally:
+            for p in procs:
+                p.kill()
+        shard_files = os.listdir(ckdir)
+    out["wall_s"] = round(time.perf_counter() - t0, 1)
+    joined = "\n".join(outs)
+    if "Multiprocess computations aren't implemented" in joined:
+        # the jaxlib lacks cross-process CPU collectives (gloo knob
+        # absent/renamed — initialize_distributed tolerates that): an
+        # environment limitation, not a regression. Report skipped so
+        # the harness degrades the same way TestTwoProcessSmoke does.
+        out.update(skipped=True,
+                   reason="jaxlib lacks cross-process CPU collectives")
+        return out
+    out["ok"] = (
+        all(p.returncode == 0 for p in procs)
+        and "DISTRIBUTED DEMO PASS" in joined          # global-ring train
+        and joined.count("SHARDED CKPT RESUME OK") == 2  # per-shard ckpt
+        and joined.count("parity OK") == 2             # mesh ALS parity
+        and any(".shard0of2" in n for n in shard_files)
+        and any(".shard1of2" in n for n in shard_files)
+    )
+    if not out["ok"]:
+        out["error"] = ("rc=" + ",".join(str(p.returncode) for p in procs)
+                        + " tail=" + joined[-1500:])
+    return out
+
+
+def main(n_devices: int = 16, two_process: bool = True) -> dict:
+    sys.path.insert(0, REPO)
     from large_scale_recommendation_tpu.utils.platform import force_cpu
 
     force_cpu(n_devices=n_devices)
@@ -47,6 +135,19 @@ def main(n_devices: int = 16) -> dict:
     ge.dryrun_multichip(n_devices)
     out["dryrun_wall_s"] = round(time.perf_counter() - t0, 1)
 
+    # ---- partitioner rules-table resolution at N devices --------------
+    from large_scale_recommendation_tpu.parallel.partitioner import (
+        DEFAULT_RULES,
+        Partitioner,
+    )
+
+    part = Partitioner(num_devices=n_devices)
+    assert part.num_blocks == n_devices, dict(part.mesh.shape)
+    for logical, _role in DEFAULT_RULES:
+        part.sharding(logical)  # every logical axis must resolve
+    assert part.spec("users", "rank") == part.spec("items", "rank")
+    out["partitioner_axes_resolved"] = len(DEFAULT_RULES)
+
     # ---- pod-shaped at-scale pass ------------------------------------
     # 10:1 vocab at rank 128 with k = n_devices. nnz sized for geometry
     # validation (pads, divisibility, memory), not convergence: the
@@ -60,7 +161,6 @@ def main(n_devices: int = 16) -> dict:
         MeshDSGD,
         MeshDSGDConfig,
     )
-    from large_scale_recommendation_tpu.parallel.mesh import make_block_mesh
 
     import jax
 
@@ -84,7 +184,8 @@ def main(n_devices: int = 16) -> dict:
     jax.block_until_ready(p.sv)
     out["blocking_wall_s"] = round(time.perf_counter() - t0, 1)
     out["max_pad_ratio"] = round(float(p.max_pad_ratio), 3)
-    out["layout_mb"] = round(6 * p.sv.size * 4 / 2**20, 1)
+    out["layout_bytes"] = int(6 * p.sv.size * 4)
+    out["layout_mb"] = round(out["layout_bytes"] / 2**20, 1)
     # per-shard minibatch divisibility at high k: the padded block size
     # must honor minibatch_multiple exactly
     assert p.sv.shape[2] % mb == 0, (p.sv.shape, mb)
@@ -104,14 +205,25 @@ def main(n_devices: int = 16) -> dict:
     assert p.max_pad_ratio < max(2.0, 1.5 * rounding_floor), \
         (p.max_pad_ratio, rounding_floor)
 
-    mesh = make_block_mesh(k)
     cfg = MeshDSGDConfig(num_factors=rank, lambda_=0.1, iterations=4,
                          learning_rate=0.1, lr_schedule="constant",
                          seed=0, minibatch_size=mb, init_scale=0.08)
     t0 = time.perf_counter()
-    model = MeshDSGD(cfg, mesh=mesh).fit_device(
+    model = MeshDSGD(cfg, partitioner=part).fit_device(
         u, i, r, num_users, num_items)
-    out["train_wall_s"] = round(time.perf_counter() - t0, 1)
+    jax.block_until_ready((model.U, model.V))
+    train_wall = time.perf_counter() - t0  # rate from the UNROUNDED wall
+    out["train_wall_s"] = round(train_wall, 1)
+    # sweep throughput under the unified layer (includes the one-time
+    # compile, as every MULTICHIP round's wall always has — rounds
+    # compare like against like). The blocked nnz is the visit count.
+    # NOTE the block_until_ready above: the pre-refactor script stopped
+    # the clock on the async dispatch (obs disabled ⇒ the segment timer
+    # never synced), so its wall under-measured — this round starts the
+    # honest trajectory, and 1D-vs-2D interleaved reps measure the
+    # partitioner mesh at parity with the replaced hand-rolled ring.
+    out["train_ratings_per_s"] = round(
+        p.nnz * cfg.iterations / max(train_wall, 1e-9))
 
     # holdout-free sanity: finite factors, and the TRAIN risk moved below
     # the predict-zero plateau (data std) — geometry validation, not a
@@ -127,9 +239,47 @@ def main(n_devices: int = 16) -> dict:
     assert np.isfinite(rmse)
     assert rmse < data_std, (rmse, data_std)
 
-    print(json.dumps(out))
+    # ---- mesh-ALS throughput probe (second solver family) ------------
+    from large_scale_recommendation_tpu.core.generators import (
+        SyntheticMFGenerator,
+    )
+    from large_scale_recommendation_tpu.models.als import ALSConfig
+    from large_scale_recommendation_tpu.parallel.als_mesh import MeshALS
+
+    als_nu, als_ni, als_iters = 4_000, 2_000, 2
+    als_ratings = SyntheticMFGenerator(
+        num_users=als_nu, num_items=als_ni, rank=8, noise=0.1,
+        seed=2).generate(400_000)
+    t0 = time.perf_counter()
+    als_model = MeshALS(
+        ALSConfig(num_factors=32, lambda_=0.1, iterations=als_iters,
+                  seed=0),
+        partitioner=part).fit(als_ratings)
+    jax.block_until_ready((als_model.U, als_model.V))
+    als_wall = time.perf_counter() - t0
+    out["als_wall_s"] = round(als_wall, 1)
+    out["als_rows_per_s"] = round(
+        (als_nu + als_ni) * als_iters / max(als_wall, 1e-9))
+    assert np.isfinite(als_model.rmse(als_ratings))
+
+    # ---- 2-process local cluster -------------------------------------
+    if not two_process or os.environ.get("LSR_DRYRUN_NO_2PROC"):
+        out["two_process"] = {"skipped": True,
+                              "reason": "disabled by flag/env"}
+    else:
+        out["two_process"] = run_two_process_pass()
+        assert out["two_process"].get("ok") or \
+            out["two_process"].get("skipped"), out["two_process"]
+
+    # machine-readable contract (same as bench.py::_emit_final and
+    # scripts/pallas_probe.py): flush stderr BEFORE the final JSON line
+    # so wrappers that merge 2>&1 still parse the LAST line
+    sys.stderr.flush()
+    print(json.dumps(out), flush=True)
     return out
 
 
 if __name__ == "__main__":
-    main(int(sys.argv[1]) if len(sys.argv) > 1 else 16)
+    args = [a for a in sys.argv[1:] if not a.startswith("--")]
+    main(int(args[0]) if args else 16,
+         two_process="--no-two-process" not in sys.argv)

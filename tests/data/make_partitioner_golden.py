@@ -1,0 +1,102 @@
+"""Golden generator for the Partitioner equivalence pins (ISSUE 7).
+
+Run BEFORE (to capture the hand-rolled-sharding outputs) and compared
+AFTER the unified-Partitioner refactor: the refactor only changes how
+``NamedSharding``s are constructed — same mesh, same specs, same jitted
+computations — so the outputs must match **bit for bit**.
+
+    python tests/data/make_partitioner_golden.py   # writes partitioner_golden.npz
+
+The workloads deliberately use only the stable public surfaces
+(``make_block_mesh``, ``MeshDSGD``, ``MeshALS``, ``mesh_top_k_recommend``)
+that survive the refactor unchanged, and run in the same environment as
+tier-1 (8 virtual CPU devices, x64 off) so the pins replay in-suite.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+GOLDEN = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                      "partitioner_golden.npz")
+
+
+def run_workloads(mesh_factory):
+    """The three mesh workloads pinned by the equivalence tests, run over
+    ``mesh_factory(n_devices)``-built meshes. Returns {name: np.ndarray}.
+    One copy shared by the generator and tests/test_partitioner.py so the
+    pinned configs cannot drift from the goldens."""
+    import numpy as np
+
+    from large_scale_recommendation_tpu.core.generators import (
+        SyntheticMFGenerator,
+    )
+    from large_scale_recommendation_tpu.models.als import ALSConfig
+    from large_scale_recommendation_tpu.parallel.als_mesh import MeshALS
+    from large_scale_recommendation_tpu.parallel.dsgd_mesh import (
+        MeshDSGD,
+        MeshDSGDConfig,
+    )
+    from large_scale_recommendation_tpu.parallel.serving import (
+        mesh_top_k_recommend,
+    )
+
+    out: dict = {}
+    gen = SyntheticMFGenerator(num_users=120, num_items=90, rank=6,
+                               noise=0.1, seed=3)
+    train = gen.generate(6000)
+    ru, ri, rv, _ = train.to_numpy()
+
+    # mesh DSGD, host-blocked path
+    dcfg = MeshDSGDConfig(num_factors=6, lambda_=0.01, iterations=3,
+                          learning_rate=0.05, lr_schedule="constant",
+                          seed=0, minibatch_size=128, init_scale=0.3)
+    m = MeshDSGD(dcfg, mesh=mesh_factory(4)).fit(train)
+    out["dsgd_U"], out["dsgd_V"] = np.asarray(m.U), np.asarray(m.V)
+
+    # mesh DSGD, device-blocked path
+    md = MeshDSGD(dcfg, mesh=mesh_factory(4)).fit_device(
+        ru, ri, rv, 120, 90)
+    out["dsgd_dev_U"] = np.asarray(md.U)
+    out["dsgd_dev_V"] = np.asarray(md.V)
+
+    # mesh ALS
+    acfg = ALSConfig(num_factors=6, lambda_=0.05, iterations=3, seed=0)
+    ma = MeshALS(acfg, mesh=mesh_factory(4)).fit(train)
+    out["als_U"], out["als_V"] = np.asarray(ma.U), np.asarray(ma.V)
+
+    # mesh serving over a fixed random catalog (exclusions exercised)
+    rng = np.random.default_rng(7)
+    U = rng.normal(size=(60, 6)).astype(np.float32)
+    V = rng.normal(size=(83, 6)).astype(np.float32)
+    rows, scores = mesh_top_k_recommend(
+        U, V, np.arange(40, dtype=np.int32), k=7, chunk=16,
+        train_u=ru[:400] % 60, train_i=ri[:400] % 83,
+        mesh=mesh_factory(4))
+    out["serve_rows"], out["serve_scores"] = rows, scores
+    return out
+
+
+def main() -> None:
+    from large_scale_recommendation_tpu.utils.platform import force_cpu
+
+    os.environ.setdefault("JAX_ENABLE_X64", "0")
+    force_cpu(n_devices=8)
+
+    import numpy as np
+
+    from large_scale_recommendation_tpu.parallel.mesh import make_block_mesh
+
+    arrays = run_workloads(make_block_mesh)
+    np.savez(GOLDEN, **arrays)
+    print(f"wrote {GOLDEN}: " + ", ".join(
+        f"{k}{v.shape}" for k, v in arrays.items()))
+
+
+if __name__ == "__main__":
+    main()

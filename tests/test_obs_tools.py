@@ -170,6 +170,85 @@ class TestGateEndToEnd:
         assert regress_main(args) == 0
         assert regress_main(args + ["--strict"]) == 1
 
+    def test_multichip_family_gates_pad_and_throughput(self, tmp_path,
+                                                       capsys):
+        """--family multichip (ISSUE 7): MULTICHIP_r*.json rounds gate
+        through the same loader with pad ratio LOWER-is-better and
+        sharded throughput HIGHER-is-better."""
+        base = {"n_devices": 16, "max_pad_ratio": 1.10, "layout_mb": 600.0,
+                "train_ratings_per_s": 500_000.0, "als_rows_per_s": 9000.0}
+        # a pad-ratio blowup alone must trip the gate
+        cur = dict(base, max_pad_ratio=1.60)
+        b, c = tmp_path / "MULTICHIP_r01.json", tmp_path / "MULTICHIP_r02.json"
+        b.write_text(json.dumps(base))
+        c.write_text(json.dumps(cur))
+        rc = regress_main(["--family", "multichip",
+                           "--baseline", str(b), "--current", str(c)])
+        assert rc == 1
+        assert "max_pad_ratio" in capsys.readouterr().out
+        # a throughput collapse must trip it too
+        c.write_text(json.dumps(dict(base, train_ratings_per_s=100_000.0)))
+        assert regress_main(["--family", "multichip",
+                             "--baseline", str(b),
+                             "--current", str(c)]) == 1
+        # better pad ratio AND faster training is never a regression
+        c.write_text(json.dumps(dict(base, max_pad_ratio=1.02,
+                                     train_ratings_per_s=900_000.0)))
+        assert regress_main(["--family", "multichip",
+                             "--baseline", str(b),
+                             "--current", str(c)]) == 0
+
+    def test_multichip_direction_rules(self):
+        """Pad/layout keys are lower-is-better; the sharded throughput
+        keys stay higher-is-better; all are in the default watch set."""
+        from scripts.bench_regress import (
+            MULTICHIP_KEYS,
+            is_lower_better,
+        )
+
+        for key in ("max_pad_ratio", "layout_mb", "layout_bytes"):
+            assert is_lower_better(key, set()), key
+        for key in ("train_ratings_per_s", "als_rows_per_s"):
+            assert not is_lower_better(key, set()), key
+        for key in ("train_ratings_per_s", "als_rows_per_s",
+                    "max_pad_ratio", "layout_mb"):
+            assert key in MULTICHIP_KEYS
+
+    def test_multichip_find_rounds_and_legacy_wrappers(self, tmp_path):
+        """find_rounds(prefix=) orders MULTICHIP rounds; the committed
+        legacy wrapper shape ({n_devices, rc, ok, tail}) still loads
+        (empty metrics -> 'missing' verdicts, never a crash)."""
+        from scripts.bench_regress import find_rounds
+
+        for n in (2, 1, 10):
+            (tmp_path / f"MULTICHIP_r{n:02d}.json").write_text("{}")
+        (tmp_path / "BENCH_r01.json").write_text("{}")
+        rounds = find_rounds(str(tmp_path), prefix="MULTICHIP")
+        assert [os.path.basename(p) for p in rounds] == [
+            "MULTICHIP_r01.json", "MULTICHIP_r02.json",
+            "MULTICHIP_r10.json"]
+        legacy = tmp_path / "MULTICHIP_r00.json"
+        legacy.write_text(json.dumps(
+            {"n_devices": 8, "rc": 0, "ok": True, "skipped": False,
+             "tail": ""}))
+        flat, caveat = load_result(str(legacy))
+        assert flat == {} and caveat is None
+        rows = compare(flat, {"max_pad_ratio": 1.2}, {"max_pad_ratio": 10.0})
+        assert rows[0]["verdict"] == "missing"
+
+    def test_multichip_wrapper_tail_salvage(self, tmp_path):
+        """A future driver wrapper whose tail holds the pod_dryrun JSON
+        line salvages the numeric fields through the shared loader."""
+        tail = ('{"n_devices": 16, "max_pad_ratio": 1.104, '
+                '"train_ratings_per_s": 421337, "two_process": '
+                '{"ok": true, "wall_s": 38.2}}')
+        p = tmp_path / "MULTICHIP_r03.json"
+        p.write_text(json.dumps({"n": 16, "rc": 0, "tail": tail,
+                                 "parsed": None}))
+        flat, _ = load_result(str(p))
+        assert flat["max_pad_ratio"] == 1.104
+        assert flat["train_ratings_per_s"] == 421337
+
     def test_real_rounds_parse(self):
         """Every committed *successful* BENCH_r*.json loads into a
         non-empty flat metric dict — the gate can always read the
