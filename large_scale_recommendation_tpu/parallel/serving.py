@@ -112,6 +112,37 @@ class ShardedCatalog:
     version: int = 0
     dtype: str = "float32"
 
+    def apply_delta(self, rows, values,
+                    version: int | None = None) -> "ShardedCatalog":
+        """Install ONLY the given catalog rows (the delta-swap half of
+        the streaming ingest→serve handoff): scatter ``values`` (full
+        precision; cast to the catalog dtype here, same as a build)
+        into the sharded table and restamp the version. One device-side
+        scatter — no host device_put of the full table, no mask/pad
+        recompute, and the result is BIT-EQUIVALENT to rebuilding from
+        the patched source table (test-pinned; the scatter output keeps
+        the block sharding, re-pinned explicitly so the scoring step's
+        executables see the exact same layout). Geometry must be
+        unchanged — vocab growth is a full-rebuild event, callers
+        (``ServingEngine.apply_delta``) fall back on shape mismatch.
+
+        ``version`` defaults to a fresh ``catalog_version`` token of
+        the new sharded array — pass the patched source table's token
+        when you have one, so engine and quantized catalogs agree."""
+        rows = np.asarray(rows)
+        if len(rows) == 0:
+            return dataclasses.replace(
+                self, version=(catalog_version(self.V_sh)
+                               if version is None else version))
+        part = as_partitioner(self.mesh)
+        vals = jnp.asarray(values).astype(self.V_sh.dtype)
+        V_new = self.V_sh.at[jnp.asarray(rows)].set(vals)
+        V_new = part.shard(V_new, "items", "rank")
+        return dataclasses.replace(
+            self, V_sh=V_new,
+            version=(catalog_version(V_new) if version is None
+                     else version))
+
 
 def shard_catalog(V, mesh=None, item_mask=None,
                   dtype=None) -> ShardedCatalog:

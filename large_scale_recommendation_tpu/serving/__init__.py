@@ -3,10 +3,42 @@
 ``parallel.serving`` is the *mechanism* — one mesh-sharded scoring step
 over a prepared catalog. This package is the *engine* around it: request
 micro-batching into pow2 buckets (bounded executable family), versioned
-catalog refresh after retrains, opt-in bf16 catalogs, and sustained-
-throughput accounting. See ``serving.engine.ServingEngine``.
+catalog refresh after retrains, opt-in bf16 catalogs, sustained-
+throughput accounting — plus the production-traffic layer ROADMAP item 3
+named: an int8 score-then-rescore retrieval fast path
+(``serving.retrieval``), SLO-burn-driven admission control
+(``serving.admission``), and delta catalog swaps
+(``ServingEngine.apply_delta``). See ``serving.engine.ServingEngine``.
 """
 
-from large_scale_recommendation_tpu.serving.engine import ServingEngine
+from large_scale_recommendation_tpu.serving.admission import (
+    AdmissionConfig,
+    AdmissionController,
+    AdmissionRejectedError,
+)
+from large_scale_recommendation_tpu.serving.engine import (
+    RecResult,
+    ServingEngine,
+)
+from large_scale_recommendation_tpu.serving.retrieval import (
+    QuantizedCatalog,
+    RetrievalConfig,
+    TwoStageRetriever,
+    build_quantized_catalog,
+    quantize_rows,
+    recall_at_k,
+)
 
-__all__ = ["ServingEngine"]
+__all__ = [
+    "AdmissionConfig",
+    "AdmissionController",
+    "AdmissionRejectedError",
+    "QuantizedCatalog",
+    "RecResult",
+    "RetrievalConfig",
+    "ServingEngine",
+    "TwoStageRetriever",
+    "build_quantized_catalog",
+    "quantize_rows",
+    "recall_at_k",
+]

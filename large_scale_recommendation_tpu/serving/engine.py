@@ -32,10 +32,30 @@ needs its own batching/caching engine, not per-call model invocation):
   (same pattern as ``mesh_top_k_recommend``'s chunk loop), with buffer
   donation on non-CPU meshes.
 
+- **two-stage fast path** (``retrieval=RetrievalConfig(...)``) — stage 1
+  scores an int8-quantized catalog (optionally routed through a
+  k-means-clustered MIPS index, ``serving.retrieval``) for
+  ``k·overfetch`` candidates; stage 2 rescores them exactly in f32.
+  Per-request cost stops scaling with the catalog; recall@k vs the
+  exact path is test-pinned (≥0.95 at overfetch 4).
+- **admission control** (``admission=AdmissionController(...)``) — the
+  SLO error budget (``obs.health.SLOTracker``) drives a brownout
+  ladder: widen batching → serve stage-1-only (results flagged
+  ``degraded``) → reject with ``AdmissionRejectedError``
+  (``serving.admission``).
+- **delta catalog swaps** (``apply_delta``) — install only the rows
+  touched since the last version (the streaming driver knows them from
+  its WAL batches): one device scatter per table plus re-quantization
+  of exactly the dirty int8 rows — no full-table rebuild, zero
+  recompiles, bit-equivalent to a rebuild (test-pinned).
+
 Throughput accounting lives in ``stats`` (requests, rows, micro-batches,
-bucket histogram) plus ``executable_variants`` — the number of compiled
-shape variants actually backing the stream, the O(#buckets) pin the
-compile-count regression test asserts on.
+bucket histogram, delta swaps) plus ``executable_variants`` — the number
+of compiled shape variants actually backing the stream, the O(#buckets)
+pin the compile-count regression test asserts on. Results are
+``RecResult`` tuples — ``(ids, scores[, mask])`` exactly as before, plus
+``.catalog_version`` (which build answered; clients detect mid-flight
+swaps) and ``.degraded`` (stage-1-only admission fallback) attributes.
 """
 
 from __future__ import annotations
@@ -61,11 +81,36 @@ from large_scale_recommendation_tpu.parallel.serving import (
     run_pipelined_topk,
     shard_catalog,
 )
+from large_scale_recommendation_tpu.serving.admission import (
+    AdmissionController,
+)
+from large_scale_recommendation_tpu.serving.retrieval import (
+    RetrievalConfig,
+    TwoStageRetriever,
+)
 from large_scale_recommendation_tpu.utils.metrics import (
     ThroughputMeter,
     _exclusion_builder,
 )
 from large_scale_recommendation_tpu.utils.shapes import pow2_buckets, pow2_pad
+
+
+class RecResult(tuple):
+    """One request's result: unpacks exactly like the historical
+    ``(ids, scores)`` / ``(ids, scores, mask)`` tuples, with serving
+    metadata on top — ``catalog_version`` (the build that answered;
+    compare across requests to detect a mid-flight swap) and
+    ``degraded`` (True when admission control served stage-1-only
+    approximate scores)."""
+
+    catalog_version: int
+    degraded: bool
+
+    def __new__(cls, parts, catalog_version: int, degraded: bool = False):
+        self = tuple.__new__(cls, parts)
+        self.catalog_version = int(catalog_version)
+        self.degraded = bool(degraded)
+        return self
 
 
 class ServingEngine:
@@ -78,8 +123,12 @@ class ServingEngine:
     as ``MFModel.recommend``), ``dtype`` (``"bfloat16"`` opts into the
     half-width catalog), ``max_batch``/``min_bucket`` (the pow2 bucket
     policy — ``max_batch`` must be a power of two), ``slo`` (an
-    ``obs.health.SLOTracker``; every flush's synced wall is recorded
-    into its attainment window).
+    ``obs.health.SLOTracker``; every flushed REQUEST's end-to-end
+    latency — queue wait since submit plus the synced flush wall — is
+    recorded into its attainment window), ``retrieval`` (a
+    ``RetrievalConfig`` or ``"two_stage"``: the int8 score-then-rescore
+    fast path), ``admission`` (an ``AdmissionController``: the SLO-burn
+    brownout ladder).
 
     Results carry the ``recommend`` conventions exactly: int64 ids,
     unknown users → -1/0.0 rows, below-catalog slots → -1/0.0.
@@ -92,7 +141,8 @@ class ServingEngine:
 
     def __init__(self, model: MFModel, k: int = 10, mesh=None,
                  train=None, dtype=None, max_batch: int = 1024,
-                 min_bucket: int = 8, slo=None):
+                 min_bucket: int = 8, slo=None, retrieval=None,
+                 admission: AdmissionController | None = None):
         if max_batch & (max_batch - 1):
             raise ValueError(f"max_batch must be a power of two, "
                              f"got {max_batch}")
@@ -100,6 +150,18 @@ class ServingEngine:
             raise ValueError(f"min_bucket must be a power of two in "
                              f"[1, max_batch], got {min_bucket}")
         self.k = int(k)
+        # two-stage fast path: a RetrievalConfig (or "two_stage" for the
+        # defaults) swaps the exact mesh scorer for int8
+        # score-then-rescore (serving.retrieval). None = exact path,
+        # byte-for-byte the historical engine.
+        if retrieval == "two_stage":
+            retrieval = RetrievalConfig()
+        if retrieval is not None and not isinstance(retrieval,
+                                                    RetrievalConfig):
+            raise TypeError(f"retrieval must be a RetrievalConfig or "
+                            f"'two_stage', got {type(retrieval).__name__}")
+        self._retrieval_cfg: RetrievalConfig | None = retrieval
+        self._retriever: TwoStageRetriever | None = None
         # ``mesh`` accepts a raw Mesh (legacy), a Partitioner, or None
         # (default global partitioner) — the catalog and the scoring step
         # resolve their shardings through the partitioner's rules table
@@ -113,10 +175,16 @@ class ServingEngine:
         self._dtype = jnp.dtype(dtype or jnp.float32)
         self._train = train
         self._pending: list[np.ndarray] = []
-        self._pending_t: list[float] = []  # submit stamps (obs-enabled only)
+        # submit stamps: one clock read per request, consumed at flush —
+        # the queue-wait half of the per-REQUEST latency the SLO tracker
+        # records (flush wall alone recovers the moment shedding shrinks
+        # batches, which let the admission ladder relax while backlogged
+        # requests were still seconds late — measured in the traffic sim)
+        self._pending_t: list[float] = []
         self._lock = threading.RLock()
         self.stats = {"requests": 0, "rows": 0, "microbatches": 0,
-                      "refreshes": 0, "buckets": {}}
+                      "flushes": 0, "refreshes": 0, "delta_swaps": 0,
+                      "buckets": {}}
         self.meter = ThroughputMeter()
         # observability binds at CONSTRUCTION: with the default null
         # registry the handles below are shared no-op singletons and
@@ -135,10 +203,23 @@ class ServingEngine:
         self._m_requests = obs.counter("serving_requests_total")
         self._m_rows = obs.counter("serving_rows_total")
         self._obs = obs
-        # SLO wiring (obs.health.SLOTracker): each flush's synced wall —
-        # already measured for the meter, so attaching a tracker adds no
-        # clock reads — feeds the sliding attainment window. None (the
-        # default) is one pointer test per flush: zero-cost when unused.
+        # SLO wiring (obs.health.SLOTracker): each flushed request's
+        # end-to-end latency (submit stamp → synced flush end) feeds
+        # the sliding attainment window. None (the default) is one
+        # pointer test per flush: no tracker, no recording.
+        # An admission controller brings its own tracker: when no
+        # separate slo was given, the engine records into the
+        # controller's, so the burn the ladder reads is the burn this
+        # engine produces (pass both only if they share a tracker).
+        self._admission = admission
+        # _slo_adopted marks a tracker taken FROM a controller (vs an
+        # explicit slo= argument, which the caller owns): only adopted
+        # trackers are rebound when attach_admission swaps controllers —
+        # otherwise the swapped-in ladder would read a tracker nobody
+        # records into and sit at "normal" through any overload.
+        self._slo_adopted = slo is None and admission is not None
+        if self._slo_adopted:
+            slo = admission.slo
         self._slo = slo
         # swap-observation hook: called as ``on_refresh(version)`` after
         # every successful refresh, INSIDE the engine lock so concurrent
@@ -172,7 +253,7 @@ class ServingEngine:
             if self._events is not None:
                 swap_detail = {"version": version,
                                "refreshes": self.stats["refreshes"],
-                               "rows": int(self._catalog.n_rows)}
+                               "rows": int(self.catalog_rows)}
         if swap_detail is not None:
             # journaled OUTSIDE the engine lock: the emit may hit the
             # journal's JSONL disk mirror, and every submit/flush/serve
@@ -185,21 +266,35 @@ class ServingEngine:
             self.model = model
         model = self.model
         self._item_ids_of_row = np.asarray(model.items.ids)
-        self._catalog = shard_catalog(
-            model.V, self.partitioner,
-            item_mask=self._item_ids_of_row >= 0,
-            dtype=self._dtype)
-        U = jnp.asarray(model.U)
-        self._U = U.astype(self._dtype) if U.dtype != self._dtype else U
+        item_mask = self._item_ids_of_row >= 0
+        if self._retrieval_cfg is not None:
+            # fast path: int8 stage-1 structure + f32 rescore table
+            # (serving.retrieval; single-host replicated — the int8
+            # catalog is ~4× smaller than the f32 one the mesh path
+            # shards). ``dtype`` doesn't apply: stage 1 is already
+            # int8 and stage 2 must rescore full-precision.
+            self._catalog = None
+            self._retriever = TwoStageRetriever(
+                model.V, item_mask=item_mask,
+                config=self._retrieval_cfg)
+            U = jnp.asarray(model.U)
+            self._U = (U.astype(jnp.float32)
+                       if U.dtype != jnp.float32 else U)
+        else:
+            self._catalog = shard_catalog(
+                model.V, self.partitioner, item_mask=item_mask,
+                dtype=self._dtype)
+            U = jnp.asarray(model.U)
+            self._U = U.astype(self._dtype) if U.dtype != self._dtype else U
+            n_dev = self.partitioner.num_blocks
+            rpb = self._catalog.rows_per_shard
+            self._k_local = min(self.k, rpb)
+            self._k_out = min(self.k, n_dev * self._k_local)
+            self._step = _mesh_topk_step(
+                self.mesh, self._k_local, self._k_out, rpb,
+                donate=mesh_supports_donation(self.mesh))
         tu, ti = model._train_rows(self._train)
         self._build_excl = _exclusion_builder(tu, ti, int(U.shape[0]))
-        n_dev = self.partitioner.num_blocks
-        rpb = self._catalog.rows_per_shard
-        self._k_local = min(self.k, rpb)
-        self._k_out = min(self.k, n_dev * self._k_local)
-        self._step = _mesh_topk_step(
-            self.mesh, self._k_local, self._k_out, rpb,
-            donate=mesh_supports_donation(self.mesh))
         self.stats["refreshes"] += 1
         if self._obs_on:
             # version-labeled swap counter: the serving-side proof of
@@ -211,30 +306,147 @@ class ServingEngine:
                                 version=self.version)
         return self.version
 
+    def apply_delta(self, item_rows=None, V_rows=None,
+                    user_rows=None, U_rows=None) -> int:
+        """Install ONLY the touched factor rows — the streaming
+        ingest→serve handoff without a whole-table rebuild. ``*_rows``
+        are indices into the bound model's row space (geometry must be
+        unchanged; vocab growth is a full ``refresh``), ``V_rows`` /
+        ``U_rows`` the matching full-precision factors. The bound
+        model's arrays are patched too (so a later ``refresh()``
+        re-shards the post-delta state, never silently reverts it),
+        the catalog version restamps from the patched table, and the
+        fast path re-quantizes exactly the dirty int8 rows. Zero
+        recompiles — executables are keyed on shapes, and a delta
+        never changes one. Returns the new catalog version (reported
+        to ``on_refresh``, same as a full refresh)."""
+        swap_detail = None
+        with self._lock:
+            model = self.model
+            n_items = int(model.V.shape[0])
+            n_users = int(model.U.shape[0])
+            if item_rows is not None and len(item_rows):
+                item_rows = np.asarray(item_rows)
+                if item_rows.max() >= n_items:
+                    raise ValueError(
+                        f"delta item row {int(item_rows.max())} outside "
+                        f"catalog of {n_items} rows — vocab grew; use "
+                        f"refresh()")
+                vals = jnp.asarray(V_rows)
+                idx = jnp.asarray(item_rows)
+                V = jnp.asarray(model.V)
+                model.V = V.at[idx].set(vals.astype(V.dtype))
+                version = catalog_version(model.V)
+                if self._catalog is not None:
+                    self._catalog = self._catalog.apply_delta(
+                        item_rows, vals, version=version)
+                else:
+                    self._retriever.apply_delta(item_rows, vals, version)
+            if user_rows is not None and len(user_rows):
+                user_rows = np.asarray(user_rows)
+                if user_rows.max() >= n_users:
+                    raise ValueError(
+                        f"delta user row {int(user_rows.max())} outside "
+                        f"table of {n_users} rows — vocab grew; use "
+                        f"refresh()")
+                uvals = jnp.asarray(U_rows)
+                uidx = jnp.asarray(user_rows)
+                U = jnp.asarray(model.U)
+                model.U = U.at[uidx].set(uvals.astype(U.dtype))
+                self._U = self._U.at[uidx].set(
+                    uvals.astype(self._U.dtype))
+            self.stats["delta_swaps"] += 1
+            version = self.version
+            hook = self.on_refresh
+            if hook is not None:
+                hook(version)
+            if self._obs_on:
+                self._obs.counter("serving_catalog_delta_total").inc()
+                self._obs.gauge("serving_catalog_version").set(version)
+            if self._events is not None:
+                swap_detail = {
+                    "version": version,
+                    "item_rows": int(0 if item_rows is None
+                                     else len(item_rows)),
+                    "user_rows": int(0 if user_rows is None
+                                     else len(user_rows)),
+                    "delta_swaps": self.stats["delta_swaps"]}
+        if swap_detail is not None:
+            # journaled OUTSIDE the engine lock, same rule as refresh()
+            self._events.emit("serving.catalog_delta", **swap_detail)
+        return version
+
     @property
     def version(self) -> int:
         """The bound catalog's version token (``catalog_version``)."""
-        return self._catalog.version
+        if self._catalog is not None:
+            return self._catalog.version
+        return self._retriever.version
+
+    @property
+    def admission(self) -> AdmissionController | None:
+        """The attached admission controller (None = no ladder)."""
+        return self._admission
+
+    @property
+    def retriever(self):
+        """The two-stage fast path's ``TwoStageRetriever`` (None on
+        the exact path) — its ``catalog.stats`` carry the index
+        geometry the bench publishes."""
+        return self._retriever
+
+    @property
+    def catalog_rows(self) -> int:
+        """Real catalog height of the bound build (either path)."""
+        if self._catalog is not None:
+            return self._catalog.n_rows
+        return self._retriever.n_rows
 
     @property
     def executable_variants(self) -> int:
         """Compiled shape variants behind the bound scoring step — grows
         with the bucket family (O(#buckets)), NOT the request count.
-        The step is shared per (mesh, geometry): other same-geometry
-        users of this mesh (another engine, per-call recommend) add
-        their shape variants to this count too."""
+        Exact path: the per-mesh step cache (shared per (mesh,
+        geometry): other same-geometry users of this mesh add their
+        shape variants to this count too). Fast path: the distinct
+        (layout, bucket, candidate-width) shapes THIS retriever
+        dispatched (the module-level jits additionally share compiled
+        code across engines — this counts what the engine asked for)."""
+        if self._retriever is not None:
+            return len(self._retriever.buckets_seen)
         return self._step._cache_size()
+
+    def attach_admission(self, controller: AdmissionController) -> None:
+        """Arm (or swap) admission control on a live engine — the
+        traffic-simulator idiom: probe raw capacity admission-free,
+        then attach the controller without rebuilding the catalog.
+        Unless the constructor was given its own ``slo=`` tracker, the
+        controller's tracker becomes the engine's — INCLUDING on a
+        swap, so a newly attached ladder always reads the burn this
+        engine's flushes produce (a previously adopted tracker would
+        otherwise keep receiving the samples while the new ladder
+        starved below its warmup guard forever)."""
+        with self._lock:
+            self._admission = controller
+            if controller is not None and (self._slo is None
+                                           or self._slo_adopted):
+                self._slo = controller.slo
+                self._slo_adopted = True
 
     # -- request intake ------------------------------------------------------
 
     def submit(self, user_ids) -> int:
         """Queue one request; returns its index into ``flush()``'s
         result list. Nothing runs until ``flush`` (or ``recommend``/
-        ``serve``, which flush for you)."""
+        ``serve``, which flush for you). With admission control at the
+        ``shed`` level this raises ``AdmissionRejectedError`` — already
+        queued requests still flush (shedding bounds the queue, it
+        never drops accepted work)."""
+        if self._admission is not None:
+            self._admission.check_admit()  # raises when shedding
         with self._lock:
             self._pending.append(np.asarray(user_ids))
-            if self._obs_on:  # queue-wait stamp, consumed at flush
-                self._pending_t.append(time.perf_counter())
+            self._pending_t.append(time.perf_counter())
             return len(self._pending) - 1
 
     def recommend(self, user_ids, return_mask: bool = False):
@@ -250,41 +462,80 @@ class ServingEngine:
     def serve(self, requests, return_mask: bool = False) -> list:
         """Serve an iterable of requests, coalescing them into shared
         micro-batches: rows from small adjacent requests pack into one
-        padded kernel call. Returns one result tuple per request, in
-        order. Requests already queued via ``submit`` are served in the
-        same pass but NOT returned here — ``flush()`` first if you need
-        their results. Holds the engine lock for the whole stream, so
-        concurrent producers cannot interleave tickets into this
-        stream's flushes."""
+        padded kernel call. Returns one result per request, in order —
+        a ``RecResult`` normally, or the ``AdmissionRejectedError``
+        INSTANCE for a request the admission ladder shed (the ladder
+        can flip mid-stream via the per-flush ``observe``; raising
+        there would discard every already-computed result and leave
+        this stream's unflushed tickets to misalign the next caller's
+        ``flush()``). Requests already queued via ``submit`` are served
+        in the same pass but NOT returned here — ``flush()`` first if
+        you need their results. Holds the engine lock for the whole
+        stream, so concurrent producers cannot interleave tickets into
+        this stream's flushes."""
+        from large_scale_recommendation_tpu.serving.admission import (
+            AdmissionRejectedError,
+        )
+
         with self._lock:
             out: list = []
+            next_fill = 0  # first not-yet-filled placeholder in out
             queued_rows = 0
             skip = len(self._pending)  # pre-queued tickets: not ours
+
+            def drain():
+                nonlocal skip, queued_rows, next_fill
+                for res in self.flush(return_mask=return_mask)[skip:]:
+                    while out[next_fill] is not None:
+                        next_fill += 1  # skip shed markers
+                    out[next_fill] = res
+                skip = 0
+                queued_rows = 0
+
             for r in requests:
                 r = np.asarray(r)
-                self.submit(r)
-                queued_rows += len(r)
-                if queued_rows >= self.max_batch:
-                    out.extend(self.flush(return_mask=return_mask)[skip:])
-                    skip = 0
-                    queued_rows = 0
+                try:
+                    self.submit(r)
+                    out.append(None)  # filled by the covering flush
+                    queued_rows += len(r)
+                except AdmissionRejectedError as e:
+                    out.append(e)
+                    continue
+                # under admission WIDEN the flush threshold stretches to
+                # widen_factor × max_batch: more rows coalesce per
+                # flush (fewer dispatches, fuller buckets) at the cost
+                # of per-request latency — the cheapest throughput the
+                # brownout ladder can buy
+                limit = self.max_batch
+                if self._admission is not None:
+                    limit = int(limit * self._admission.widen_factor)
+                if queued_rows >= limit:
+                    drain()
             if self._pending:
-                out.extend(self.flush(return_mask=return_mask)[skip:])
+                drain()
             return out
 
     # -- execution -----------------------------------------------------------
 
     def flush(self, return_mask: bool = False) -> list:
         """Run every queued request through bucketed micro-batches and
-        return their results in submit order. Holds the engine lock:
-        the whole flush serves from one catalog version."""
+        return their results in submit order (``RecResult`` tuples —
+        ``(ids, scores[, mask])`` plus the serving catalog version and
+        the degraded flag). Holds the engine lock: the whole flush
+        serves from one catalog version — the version every result of
+        this flush carries."""
         with self._lock:
             requests, self._pending = self._pending, []
             if not requests:
                 return []
+            # the admission level is read ONCE per flush: every result
+            # of a flush is uniformly exact or uniformly degraded
+            degraded = (self._admission is not None
+                        and self._admission.degrade_active
+                        and self._retriever is not None)
             t0 = time.perf_counter()
+            stamps, self._pending_t = self._pending_t, []
             if self._obs_on:
-                stamps, self._pending_t = self._pending_t, []
                 for ts in stamps:
                     self._m_qwait.observe(t0 - ts)
             # id → row space per request, then one shared row stream:
@@ -305,26 +556,49 @@ class ServingEngine:
             if self._trace.enabled:
                 # compile-keyed: the first flush at a fresh catalog
                 # geometry carries the bucket family's XLA compiles
+                geom = (self._catalog.rows_per_shard
+                        if self._catalog is not None
+                        else self._retriever.n_rows)
                 with self._trace.span(
                         "serving/flush",
-                        key=("serving_flush", self._catalog.rows_per_shard),
+                        key=("serving_flush", geom),
                         rows=len(rows_all), requests=len(requests)):
-                    top_rows, top_scores = self._serve_rows(rows_all)
+                    top_rows, top_scores = self._serve_rows(
+                        rows_all, stage1_only=degraded)
             else:
-                top_rows, top_scores = self._serve_rows(rows_all)
+                top_rows, top_scores = self._serve_rows(
+                    rows_all, stage1_only=degraded)
+            version = self.version
             results = []
             for (n_ids, known), b0, b1 in zip(known_masks, bounds,
                                               bounds[1:]):
-                results.append(_assemble_topk(
-                    n_ids, self.k, known, top_rows[b0:b1],
-                    top_scores[b0:b1], self._item_ids_of_row,
-                    return_mask))
+                results.append(RecResult(
+                    _assemble_topk(
+                        n_ids, self.k, known, top_rows[b0:b1],
+                        top_scores[b0:b1], self._item_ids_of_row,
+                        return_mask),
+                    catalog_version=version, degraded=degraded))
             self.stats["requests"] += len(requests)
             self.stats["rows"] += len(rows_all)
+            self.stats["flushes"] += 1
             wall = time.perf_counter() - t0
+            end = t0 + wall
             self.meter.record(len(rows_all), wall)
             if self._slo is not None:
-                self._slo.record(wall)
+                # one sample per REQUEST: queue wait since submit plus
+                # the flush wall — the latency a client saw. Tracking
+                # the flush wall alone would let the burn recover while
+                # a backlog is still seconds deep (shedding shrinks
+                # batches, walls look great, clients still suffer).
+                for ts in stamps:
+                    self._slo.record(end - ts)
+            if self._admission is not None:
+                # the burn just moved — re-evaluate the ladder while the
+                # lock is held, so the level the NEXT submit sees is
+                # consistent with this flush's latency
+                if degraded:
+                    self._admission.count_degraded(len(requests))
+                self._admission.observe()
             if self._obs_on:
                 # results are host numpy by here, so the flush wall is a
                 # SYNCED end-to-end latency, not a dispatch time
@@ -333,12 +607,40 @@ class ServingEngine:
                 self._m_rows.inc(len(rows_all))
             return results
 
-    def _serve_rows(self, user_rows: np.ndarray):
+    def _serve_rows(self, user_rows: np.ndarray,
+                    stage1_only: bool = False):
         """Row-space scoring through pow2-bucketed micro-batches, on the
         shared two-deep dispatch pipeline (``run_pipelined_topk`` — one
         copy of the overlap + pad-clamp machinery with the per-call
-        path)."""
-        cat, step = self._catalog, self._step
+        path). Routes to the exact mesh step or the two-stage fast path
+        (``stage1_only`` skips the exact rescore — the admission
+        ladder's degraded operating point)."""
+        if self._retriever is not None:
+            ret = self._retriever
+
+            def base_chunk(cu, c):
+                excl = self._build_excl(cu, c)
+                U_chunk = self._U[jnp.asarray(cu)]
+                return ret.topk(U_chunk, excl, k=self.k,
+                                stage1_only=stage1_only)
+
+            k_out = min(self.k, ret.candidate_count(self.k))
+            n_rows = ret.n_rows
+            # the clustered gather materializes [bucket, slab, rank]
+            # per probe: the retrieval config's bucket cap — not the
+            # engine's packing cap — bounds stage-1 memory
+            slice_size = min(self.max_batch, ret.config.max_bucket)
+        else:
+            cat, step = self._catalog, self._step
+
+            def base_chunk(cu, c):
+                excl = self._build_excl(cu, c)
+                return step(self._U[jnp.asarray(cu)], cat.V_sh, cat.w_sh,
+                            jnp.asarray(excl[0]), jnp.asarray(excl[1]),
+                            jnp.asarray(excl[2]))
+
+            k_out, n_rows, slice_size = (self._k_out, cat.n_rows,
+                                         self.max_batch)
 
         if self._obs_on:
             def score_chunk(cu, c):
@@ -348,10 +650,7 @@ class ServingEngine:
                 # here — blocking per chunk would serialize the overlap
                 # the engine exists to provide)
                 t0 = time.perf_counter()
-                excl = self._build_excl(cu, c)
-                out = step(self._U[jnp.asarray(cu)], cat.V_sh, cat.w_sh,
-                           jnp.asarray(excl[0]), jnp.asarray(excl[1]),
-                           jnp.asarray(excl[2]))
+                out = base_chunk(cu, c)
                 bucket = len(cu)
                 self._obs.histogram("serving_score_s",
                                     bucket=bucket).observe(
@@ -360,11 +659,7 @@ class ServingEngine:
                                 bucket=bucket).set(c / bucket)
                 return out
         else:
-            def score_chunk(cu, c):
-                excl = self._build_excl(cu, c)
-                return step(self._U[jnp.asarray(cu)], cat.V_sh, cat.w_sh,
-                            jnp.asarray(excl[0]), jnp.asarray(excl[1]),
-                            jnp.asarray(excl[2]))
+            score_chunk = base_chunk
 
         def on_batch(bucket):
             self.stats["microbatches"] += 1
@@ -375,8 +670,8 @@ class ServingEngine:
                                   bucket=bucket).inc()
 
         return run_pipelined_topk(
-            user_rows, k=self.k, k_out=self._k_out, n_rows=cat.n_rows,
-            slice_size=self.max_batch,
+            user_rows, k=self.k, k_out=k_out, n_rows=n_rows,
+            slice_size=slice_size,
             bucket_fn=lambda c: min(pow2_pad(c, self.min_bucket),
-                                    self.max_batch),
+                                    slice_size),
             score_chunk=score_chunk, on_batch=on_batch)

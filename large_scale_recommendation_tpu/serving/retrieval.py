@@ -1,0 +1,640 @@
+"""Approximate retrieval fast path: int8 score-then-rescore top-K.
+
+``parallel.serving`` scores the **full catalog exactly** for every
+request bucket — per-request cost grows linearly with items, which is
+exactly where "millions of users" dies at the serving tier (ROADMAP
+item 3; FLAME, arxiv 2509.22681, frames the milestone as sustaining
+heavy *mixed* traffic within latency SLOs, not batch throughput). This
+module is the two-stage alternative, the serving half of the ALX
+quantized-storage/f32-accumulate recipe the training tier already runs
+(PR 6's bf16 factors):
+
+- **stage 1 (cheap, approximate)** — score an int8-quantized catalog
+  (per-row symmetric scale: ``q = round(V / scale)``, ``scale =
+  max|row| / 127``) with an int8×int8→int32 matmul and keep the top
+  ``k · overfetch`` candidates. Optionally the catalog is organized
+  into a k-means-clustered MIPS index (IVF layout: rows grouped into
+  per-cluster slabs, queries routed to their top-``n_probe`` clusters
+  by centroid inner product) so stage 1 touches ``n_probe / n_clusters``
+  of the catalog instead of all of it — the per-request cost stops
+  scaling with the catalog.
+- **stage 2 (exact)** — gather the candidates' full-precision rows and
+  rescore them in f32 (one ``[bucket, kc, rank]`` einsum), apply the
+  train-seen exclusions exactly, and return the top-k. Every returned
+  score is the EXACT f32 score of that item — approximation only
+  affects which ~``k·overfetch`` items were considered, measured as
+  recall@k against the exact path (``recall_at_k``; target ≥ 0.95 at
+  overfetch 4, test-pinned).
+
+A ``stage1_only`` mode skips the rescore and returns the dequantized
+approximate scores — the *degraded* operating point the admission
+controller (``serving.admission``) falls back to under SLO burn.
+
+Exclusion semantics match the exact path: the flat stage-1 kernel
+scatter-mins the same ``(rows, cols, w)`` triple ``_exclusion_builder``
+produces; stage 2 re-applies exclusions as a sorted-key membership test
+over the candidate set (an excluded candidate's score is forced to
+``DEAD_SLOT_OFFSET``, below ``DEAD_SLOT_THRESHOLD`` — the shared
+dead-slot sentinel contract). Masked (phantom) rows carry the same
+additive ``item_w`` offset as the exact catalogs.
+
+Everything here is single-host: the quantized catalog is a plain
+replicated device array (int8 makes a 1M×128 catalog ~128 MB — far
+below one chip's HBM; rank-sharding a quantized catalog is future work,
+same status as model-parallel factor rows in ``parallel.partitioner``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from functools import partial
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from large_scale_recommendation_tpu.parallel.serving import catalog_version
+from large_scale_recommendation_tpu.utils.metrics import DEAD_SLOT_OFFSET
+from large_scale_recommendation_tpu.utils.shapes import pow2_pad
+
+
+@dataclasses.dataclass(frozen=True)
+class RetrievalConfig:
+    """Fast-path knobs.
+
+    ``overfetch`` sets the stage-1 candidate budget (``k · overfetch``,
+    clamped to the catalog); 4 is the recall≥0.95 operating point the
+    tests pin. ``n_clusters=None`` scores the whole int8 catalog flat
+    (bandwidth win only — right up to ~100k items); an integer opts
+    into the clustered MIPS index (compute win: stage 1 touches
+    ``n_probe`` clusters per query). ``spill`` pads each cluster slab
+    to ``pow2_pad(max cluster size)`` — k-means imbalance costs memory,
+    never correctness (every row is in exactly one slab).
+    ``max_bucket`` caps the fast path's micro-batch slice: the clustered
+    gather materializes ``[bucket, slab, rank]`` per probe, so the
+    bucket — not the catalog — bounds stage-1 memory."""
+
+    overfetch: int = 4
+    n_clusters: int | None = None
+    n_probe: int = 8
+    kmeans_iters: int = 5
+    kmeans_sample: int = 65536
+    slab_slack: float = 2.0
+    spill_choices: int = 4
+    max_bucket: int = 256
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.overfetch < 1:
+            raise ValueError(f"overfetch must be >= 1, got {self.overfetch}")
+        if self.n_clusters is not None and self.n_clusters < 2:
+            raise ValueError(f"n_clusters must be >= 2, "
+                             f"got {self.n_clusters}")
+        if self.n_probe < 1:
+            raise ValueError(f"n_probe must be >= 1, got {self.n_probe}")
+        if self.slab_slack < 1.0:
+            raise ValueError(f"slab_slack must be >= 1, "
+                             f"got {self.slab_slack}")
+        if self.spill_choices < 1:
+            raise ValueError(f"spill_choices must be >= 1, "
+                             f"got {self.spill_choices}")
+
+
+# --------------------------------------------------------------------------
+# int8 per-row quantization (the ALX storage recipe, serving half)
+# --------------------------------------------------------------------------
+
+
+@jax.jit
+def _quantize_rows(X):
+    """Per-row symmetric int8: ``scale = max|row| / 127`` (all-zero rows
+    get scale 1 so dequantization is exact), ``q = round(X / scale)``.
+    Round-trip error is ≤ ``scale / 2`` per element — test-pinned."""
+    X = X.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(X), axis=1)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(X / scale[:, None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def quantize_rows(X) -> tuple[jax.Array, jax.Array]:
+    """Public form of the per-row int8 quantizer: ``(q int8 [n, r],
+    scale f32 [n])`` with ``dequant = q * scale[:, None]``."""
+    return _quantize_rows(jnp.asarray(X))
+
+
+def dequantize_rows(q, scale) -> jax.Array:
+    return q.astype(jnp.float32) * scale[:, None]
+
+
+# --------------------------------------------------------------------------
+# k-means MIPS index build (host-side; assignment via chunked matmuls)
+# --------------------------------------------------------------------------
+
+
+def _augment(V: np.ndarray) -> np.ndarray:
+    """MIPS→NN reduction (Bachrach et al. 2014): append
+    ``sqrt(max_norm² − ‖v‖²)`` so Euclidean k-means groups items by the
+    direction+norm structure inner-product search actually cares about
+    (raw Euclidean clustering under-weights the norm component)."""
+    norms2 = np.sum(V * V, axis=1)
+    pad = np.sqrt(np.maximum(norms2.max() - norms2, 0.0))
+    return np.concatenate([V, pad[:, None]], axis=1).astype(np.float32)
+
+
+def _assign(X: np.ndarray, centroids: np.ndarray, top: int = 1,
+            chunk: int = 16384) -> np.ndarray:
+    """Per row, the ``top`` nearest centroids by Euclidean distance
+    (argmin ‖x − c‖² = argmax (x·c − ‖c‖²/2)), chunked matmuls so a
+    1M-row assignment never materializes [n, C] at once. Returns
+    ``[n]`` for ``top=1``, else ``[n, top]`` best-first."""
+    half = jnp.asarray(0.5 * np.sum(centroids * centroids, axis=1))
+    C_dev = jnp.asarray(centroids.T)
+    top = min(top, len(centroids))
+    out = np.empty((len(X), top), np.int32)
+    for c0 in range(0, len(X), chunk):
+        sl = jnp.asarray(X[c0:c0 + chunk])
+        scores = jnp.dot(sl, C_dev) - half[None, :]
+        _, idx = jax.lax.top_k(scores, top)
+        out[c0:c0 + len(idx)] = np.asarray(idx)
+    return out[:, 0] if top == 1 else out
+
+
+def _capacity_assign(choices: np.ndarray, cap: int, n_clusters: int
+                     ) -> tuple[np.ndarray, np.ndarray]:
+    """Greedy capacity-capped assignment: every row tries its ranked
+    cluster choices in order; a cluster accepts rows only up to ``cap``.
+    Rows exhausting their choices land in the OVERFLOW set (scored on
+    every probe downstream, so spilling costs compute, never recall).
+    Capacity capping is what makes the probed volume ``n_probe · cap``
+    a real bound — uncapped k-means slabs pad to the LARGEST cluster,
+    and one hot cluster then inflates every probe (measured: a 7×
+    imbalance turned the fast path 4× SLOWER than exact). Vectorized
+    per choice rank: rows are ranked within each cluster's applicant
+    pool and accepted while capacity remains."""
+    n, n_choices = choices.shape
+    assign = np.full(n, -1, np.int32)
+    used = np.zeros(n_clusters, np.int64)
+    remaining = np.arange(n)
+    for level in range(n_choices):
+        if not len(remaining):
+            break
+        c = choices[remaining, level]
+        order = np.argsort(c, kind="stable")
+        cs = c[order]
+        starts = np.searchsorted(cs, np.arange(n_clusters))
+        rank = np.arange(len(cs)) - starts[cs]
+        ok = rank < (cap - used[cs])
+        accepted = order[ok]
+        assign[remaining[accepted]] = cs[ok]
+        used += np.bincount(cs[ok], minlength=n_clusters)
+        remaining = remaining[order[~ok]]
+    return assign, remaining
+
+
+def kmeans_fit(V: np.ndarray, n_clusters: int, iters: int = 5,
+               sample: int = 65536, seed: int = 0, cap: int | None = None,
+               spill_choices: int = 4
+               ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Fit centroids on a subsample (Lloyd iterations), then
+    capacity-capped-assign EVERY row — the standard IVF build split:
+    fitting is O(sample·C) per iteration, the one full pass is
+    assignment only. Returns ``(assignment int32 [n] (−1 = overflow),
+    overflow row indices, routing centroids f32 [C, rank])`` — routing
+    centroids are the mean RAW member vectors (queries route by inner
+    product against them). Clustering runs in MIPS-augmented space
+    (``_augment``) so direction AND norm structure separate."""
+    n, r = V.shape
+    rng = np.random.default_rng(seed)
+    aug = _augment(np.asarray(V, np.float32))
+    fit_idx = (rng.choice(n, size=sample, replace=False)
+               if n > sample else np.arange(n))
+    X = aug[fit_idx]
+    centroids = X[rng.choice(len(X), size=n_clusters, replace=False)]
+    for _ in range(max(1, iters)):
+        a = _assign(X, centroids)
+        counts = np.bincount(a, minlength=n_clusters)
+        sums = np.zeros_like(centroids)
+        np.add.at(sums, a, X)
+        nonempty = counts > 0
+        centroids[nonempty] = (sums[nonempty]
+                               / counts[nonempty][:, None])
+        # dead centroids: reseed from random points so every slab can
+        # fill (an empty cluster wastes a probe slot forever otherwise)
+        n_dead = int((~nonempty).sum())
+        if n_dead:
+            centroids[~nonempty] = X[rng.choice(len(X), size=n_dead)]
+    if cap is None:
+        cap = n  # uncapped: single-choice argmax, no overflow
+    choices = _assign(aug, centroids, top=max(1, spill_choices))
+    if choices.ndim == 1:
+        choices = choices[:, None]
+    assignment, overflow = _capacity_assign(choices, cap, n_clusters)
+    route = np.zeros((n_clusters, r), np.float32)
+    placed = assignment >= 0
+    counts = np.bincount(assignment[placed], minlength=n_clusters)
+    np.add.at(route, assignment[placed], np.asarray(V, np.float32)[placed])
+    route[counts > 0] /= counts[counts > 0][:, None]
+    return assignment, overflow, route
+
+
+# --------------------------------------------------------------------------
+# Quantized catalog (flat or clustered slabs) + delta re-quantization
+# --------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class QuantizedCatalog:
+    """The stage-1 scoring structure: an int8 catalog with per-row
+    scales, either flat (``q``/``scale``) or grouped into clustered
+    slabs (``slab_q [C, m, r]`` etc.; ``pos_of_row`` maps a global row
+    to its flat slab position so a delta can re-quantize ONLY dirty
+    rows in place). ``item_w`` is the additive phantom/mask offset the
+    exact catalogs carry too; slab pad slots hold ``-inf`` weight and
+    row id ``n_rows`` (clamped downstream, same as mesh padding).
+
+    ``version`` is the ``catalog_version`` token of the source factor
+    array — the same token the engine's exact catalog carries, so one
+    integer compare answers "are these two builds of the same swap?".
+    """
+
+    n_rows: int
+    rank: int
+    version: int
+    item_w: jax.Array  # [n] 0 real / DEAD_SLOT_OFFSET masked
+    # flat layout (None in clustered mode)
+    q: jax.Array | None = None  # int8 [n, r]
+    scale: jax.Array | None = None  # f32 [n]
+    # clustered layout (None in flat mode). Slabs are CAPACITY-CAPPED
+    # (``slab_slack × n/C`` rows, pow2-padded); rows spilling every
+    # ranked choice live in the overflow block, scored on EVERY probe.
+    centroids: jax.Array | None = None  # f32 [C, r] (routing)
+    slab_q: jax.Array | None = None  # int8 [C, m, r]
+    slab_scale: jax.Array | None = None  # f32 [C, m]
+    slab_w: jax.Array | None = None  # f32 [C, m] (item_w; -inf pads)
+    slab_rows: jax.Array | None = None  # int32 [C, m] (n_rows pads)
+    ovf_q: jax.Array | None = None  # int8 [O, r]
+    ovf_scale: jax.Array | None = None  # f32 [O]
+    ovf_w: jax.Array | None = None  # f32 [O] (-inf pads)
+    ovf_rows: jax.Array | None = None  # int32 [O] (n_rows pads)
+    pos_of_row: np.ndarray | None = None  # int64 [n]: c·m+slot | C·m+j
+    stats: dict = dataclasses.field(default_factory=dict)
+
+    @property
+    def clustered(self) -> bool:
+        return self.slab_q is not None
+
+    def nbytes(self) -> int:
+        total = 0
+        for f in ("q", "scale", "centroids", "slab_q", "slab_scale",
+                  "slab_w", "slab_rows", "ovf_q", "ovf_scale", "ovf_w",
+                  "ovf_rows", "item_w"):
+            arr = getattr(self, f)
+            if arr is not None:
+                total += arr.size * arr.dtype.itemsize
+        return int(total)
+
+    def apply_delta(self, rows, values, version: int) -> "QuantizedCatalog":
+        """Re-quantize ONLY the given rows (new full-precision
+        ``values``) and scatter them into the layout. Per-row
+        quantization is deterministic, so the flat result is
+        BIT-EQUIVALENT to a full rebuild from the patched table
+        (test-pinned). Clustered mode keeps each row's cluster
+        assignment — re-clustering is a full-rebuild concern; routing
+        quality degrades only as rows drift far from their centroid."""
+        rows = np.asarray(rows)
+        if len(rows) == 0:
+            return dataclasses.replace(self, version=version)
+        q_new, s_new = _quantize_rows(jnp.asarray(values))
+        patch: dict = {"version": version}
+        if self.q is not None:
+            idx = jnp.asarray(rows)
+            patch["q"] = self.q.at[idx].set(q_new)
+            patch["scale"] = self.scale.at[idx].set(s_new)
+        if self.clustered:
+            C, m, r = self.slab_q.shape
+            pos = self.pos_of_row[rows]
+            in_slab = pos < C * m
+            if in_slab.any():
+                sp = jnp.asarray(pos[in_slab])
+                qs, ss = q_new[jnp.asarray(in_slab)], s_new[
+                    jnp.asarray(in_slab)]
+                patch["slab_q"] = self.slab_q.reshape(
+                    C * m, r).at[sp].set(qs).reshape(C, m, r)
+                patch["slab_scale"] = self.slab_scale.reshape(
+                    C * m).at[sp].set(ss).reshape(C, m)
+            in_ovf = ~in_slab
+            if in_ovf.any():
+                op = jnp.asarray(pos[in_ovf] - C * m)
+                patch["ovf_q"] = self.ovf_q.at[op].set(
+                    q_new[jnp.asarray(in_ovf)])
+                patch["ovf_scale"] = self.ovf_scale.at[op].set(
+                    s_new[jnp.asarray(in_ovf)])
+        return dataclasses.replace(self, **patch)
+
+
+def build_quantized_catalog(V, item_mask=None,
+                            config: RetrievalConfig | None = None,
+                            version: int | None = None
+                            ) -> QuantizedCatalog:
+    """Quantize ``V`` and (optionally) build the clustered MIPS layout.
+    ``item_mask`` follows the ``shard_catalog`` contract (True = real
+    item; masked rows score ``DEAD_SLOT_OFFSET`` additively)."""
+    cfg = config or RetrievalConfig()
+    t0 = time.perf_counter()
+    version = catalog_version(V) if version is None else version
+    V_host = np.asarray(V, np.float32)
+    n, r = V_host.shape
+    item_w = np.zeros(n, np.float32)
+    if item_mask is not None:
+        item_w[~np.asarray(item_mask)] = DEAD_SLOT_OFFSET
+    q_dev, s_dev = _quantize_rows(jnp.asarray(V_host))
+    stats = {"n_rows": n, "rank": r, "mode": "flat"}
+    if cfg.n_clusters is None:
+        cat = QuantizedCatalog(
+            n_rows=n, rank=r, version=version,
+            item_w=jnp.asarray(item_w), q=q_dev, scale=s_dev, stats=stats)
+        stats["build_s"] = round(time.perf_counter() - t0, 3)
+        stats["bytes"] = cat.nbytes()
+        return cat
+
+    C = min(cfg.n_clusters, n)
+    # capacity-capped slabs: m = pow2(slack · mean cluster) bounds the
+    # probed volume at n_probe·m rows REGARDLESS of k-means imbalance
+    m = pow2_pad(max(1, int(np.ceil(cfg.slab_slack * n / C))))
+    assignment, overflow, route = kmeans_fit(
+        V_host, C, iters=cfg.kmeans_iters, sample=cfg.kmeans_sample,
+        seed=cfg.seed, cap=m, spill_choices=cfg.spill_choices)
+    placed = assignment >= 0
+    counts = np.bincount(assignment[placed], minlength=C)
+    # slab fill, vectorized: placed rows sorted by cluster; each row's
+    # slot is its rank within the cluster (< m by the capacity cap)
+    placed_rows = np.nonzero(placed)[0]
+    order = placed_rows[np.argsort(assignment[placed_rows],
+                                   kind="stable")]
+    starts = np.zeros(C + 1, np.int64)
+    np.cumsum(counts, out=starts[1:])
+    slot = (np.arange(len(order), dtype=np.int64)
+            - starts[assignment[order]])
+    pos_of_row = np.empty(n, np.int64)
+    pos_of_row[order] = assignment[order].astype(np.int64) * m + slot
+    O = pow2_pad(max(len(overflow), 1), 8)
+    pos_of_row[overflow] = C * m + np.arange(len(overflow))
+    q_host = np.asarray(q_dev)
+    s_host = np.asarray(s_dev)
+    slab_q = np.zeros((C * m + O, r), np.int8)
+    slab_scale = np.zeros(C * m + O, np.float32)
+    slab_w = np.full(C * m + O, -np.inf, np.float32)  # pads: -inf
+    slab_rows = np.full(C * m + O, n, np.int32)  # pads: clamped later
+    slab_q[pos_of_row] = q_host
+    slab_scale[pos_of_row] = s_host
+    slab_w[pos_of_row] = item_w
+    slab_rows[pos_of_row] = np.arange(n, dtype=np.int32)
+    stats.update(mode="clustered", n_clusters=int(C), slab_size=int(m),
+                 capacity_cap=int(m), overflow_rows=int(len(overflow)),
+                 max_cluster=int(counts.max()),
+                 mean_cluster=float(counts.mean()),
+                 empty_clusters=int((counts == 0).sum()),
+                 n_probe=int(min(cfg.n_probe, C)))
+    cat = QuantizedCatalog(
+        n_rows=n, rank=r, version=version, item_w=jnp.asarray(item_w),
+        centroids=jnp.asarray(route),
+        slab_q=jnp.asarray(slab_q[:C * m].reshape(C, m, r)),
+        slab_scale=jnp.asarray(slab_scale[:C * m].reshape(C, m)),
+        slab_w=jnp.asarray(slab_w[:C * m].reshape(C, m)),
+        slab_rows=jnp.asarray(slab_rows[:C * m].reshape(C, m)),
+        ovf_q=jnp.asarray(slab_q[C * m:]),
+        ovf_scale=jnp.asarray(slab_scale[C * m:]),
+        ovf_w=jnp.asarray(slab_w[C * m:]),
+        ovf_rows=jnp.asarray(slab_rows[C * m:]),
+        pos_of_row=pos_of_row, stats=stats)
+    stats["build_s"] = round(time.perf_counter() - t0, 3)
+    stats["bytes"] = cat.nbytes()
+    return cat
+
+
+# --------------------------------------------------------------------------
+# Jitted stages
+# --------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("kc",))
+def _stage1_flat(qU, u_scale, Q, scale, item_w,
+                 excl_rows, excl_cols, excl_w, *, kc):
+    """Flat int8 stage 1: one int8×int8→int32 matmul over the whole
+    quantized catalog, dequantized by the outer product of scales, the
+    exact path's additive mask offset and scatter-min exclusions
+    applied, top-``kc`` candidates out."""
+    scores = jax.lax.dot_general(
+        qU, Q, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32).astype(jnp.float32)
+    scores = scores * (u_scale[:, None] * scale[None, :])
+    scores = scores + item_w[None, :]
+    scores = scores.at[excl_rows, excl_cols].min(excl_w)
+    return jax.lax.top_k(scores, kc)
+
+
+@partial(jax.jit, static_argnames=("kc", "n_probe"))
+def _stage1_clustered(U_chunk, centroids,
+                      slab_q, slab_scale, slab_w, slab_rows,
+                      ovf_q, ovf_scale, ovf_w, ovf_rows,
+                      *, kc, n_probe):
+    """Clustered stage 1 (IVF): route each query to its top-``n_probe``
+    clusters by centroid inner product, score ONLY those slabs plus the
+    (small) overflow block every query scores. The probe loop is a
+    ``lax.map`` so peak memory is one ``[bucket, slab, rank]`` gather,
+    not ``n_probe`` of them; the gathered int8 slab upcasts to f32
+    before the einsum (measured fastest on XLA:CPU — the int8-einsum
+    path is a slow scalar loop, and f32-at-rest slabs would double the
+    gather bytes). Queries stay RAW f32: the slab operand is f32 by
+    then anyway, so quantizing queries here would add round-trip error
+    for zero compute saved (the flat path quantizes them because its
+    int8×int8 dot actually consumes them). Exclusions are NOT applied
+    here (slab positions vary per query); stage 2's membership test
+    owns them — overfetch absorbs the candidate slots excluded items
+    waste."""
+    routing = jnp.dot(U_chunk, centroids.T)  # [b, C] f32
+    _, cid = jax.lax.top_k(routing, n_probe)  # [b, p]
+
+    def one_probe(pi):
+        c = cid[:, pi]  # [b]
+        g = slab_q[c].astype(jnp.float32)  # [b, m, r]
+        sc = jnp.einsum("br,bmr->bm", U_chunk, g)
+        sc = sc * slab_scale[c] + slab_w[c]
+        return sc, slab_rows[c]
+
+    scores, rows = jax.lax.map(one_probe, jnp.arange(n_probe))
+    b = U_chunk.shape[0]
+    scores = jnp.moveaxis(scores, 0, 1).reshape(b, -1)  # [b, p·m]
+    rows = jnp.moveaxis(rows, 0, 1).reshape(b, -1)
+    # overflow block: rows that spilled every capped slab — scored by
+    # every query (a plain [b, O] matmul; O is a few % of the catalog
+    # at most, and the cap is what keeps the slabs honest)
+    ov = jnp.dot(U_chunk, ovf_q.astype(jnp.float32).T)
+    ov = ov * ovf_scale[None, :] + ovf_w[None, :]
+    scores = jnp.concatenate([scores, ov], axis=1)
+    rows = jnp.concatenate(
+        [rows, jnp.broadcast_to(ovf_rows[None, :], ov.shape)], axis=1)
+    v, pos = jax.lax.top_k(scores, kc)
+    return v, jnp.take_along_axis(rows, pos, axis=1)
+
+
+@partial(jax.jit, static_argnames=("k", "exact"))
+def _stage2(U_chunk, V, item_w, cand_v, cand_rows,
+            excl_rows, excl_cols, excl_w, *, k, exact):
+    """Candidate finalization. ``exact=True`` gathers the candidates'
+    full-precision rows and rescores in f32 (every surfaced score is
+    then the true score of that item); ``exact=False`` is the degraded
+    stage-1-only mode — approximate scores pass through. Either way the
+    train-seen exclusions apply EXACTLY via a sorted-key membership
+    test (the scatter-min triple can't address a candidate list), and
+    excluded candidates drop to ``DEAD_SLOT_OFFSET`` — the shared
+    dead-slot sentinel."""
+    n = V.shape[0]
+    safe_rows = jnp.minimum(cand_rows, n - 1)  # slab pads carry n
+    if exact:
+        Vc = V[safe_rows]  # [b, kc, r]
+        sc = jnp.einsum("br,bkr->bk", U_chunk, Vc)
+        sc = sc + item_w[safe_rows]
+        # pads (row == n) must stay dead even though row n-1 is real
+        sc = jnp.where(cand_rows >= n, -jnp.inf, sc)
+    else:
+        sc = cand_v
+    # membership: real exclusion entries carry w = DEAD_SLOT_OFFSET,
+    # pads +inf — encode (query, item) as one sortable uint32 key
+    # (x64 is disabled repo-wide; the bucket·(n+1) < 2³² capacity this
+    # implies is guarded loudly in TwoStageRetriever.topk)
+    stride = jnp.uint32(n + 1)
+    real = excl_w < 0
+    keys = jnp.where(
+        real,
+        excl_rows.astype(jnp.uint32) * stride
+        + excl_cols.astype(jnp.uint32),
+        jnp.uint32(2**32 - 1))
+    keys = jnp.sort(keys)
+    b = cand_rows.shape[0]
+    cand_keys = (jnp.arange(b, dtype=jnp.uint32)[:, None] * stride
+                 + cand_rows.astype(jnp.uint32))
+    pos = jnp.clip(jnp.searchsorted(keys, cand_keys), 0, keys.shape[0] - 1)
+    hit = keys[pos] == cand_keys
+    sc = jnp.where(hit, DEAD_SLOT_OFFSET, sc)
+    v, p = jax.lax.top_k(sc, k)
+    return v, jnp.take_along_axis(cand_rows, p, axis=1)
+
+
+# --------------------------------------------------------------------------
+# Retriever: the engine-facing surface
+# --------------------------------------------------------------------------
+
+
+class TwoStageRetriever:
+    """One catalog build's fast path: quantized stage-1 structure +
+    full-precision rescore table, with per-chunk ``topk`` the engine's
+    micro-batch loop calls. Rebuilt by ``ServingEngine._refresh`` on a
+    full swap; patched in place by ``apply_delta`` on a delta swap."""
+
+    def __init__(self, V, item_mask=None,
+                 config: RetrievalConfig | None = None,
+                 version: int | None = None):
+        self.config = config or RetrievalConfig()
+        self.V = jnp.asarray(V, jnp.float32)  # exact rescore table
+        self.catalog = build_quantized_catalog(
+            self.V, item_mask=item_mask, config=self.config,
+            version=catalog_version(V) if version is None else version)
+        self.buckets_seen: set[tuple] = set()  # compile-shape evidence
+
+    @property
+    def version(self) -> int:
+        return self.catalog.version
+
+    @property
+    def n_rows(self) -> int:
+        return self.catalog.n_rows
+
+    def candidate_count(self, k: int) -> int:
+        """Stage-1 budget for ``k`` results: ``k · overfetch``, floored
+        at ``k`` and clamped to what the layout's top-k can legally
+        supply (catalog height flat; probed slab capacity clustered)."""
+        cat = self.catalog
+        if cat.clustered:
+            C, m, _ = cat.slab_q.shape
+            hard = (min(self.config.n_probe, C) * m
+                    + int(cat.ovf_q.shape[0]))
+        else:
+            hard = cat.n_rows
+        return min(max(k, min(k * self.config.overfetch, cat.n_rows)),
+                   hard)
+
+    def topk(self, U_chunk, excl, k: int, stage1_only: bool = False):
+        """Top-``k`` of one padded query chunk: ``(values f32 [b, k],
+        rows int32 [b, k])``, rows ≥ ``n_rows`` possible only for slab
+        pads (callers clamp, as with mesh padding)."""
+        cat = self.catalog
+        kc = self.candidate_count(k)
+        if U_chunk.shape[0] * (cat.n_rows + 1) >= 2**32:
+            # stage 2's exclusion membership packs (query, item) into
+            # one uint32 key (x64 is disabled repo-wide)
+            raise ValueError(
+                f"bucket {U_chunk.shape[0]} × catalog {cat.n_rows} "
+                f"exceeds the uint32 membership-key capacity — lower "
+                f"RetrievalConfig.max_bucket")
+        excl_rows, excl_cols, excl_w = (jnp.asarray(e) for e in excl)
+        if cat.clustered:
+            n_probe = min(self.config.n_probe, cat.slab_q.shape[0])
+            self.buckets_seen.add(("clustered", U_chunk.shape[0], kc))
+            cand_v, cand_rows = _stage1_clustered(
+                U_chunk, cat.centroids, cat.slab_q,
+                cat.slab_scale, cat.slab_w, cat.slab_rows,
+                cat.ovf_q, cat.ovf_scale, cat.ovf_w, cat.ovf_rows,
+                kc=kc, n_probe=n_probe)
+        else:
+            # only the flat int8×int8 dot consumes quantized queries
+            qU, u_scale = _quantize_rows(U_chunk)
+            self.buckets_seen.add(("flat", U_chunk.shape[0], kc))
+            cand_v, cand_rows = _stage1_flat(
+                qU, u_scale, cat.q, cat.scale, cat.item_w,
+                excl_rows, excl_cols, excl_w, kc=kc)
+        return _stage2(U_chunk, self.V, cat.item_w, cand_v, cand_rows,
+                       excl_rows, excl_cols, excl_w,
+                       k=min(k, kc), exact=not stage1_only)
+
+    def apply_delta(self, rows, values, version: int) -> None:
+        """Install only the touched rows: patch the f32 rescore table
+        and re-quantize exactly the dirty rows of the int8 catalog.
+        ``values`` are the rows' new full-precision factors."""
+        rows = np.asarray(rows)
+        if len(rows):
+            vals = jnp.asarray(values, jnp.float32)
+            self.V = self.V.at[jnp.asarray(rows)].set(vals)
+            self.catalog = self.catalog.apply_delta(rows, vals, version)
+        else:
+            self.catalog = dataclasses.replace(self.catalog,
+                                               version=version)
+
+
+# --------------------------------------------------------------------------
+# Recall measurement
+# --------------------------------------------------------------------------
+
+
+def recall_at_k(approx_ids, exact_ids) -> float:
+    """Mean per-query overlap fraction between an approximate top-k id
+    list and the exact one. Dead slots (id −1, the assembled form of
+    below-threshold scores) are dropped from BOTH sides; a query whose
+    exact list is empty contributes 1.0 (nothing to recall)."""
+    approx_ids = np.asarray(approx_ids)
+    exact_ids = np.asarray(exact_ids)
+    if approx_ids.ndim == 1:
+        approx_ids = approx_ids[None]
+        exact_ids = exact_ids[None]
+    total = 0.0
+    for a_row, e_row in zip(approx_ids, exact_ids):
+        e = set(int(x) for x in e_row if x >= 0)
+        if not e:
+            total += 1.0
+            continue
+        a = set(int(x) for x in a_row if x >= 0)
+        total += len(a & e) / len(e)
+    return total / len(approx_ids)

@@ -54,6 +54,8 @@ import threading
 import time
 from typing import Any, Callable
 
+import numpy as np
+
 from large_scale_recommendation_tpu.obs.events import get_events
 from large_scale_recommendation_tpu.obs.registry import get_registry
 from large_scale_recommendation_tpu.obs.trace import get_tracer
@@ -119,6 +121,20 @@ class StreamingDriver:
         self.on_batch = on_batch
         self._adaptive = isinstance(model, AdaptiveMF)
         self._online = model.online if self._adaptive else model
+        # ids touched since the last serving refresh — the WAL batches
+        # flowing through _apply know exactly which rows moved, which is
+        # what lets refresh_serving ship DELTAS (engine.apply_delta:
+        # scatter + dirty-row requantization) instead of whole-table
+        # rebuilds. Sets of python ints: micro-batches touch hundreds of
+        # ids, catalogs hold millions of rows. Guarded by _dirty_lock:
+        # run(follow=True) applies batches on one thread while
+        # refresh_serving lands from a serving-side thread — an
+        # unguarded snapshot-then-clear would erase ids marked between
+        # the two steps, and those rows would serve stale FOREVER (no
+        # later refresh would know about them).
+        self._dirty_users: set[int] = set()
+        self._dirty_items: set[int] = set()
+        self._dirty_lock = threading.Lock()
         self._stop = threading.Event()
         self._source: QueuedSource | None = None
         self._last_stats: dict = {}
@@ -291,6 +307,14 @@ class StreamingDriver:
             self.model.partial_fit(
                 batch.ratings, offset=offset,
                 emit_updates=self.config.emit_updates)
+        if self._engines:  # dirty-id tracking feeds delta refreshes
+            ru, ri, _, rw = batch.ratings.to_numpy()
+            real = rw > 0
+            du = np.unique(ru[real]).tolist()
+            di = np.unique(ri[real]).tolist()
+            with self._dirty_lock:
+                self._dirty_users.update(du)
+                self._dirty_items.update(di)
         self.batches_processed += 1
         self.records_processed += batch.n
         self._since_checkpoint += 1
@@ -341,15 +365,83 @@ class StreamingDriver:
         self._engines.append(engine)
         return engine
 
-    def refresh_serving(self) -> None:
-        """Re-snapshot the live model into every attached engine — the
+    def refresh_serving(self, delta: bool | None = None) -> None:
+        """Push the live model's state into every attached engine — the
         manual analogue of the adaptive swap auto-refresh, for pure
-        ``OnlineMF`` streams that want periodic serve visibility."""
+        ``OnlineMF`` streams (and an ``AdaptiveMF``'s between-swap
+        online increments) that want periodic serve visibility.
+
+        ``delta=None`` (auto, the default) ships a DELTA whenever it
+        can: the ids touched since the last refresh (tracked per
+        applied WAL batch) map to engine rows and only those rows
+        install — one scatter per table plus dirty-row requantization
+        of the int8 fast path (``ServingEngine.apply_delta``), instead
+        of re-sharding the whole catalog. Falls back to a full
+        ``refresh`` whenever any engine's geometry no longer matches
+        the live tables (vocab grew since its snapshot) — correctness
+        never depends on the delta path being available. ``delta=False``
+        forces the full rebuild; ``delta=True`` asserts deltas were
+        possible (raises if not — the knob regression tests use).
+
+        The retrain SWAP path (``AdaptiveMF._install``) stays a full
+        refresh by construction: a from-scratch retrain rewrites every
+        row, which is exactly the whole-table case."""
         if not self._engines:
+            with self._dirty_lock:
+                self._dirty_users.clear()
+                self._dirty_items.clear()
             return
-        snapshot = self.model.to_model()
-        for engine in self._engines:
-            engine.refresh(snapshot)
+        online = self._online
+
+        def geometry_matches(engine) -> bool:
+            m = engine.model
+            return (int(m.U.shape[0]) == online.users.num_rows
+                    and int(m.V.shape[0]) == online.items.num_rows)
+
+        can_delta = all(geometry_matches(e) for e in self._engines)
+        if delta is True and not can_delta:
+            raise ValueError(
+                "delta refresh requested but an engine's geometry no "
+                "longer matches the live tables (vocab grew) — use "
+                "delta=None/False")
+        # atomically TAKE the dirty sets (fresh empties replace them):
+        # ids marked by a concurrently-applying batch after this point
+        # land in the new sets and ship on the NEXT refresh — never
+        # silently erased (the clear-after-snapshot race)
+        with self._dirty_lock:
+            dirty_users, self._dirty_users = self._dirty_users, set()
+            dirty_items, self._dirty_items = self._dirty_items, set()
+        if delta is not False and can_delta:
+            du = (np.fromiter(dirty_users, np.int64, len(dirty_users))
+                  if dirty_users else np.zeros(0, np.int64))
+            di = (np.fromiter(dirty_items, np.int64, len(dirty_items))
+                  if dirty_items else np.zeros(0, np.int64))
+            u_rows, _ = online.users.rows_for(du)
+            i_rows, _ = online.items.rows_for(di)
+            U_vals = self._gather_rows(online.users.array, u_rows)
+            V_vals = self._gather_rows(online.items.array, i_rows)
+            for engine in self._engines:
+                engine.apply_delta(item_rows=i_rows, V_rows=V_vals,
+                                   user_rows=u_rows, U_rows=U_vals)
+        else:
+            snapshot = self.model.to_model()
+            for engine in self._engines:
+                engine.refresh(snapshot)
+
+    @staticmethod
+    def _gather_rows(table_arr, rows: np.ndarray) -> np.ndarray:
+        """One pow2-padded device gather of the dirty rows (the same
+        bounded-shape-family idiom as ``BatchUpdates``' update gather)."""
+        import jax.numpy as jnp
+
+        from large_scale_recommendation_tpu.utils.shapes import pow2_pad
+
+        n = len(rows)
+        if n == 0:
+            return np.zeros((0, int(table_arr.shape[1])), np.float32)
+        idx = np.zeros(pow2_pad(n), np.int64)
+        idx[:n] = rows
+        return np.asarray(table_arr[jnp.asarray(idx)])[:n]
 
     # -- telemetry -----------------------------------------------------------
 
@@ -409,5 +501,7 @@ class StreamingDriver:
             "lag_records": max(0, end - self.consumed_offset),
             "checkpoints_written": self.checkpoints_written,
             "catalog_versions": list(self.catalog_versions),
+            "dirty_users": len(self._dirty_users),
+            "dirty_items": len(self._dirty_items),
             "queue": queue,
         }

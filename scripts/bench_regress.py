@@ -12,6 +12,11 @@ silently as long as tier-1 stays green. This gate closes that gap::
                                       # (MULTICHIP_r*.json: pad ratio and
                                       # layout lower-is-better, sharded
                                       # train/ALS throughput higher)
+    python scripts/bench_regress.py --family serving  # traffic-sim rounds
+                                      # (SERVING_r*.json: p99 latencies
+                                      # lower-is-better; fast/exact
+                                      # throughput, QPS-at-SLO and
+                                      # recall@10 higher)
 
 It loads both rounds, compares the watched keys (higher-is-better rates
 by default; ``--lower`` flags wall-clock-style keys), prints a table,
@@ -76,10 +81,30 @@ MULTICHIP_KEYS: dict[str, float] = {
     "layout_mb": 10.0,
 }
 
+# watched keys for the SERVING_r*.json trajectory (the serving_bench
+# traffic-simulator rounds, ISSUE 8): fast-path/exact throughput, the
+# fast-vs-exact ratio, QPS-at-SLO and recall are higher-is-better;
+# p99 latencies are LOWER-is-better — a p99 blowup under the overload
+# pass is an admission-control regression even when throughput noise
+# hides it. Latency thresholds are loose (shared machines double tail
+# latencies routinely); recall is tight (same code + seed ⇒ same
+# index ⇒ same recall, drift means the retrieval math changed).
+SERVING_KEYS: dict[str, float] = {
+    "value": 30.0,  # fast-path users/s headline
+    "fast_users_per_s": 30.0,
+    "exact_users_per_s": 30.0,
+    "fast_vs_exact": 30.0,
+    "qps_at_slo": 30.0,
+    "recall_at_10": 5.0,
+    "p99_ms": 50.0,
+    "overload_fast_p99_ms": 50.0,
+}
+
 # per-family round-file prefix + default watch set
 FAMILIES = {
     "bench": ("BENCH", DEFAULT_KEYS),
     "multichip": ("MULTICHIP", MULTICHIP_KEYS),
+    "serving": ("SERVING", SERVING_KEYS),
 }
 
 # keys where HIGHER is explicitly better (throughputs, achieved
@@ -89,12 +114,14 @@ FAMILIES = {
 # while every rate relied on the absence of a pattern collision.
 DEFAULT_HIGHER = ("_ratings_per_s", "_rows_per_s", "_users_per_s",
                   "_per_s", "effective_hbm_gbs", "pct_of_hbm_peak",
-                  "_hbm_gbs", "_tflops", "_mbps")
+                  "_hbm_gbs", "_tflops", "_mbps", "qps_at_slo",
+                  "recall_at", "_vs_exact")
 
 # keys where LOWER is better (walls, latencies, pad/layout overheads)
 # when watched explicitly
 DEFAULT_LOWER = ("_wall_s", "_ms_", "time_to_", "_s_p", "_pad_ratio",
-                 "layout_mb", "layout_bytes")
+                 "layout_mb", "layout_bytes", "p99_ms", "p50_ms",
+                 "shed_frac")
 
 _NUM_PAIR = re.compile(
     r'"([A-Za-z_][A-Za-z0-9_]*)":\s*(-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)')
@@ -216,9 +243,12 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--family", choices=sorted(FAMILIES), default="bench",
                     help="round family to gate: 'bench' (BENCH_r*.json, "
-                         "default) or 'multichip' (MULTICHIP_r*.json "
+                         "default), 'multichip' (MULTICHIP_r*.json "
                          "pod_dryrun rounds — pad ratio lower-is-better, "
-                         "sharded throughput higher-is-better)")
+                         "sharded throughput higher-is-better) or "
+                         "'serving' (SERVING_r*.json traffic-sim rounds "
+                         "— p99 lower-is-better, throughput/QPS-at-SLO/"
+                         "recall higher-is-better)")
     ap.add_argument("--current", default=None,
                     help="current round file (default: newest round of "
                          "the family)")

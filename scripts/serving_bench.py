@@ -1,30 +1,54 @@
-"""Sustained-serving micro-bench: ServingEngine vs the per-call mesh path.
+"""Serving bench: engine micro-bench + closed-loop traffic simulator.
 
-The serving acceptance pin, CPU-measurable and repeatable: a stream of
-mixed-size recommend requests (the "millions of users" shape — many
-small queries, not one big batch) served two ways over the SAME prebuilt
-sharded catalog:
+Two modes, selected by ``SERVE_MODE``:
 
-- **per-call**: one ``mesh_top_k_recommend`` invocation per request —
-  what a naive service loop around ``MFModel.recommend(mesh=...)`` does.
-  Each request pays its own dispatch, exclusion build, and a
-  request-sized (pow2-padded) kernel call that leaves the matmul units
-  mostly idle.
-- **engine**: ``ServingEngine.serve`` — requests coalesce into
-  ``max_batch``-row micro-batches from a bounded pow2 bucket family, so
-  the dispatch count collapses and every kernel call runs at a
-  throughput-shaped batch size. A bf16-catalog pass rides along.
+**micro** (default) — the PR-1 acceptance pin: a stream of mixed-size
+recommend requests served by ``ServingEngine.serve`` vs one
+``mesh_top_k_recommend`` call per request over the SAME prebuilt
+catalog. ``value`` is engine users/s, ``vs_baseline`` the
+engine/per-call speedup (bar ≥ 1.5).
 
-Contract: the LAST stdout line is one JSON object
-``{"metric", "value", "unit", "vs_baseline", "extra"}`` — ``value`` is
-engine users/s, ``vs_baseline`` is the engine/per-call speedup
-(the acceptance bar is ≥ 1.5). ``extra`` carries both raw rates, the
-compiled-executable count (O(#buckets) evidence), and the workload knobs.
+**traffic** — the ROADMAP-item-3 acceptance harness: a traffic
+simulator drives the two-stage quantized fast path
+(``serving.retrieval``) and the exact full-catalog engine through
+timed arrival streams (``SERVE_PATTERN``: poisson / diurnal / bursty)
+over a *structured* synthetic catalog (a mixture of ``SERVE_CENTERS``
+Gaussian centers — real embedding catalogs cluster, which is the
+regime IVF routing is for; recall is MEASURED and reported either
+way). It emits:
 
-Env knobs: SERVE_USERS, SERVE_ITEMS, SERVE_RANK, SERVE_REQUESTS,
-SERVE_REQ_MAX (request sizes are uniform in [1, SERVE_REQ_MAX]),
-SERVE_K, SERVE_MAX_BATCH, SERVE_DEVICES (virtual CPU mesh width),
+- saturation throughput for both engines (same bucket warmup) —
+  ``fast_users_per_s`` / ``exact_users_per_s`` / ``fast_vs_exact``
+  (the ≥3× @ 1M-items acceptance);
+- ``recall_at_10`` of the fast path against the exact answers;
+- a p99-latency-vs-offered-QPS curve (per-level p50/p99/achieved QPS/
+  shed/degraded fractions) and ``qps_at_slo`` — the highest offered
+  level whose p99 still met ``SERVE_SLO_MS``;
+- an overload pass: offered load ≳3× capacity with admission control
+  armed (``serving.admission``) — p99 of ACCEPTED requests stays
+  bounded while load sheds (``overload_fast_p99_ms``,
+  ``overload_shed_frac``, ``admission_transitions``), vs the
+  admissionless exact baseline saturating (``overload_exact_p99_ms``).
+
+Arrivals are open-loop (scheduled independently of completions — the
+only shape that exposes saturation); the *control* loop is closed: the
+engine's SLO tracker feeds the admission ladder which feeds back into
+batching/degrade/shed decisions.
+
+Contract (both modes): the LAST stdout line is one JSON object
+``{"metric", "value", "unit", "vs_baseline", "extra"}``; stderr is
+flushed before that line is printed, so ``2>&1``-merged wrappers always
+parse it (the bench.py/pallas_probe/pod_dryrun hardening). Traffic-mode
+rounds are committed as ``SERVING_r*.json`` and gated by
+``scripts/bench_regress.py --family serving``.
+
+Env knobs (micro): SERVE_USERS, SERVE_ITEMS, SERVE_RANK,
+SERVE_REQUESTS, SERVE_REQ_MAX, SERVE_K, SERVE_MAX_BATCH, SERVE_DEVICES,
 SERVE_FORCE_CPU (=0 to use the default jax backend).
+Traffic adds: SERVE_CENTERS, SERVE_CLUSTERS (0 = flat int8 stage 1),
+SERVE_PROBE, SERVE_OVERFETCH, SERVE_PATTERN, SERVE_LEVELS (offered-QPS
+multipliers of measured capacity), SERVE_SLO_MS, SERVE_DEADLINE_MS,
+SERVE_TRAFFIC_REQUESTS, SERVE_RECALL_SAMPLE.
 """
 
 from __future__ import annotations
@@ -37,6 +61,16 @@ import time
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _emit_final(result: dict) -> None:
+    """The machine-readable emit contract: flush stderr BEFORE printing
+    the final JSON line, so a 2>&1-merged capture can always parse the
+    last line (the same hardening bench.py / pallas_probe / pod_dryrun
+    carry — an unflushed stderr write landing after the summary once
+    cost a round its parsed result)."""
+    sys.stderr.flush()
+    print(json.dumps(result), flush=True)
 
 
 def build_model(num_users: int, num_items: int, rank: int, seed: int = 0):
@@ -203,21 +237,329 @@ def run(num_users=20_000, num_items=8_192, rank=64, n_requests=400,
     }
 
 
+# --------------------------------------------------------------------------
+# Traffic simulator (SERVE_MODE=traffic)
+# --------------------------------------------------------------------------
+
+
+def build_structured_model(num_users: int, num_items: int, rank: int,
+                           n_centers: int = 256, spread: float = 2.0,
+                           noise: float = 0.3, seed: int = 0):
+    """A catalog with planted cluster structure: items drawn around
+    ``n_centers`` Gaussian centers (the shape real embedding catalogs
+    have — and the regime clustered MIPS routing exists for; the flat
+    int8 path doesn't care). Queries stay isotropic Gaussian."""
+    import jax.numpy as jnp
+
+    from large_scale_recommendation_tpu.data.blocking import flat_index
+    from large_scale_recommendation_tpu.models.mf import MFModel
+
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(n_centers, rank)) * spread
+    V = (centers[rng.integers(0, n_centers, num_items)]
+         + noise * rng.normal(size=(num_items, rank))).astype(np.float32)
+    U = rng.normal(size=(num_users, rank)).astype(np.float32)
+    return MFModel(
+        U=jnp.asarray(U), V=jnp.asarray(V),
+        users=flat_index(np.arange(num_users, dtype=np.int64)),
+        items=flat_index(np.arange(num_items, dtype=np.int64)))
+
+
+def make_arrivals(pattern: str, n: int, qps: float,
+                  rng: np.random.Generator) -> np.ndarray:
+    """Arrival offsets (seconds, sorted) for ``n`` requests at mean
+    rate ``qps``: ``poisson`` (exponential gaps), ``diurnal`` (one
+    compressed sinusoidal day — rate swings ±80% around the mean),
+    ``bursty`` (alternating 4× on-bursts and 0.25× lulls)."""
+    if pattern == "poisson":
+        gaps = rng.exponential(1.0 / qps, n)
+    elif pattern == "diurnal":
+        # inhomogeneous Poisson by gap scaling: rate(t) tracks one
+        # sine period over the stream
+        gaps = np.empty(n)
+        t = 0.0
+        period = n / qps
+        for i in range(n):
+            rate = qps * (1.0 + 0.8 * np.sin(2 * np.pi * t / period))
+            rate = max(rate, 0.05 * qps)
+            gaps[i] = rng.exponential(1.0 / rate)
+            t += gaps[i]
+    elif pattern == "bursty":
+        burst = int(max(8, n // 8))
+        gaps = np.empty(n)
+        for i in range(n):
+            on = (i // burst) % 2 == 0
+            gaps[i] = rng.exponential(1.0 / (qps * (4.0 if on else 0.25)))
+    else:
+        raise ValueError(f"unknown arrival pattern {pattern!r}")
+    return np.cumsum(gaps)
+
+
+def run_traffic_level(engine, requests, arrivals, deadline_s: float,
+                      slo_ms: float) -> dict:
+    """Drive one offered-load level through the engine: submit each
+    request at its arrival offset, flush when the coalescing window
+    fills (``max_batch`` rows, admission-widened) or the oldest pending
+    ticket hits the batching deadline, measure per-request latency
+    (completion − scheduled arrival: a backlogged engine pays its queue
+    honestly). Returns the level's latency/QPS/shed/degraded stats."""
+    from large_scale_recommendation_tpu.serving import (
+        AdmissionRejectedError,
+    )
+
+    n = len(requests)
+    lat = np.full(n, np.nan)
+    shed = np.zeros(n, bool)
+    degraded = np.zeros(n, bool)
+    pending: list[tuple[int, float]] = []  # (request idx, arrival)
+    pending_rows = 0
+    t0 = time.perf_counter()
+    i = 0
+
+    def flush_pending():
+        nonlocal pending, pending_rows
+        results = engine.flush()
+        done = time.perf_counter() - t0
+        for (idx, arr), res in zip(pending, results):
+            lat[idx] = done - arr
+            degraded[idx] = getattr(res, "degraded", False)
+        pending = []
+        pending_rows = 0
+
+    while i < n or pending:
+        now = time.perf_counter() - t0
+        while i < n and arrivals[i] <= now:
+            try:
+                engine.submit(requests[i])
+                pending.append((i, arrivals[i]))
+                pending_rows += len(requests[i])
+            except AdmissionRejectedError:
+                shed[i] = True
+            i += 1
+        widen = 1.0
+        if engine.admission is not None:
+            widen = engine.admission.widen_factor
+        limit = int(engine.max_batch * widen)
+        oldest = pending[0][1] if pending else None
+        if pending and (pending_rows >= limit
+                        or now - oldest >= deadline_s * widen
+                        or i >= n):
+            flush_pending()
+            continue
+        # idle until the next edge: an arrival or the deadline
+        next_t = arrivals[i] if i < n else np.inf
+        if oldest is not None:
+            next_t = min(next_t, oldest + deadline_s * widen)
+        sleep = min(max(next_t - (time.perf_counter() - t0), 0.0), 0.01)
+        if sleep > 0:
+            time.sleep(sleep)
+
+    wall = time.perf_counter() - t0
+    served = lat[~np.isnan(lat)]
+    out = {
+        "offered_qps": round(float(len(requests) / arrivals[-1]), 2),
+        "achieved_qps": round(float(len(served) / wall), 2),
+        "served": int(len(served)),
+        "shed": int(shed.sum()),
+        "shed_frac": round(float(shed.mean()), 4),
+        "degraded_frac": round(float(degraded.mean()), 4),
+        "p50_ms": (round(float(np.percentile(served, 50) * 1e3), 2)
+                   if len(served) else None),
+        "p99_ms": (round(float(np.percentile(served, 99) * 1e3), 2)
+                   if len(served) else None),
+        "met_slo": (bool(np.percentile(served, 99) * 1e3 <= slo_ms)
+                    if len(served) else False),
+    }
+    return out
+
+
+def run_traffic(num_users=20_000, num_items=262_144, rank=64,
+                n_requests=400, req_max=32, k=10, max_batch=1024,
+                n_centers=256, n_clusters=512, n_probe=16, overfetch=4,
+                kmeans_sample=65536, pattern="poisson",
+                levels=(0.02, 0.05, 0.1, 0.25, 0.5, 1.0), slo_ms=200.0,
+                deadline_ms=25.0, recall_sample=256,
+                overload_mult=3.0, seed=0) -> dict:
+    import jax
+
+    from large_scale_recommendation_tpu.obs import health
+    from large_scale_recommendation_tpu.serving import (
+        AdmissionConfig,
+        AdmissionController,
+        RetrievalConfig,
+        ServingEngine,
+        recall_at_k,
+    )
+
+    model = build_structured_model(num_users, num_items, rank,
+                                   n_centers=n_centers, seed=seed)
+    rng = np.random.default_rng(seed + 1)
+    requests = [rng.integers(0, num_users, int(sz)).astype(np.int64)
+                for sz in rng.integers(1, req_max + 1, n_requests)]
+    total_rows = sum(len(r) for r in requests)
+    retrieval = RetrievalConfig(
+        overfetch=overfetch,
+        n_clusters=(n_clusters if n_clusters > 0 else None),
+        n_probe=n_probe, kmeans_sample=kmeans_sample, seed=seed)
+    t0 = time.perf_counter()
+    fast = ServingEngine(model, k=k, retrieval=retrieval,
+                         max_batch=max_batch)
+    build_s = time.perf_counter() - t0
+    exact = ServingEngine(model, k=k, max_batch=max_batch)
+    extra = {
+        "device": str(jax.devices()[0]), "catalog_rows": num_items,
+        "num_users": num_users, "rank": rank, "k": k,
+        "requests": n_requests, "request_rows": total_rows,
+        "req_size_max": req_max, "max_batch": max_batch,
+        "pattern": pattern, "slo_ms": slo_ms, "deadline_ms": deadline_ms,
+        "catalog_build_s": round(build_s, 2),
+        "index": dict(fast.retriever.catalog.stats),
+    }
+
+    # ---- saturation throughput, same bucket warmup both engines ------
+    # best-of-reps per side: one descheduled slice on a shared 2-core
+    # box can halve a single pass's rate (measured), and the ratio is
+    # the acceptance bar — noise must not decide it
+    warm = requests[:4]
+    reps = int(os.environ.get("SERVE_SAT_REPS", 2))
+    rates = {}
+    for eng, name in ((fast, "fast"), (exact, "exact")):
+        eng.serve(warm)
+        best = 0.0
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            eng.serve(requests)
+            best = max(best, total_rows / (time.perf_counter() - t0))
+        rates[name] = best
+        extra[f"{name}_users_per_s"] = round(best, 1)
+    extra["fast_vs_exact"] = round(rates["fast"] / rates["exact"], 2)
+
+    # ---- recall of the fast path against the exact answers -----------
+    sample = rng.integers(0, num_users, recall_sample).astype(np.int64)
+    ie, _ = exact.recommend(sample)
+    ia, _ = fast.recommend(sample)
+    extra["recall_at_10" if k == 10 else f"recall_at_{k}"] = round(
+        recall_at_k(ia, ie), 4)
+
+    # ---- warm the WHOLE bucket family, both stages -------------------
+    # the curve flushes small deadline-bounded batches (buckets 8..256)
+    # the saturation pass above never compiled, and the degrade level
+    # additionally compiles stage-1-only variants: without this warmup
+    # the low-QPS levels' p99 is XLA compile time, not serving latency
+    import jax.numpy as jnp
+
+    empty_excl = (np.zeros(8, np.int32), np.zeros(8, np.int32),
+                  np.full(8, np.inf, np.float32))
+    bucket = 8
+    while bucket <= min(max_batch, fast.retriever.config.max_bucket):
+        for stage1_only in (False, True):
+            fast.retriever.topk(
+                jnp.zeros((bucket, rank), jnp.float32), empty_excl,
+                k=k, stage1_only=stage1_only)
+        exact.recommend(np.zeros(bucket, np.int64))
+        bucket <<= 1
+
+    # ---- p99-vs-offered-QPS curve (admission armed) ------------------
+    # capacity in requests/s: saturation users/s over mean request size.
+    # NOTE the two operating modes: saturation throughput comes from
+    # max_batch-deep coalescing, while the curve's deadline-bounded
+    # flushes serve SMALL buckets whose per-row cost is far higher —
+    # the latency knee sits well below multiplier 1.0, which is exactly
+    # what the low rungs of the ladder exist to bracket.
+    cap_qps = rates["fast"] / (total_rows / n_requests)
+    slo = health.SLOTracker(target_s=slo_ms / 1e3, objective=0.9,
+                            window=64)
+    fast.attach_admission(AdmissionController(slo, AdmissionConfig()))
+    curve = []
+    for mult in levels:
+        qps = cap_qps * mult
+        # bound each level's wall: low rungs don't need the full
+        # request stream to measure a stable p99
+        n_lv = int(min(n_requests, max(60, qps * 20)))
+        arr = make_arrivals(pattern, n_lv, qps, rng)
+        level = run_traffic_level(fast, requests[:n_lv], arr,
+                                  deadline_s=deadline_ms / 1e3,
+                                  slo_ms=slo_ms)
+        level["level"] = mult
+        curve.append(level)
+    extra["curve"] = curve
+    met = [lv for lv in curve if lv["met_slo"]]
+    extra["qps_at_slo"] = max((lv["achieved_qps"] for lv in met),
+                              default=0.0)
+    one_x = min(curve, key=lambda lv: abs(lv["level"] - 1.0))
+    extra["p99_ms"] = one_x["p99_ms"]
+    extra["p50_ms"] = one_x["p50_ms"]
+
+    # ---- overload: admission sheds/degrades, p99 stays bounded -------
+    qps = cap_qps * overload_mult
+    arr = make_arrivals(pattern, n_requests, qps, rng)
+    over = run_traffic_level(fast, requests, arr,
+                             deadline_s=deadline_ms / 1e3, slo_ms=slo_ms)
+    snap = fast.admission.snapshot()
+    extra["overload_fast_p99_ms"] = over["p99_ms"]
+    extra["overload_shed_frac"] = over["shed_frac"]
+    extra["overload_degraded_frac"] = over["degraded_frac"]
+    extra["admission_transitions"] = snap["transitions"]
+    extra["admission_final_level"] = snap["level"]
+    # the exact engine, admissionless, under the SAME offered load:
+    # nothing sheds, the queue eats the backlog, p99 saturates
+    over_exact = run_traffic_level(exact, requests, arr,
+                                   deadline_s=deadline_ms / 1e3,
+                                   slo_ms=slo_ms)
+    extra["overload_exact_p99_ms"] = over_exact["p99_ms"]
+
+    return {
+        "metric": (f"two-stage quantized serving users/s vs exact "
+                   f"full-catalog ({num_users}x{num_items} rank={rank}, "
+                   f"{pattern} traffic, "
+                   f"{'clustered' if n_clusters > 0 else 'flat'} "
+                   f"stage 1)"),
+        "value": extra["fast_users_per_s"],
+        "unit": "users/s",
+        "vs_baseline": extra["fast_vs_exact"],
+        "extra": extra,
+    }
+
+
 def main() -> None:
     if os.environ.get("SERVE_FORCE_CPU", "1") == "1":
         from large_scale_recommendation_tpu.utils.platform import force_cpu
 
         force_cpu(n_devices=int(os.environ.get("SERVE_DEVICES", 8)))
-    result = run(
-        num_users=int(os.environ.get("SERVE_USERS", 20_000)),
-        num_items=int(os.environ.get("SERVE_ITEMS", 8_192)),
-        rank=int(os.environ.get("SERVE_RANK", 64)),
-        n_requests=int(os.environ.get("SERVE_REQUESTS", 400)),
-        req_max=int(os.environ.get("SERVE_REQ_MAX", 64)),
-        k=int(os.environ.get("SERVE_K", 10)),
-        max_batch=int(os.environ.get("SERVE_MAX_BATCH", 1024)),
-    )
-    print(json.dumps(result), flush=True)
+    env = os.environ.get
+    if env("SERVE_MODE", "micro") == "traffic":
+        result = run_traffic(
+            num_users=int(env("SERVE_USERS", 20_000)),
+            num_items=int(env("SERVE_ITEMS", 262_144)),
+            rank=int(env("SERVE_RANK", 64)),
+            n_requests=int(env("SERVE_TRAFFIC_REQUESTS", 400)),
+            req_max=int(env("SERVE_REQ_MAX", 32)),
+            k=int(env("SERVE_K", 10)),
+            max_batch=int(env("SERVE_MAX_BATCH", 1024)),
+            n_centers=int(env("SERVE_CENTERS", 256)),
+            n_clusters=int(env("SERVE_CLUSTERS", 512)),
+            n_probe=int(env("SERVE_PROBE", 16)),
+            overfetch=int(env("SERVE_OVERFETCH", 4)),
+            kmeans_sample=int(env("SERVE_KMEANS_SAMPLE", 65536)),
+            pattern=env("SERVE_PATTERN", "poisson"),
+            levels=tuple(float(x) for x in
+                         env("SERVE_LEVELS", "0.02,0.05,0.1,0.25,0.5,1").split(",")),
+            slo_ms=float(env("SERVE_SLO_MS", 200)),
+            deadline_ms=float(env("SERVE_DEADLINE_MS", 25)),
+            recall_sample=int(env("SERVE_RECALL_SAMPLE", 256)),
+            overload_mult=float(env("SERVE_OVERLOAD_MULT", 3.0)),
+        )
+    else:
+        result = run(
+            num_users=int(env("SERVE_USERS", 20_000)),
+            num_items=int(env("SERVE_ITEMS", 8_192)),
+            rank=int(env("SERVE_RANK", 64)),
+            n_requests=int(env("SERVE_REQUESTS", 400)),
+            req_max=int(env("SERVE_REQ_MAX", 64)),
+            k=int(env("SERVE_K", 10)),
+            max_batch=int(env("SERVE_MAX_BATCH", 1024)),
+        )
+    _emit_final(result)
 
 
 if __name__ == "__main__":

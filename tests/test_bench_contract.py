@@ -160,6 +160,62 @@ def test_serving_bench_emits_contract_json():
     assert e["engine_microbatches"] < int(env["SERVE_REQUESTS"])
 
 
+def test_serving_traffic_bench_contract_on_merged_stream():
+    """The traffic-simulator contract (SERVE_MODE=traffic), captured
+    with stderr MERGED into stdout — the 2>&1 shape the round driver's
+    wrapper records. The LAST merged line must be the parseable JSON
+    summary (the stderr-flush-before-final-line hardening
+    bench.py/pallas_probe/pod_dryrun already carry), with the fast-path
+    vs exact rates, recall, the p99-vs-QPS curve, and the
+    overload/admission evidence keys the SERVING_r*.json regress family
+    gates on."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "SERVE_MODE": "traffic",
+        "SERVE_USERS": "500",
+        "SERVE_ITEMS": "2048",
+        "SERVE_RANK": "16",
+        "SERVE_TRAFFIC_REQUESTS": "60",
+        "SERVE_REQ_MAX": "16",
+        "SERVE_DEVICES": "2",
+        "SERVE_MAX_BATCH": "256",
+        "SERVE_CENTERS": "32",
+        "SERVE_CLUSTERS": "16",
+        "SERVE_PROBE": "8",
+        "SERVE_LEVELS": "0.5,1",
+        "SERVE_RECALL_SAMPLE": "32",
+        "SERVE_KMEANS_SAMPLE": "2048",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "serving_bench.py")],
+        env=env, text=True, timeout=600, cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,  # 2>&1 merge
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    d = json.loads(lines[-1])  # the merged-stream emit contract
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in d, f"missing {key}"
+    assert d["unit"] == "users/s"
+    assert d["value"] > 0
+    e = d["extra"]
+    for key in ("fast_users_per_s", "exact_users_per_s", "fast_vs_exact",
+                "recall_at_10", "qps_at_slo", "p99_ms", "p50_ms",
+                "overload_fast_p99_ms", "overload_exact_p99_ms",
+                "overload_shed_frac", "overload_degraded_frac",
+                "admission_transitions", "admission_final_level",
+                "catalog_build_s", "index", "curve"):
+        assert key in e, f"missing extra.{key}"
+    assert e["index"]["mode"] == "clustered"
+    assert 0.0 <= e["recall_at_10"] <= 1.0
+    assert len(e["curve"]) == 2
+    for level in e["curve"]:
+        for key in ("offered_qps", "achieved_qps", "p99_ms",
+                    "shed_frac", "degraded_frac", "met_slo"):
+            assert key in level, f"missing curve.{key}"
+
+
 def test_streams_bench_emits_contract_json():
     """The durable-ingest line's contract: scripts/streams_bench.py
     emits one JSON line with the standard fields, ratings/s unit, the

@@ -312,3 +312,81 @@ class TestWatchDeltas:
         rows = snapshot_deltas(prev, self._snap(1, counter=5), dt=1.0)
         by = {r["name"]: r for r in rows}
         assert by["c_total"]["delta"] == 5
+
+
+class TestServingFamily:
+    """``--family serving`` (ISSUE 8): SERVING_r*.json traffic-sim
+    rounds gate with p99 latencies LOWER-is-better and throughput /
+    QPS-at-SLO / recall higher-is-better — the unit twins of the
+    multichip family's tests above."""
+
+    BASE = {"fast_users_per_s": 900.0, "exact_users_per_s": 300.0,
+            "fast_vs_exact": 3.0, "qps_at_slo": 60.0,
+            "recall_at_10": 0.97, "p99_ms": 120.0,
+            "overload_fast_p99_ms": 250.0}
+
+    def _round(self, tmp_path, name, **over):
+        extra = dict(self.BASE, **over)
+        value = extra.pop("value", extra["fast_users_per_s"])
+        p = tmp_path / name
+        p.write_text(json.dumps(  # the real serving_bench line shape
+            {"metric": "two-stage serving users/s", "value": value,
+             "unit": "users/s", "vs_baseline": extra["fast_vs_exact"],
+             "extra": extra}))
+        return str(p)
+
+    def test_p99_blowup_alone_trips(self, tmp_path, capsys):
+        b = self._round(tmp_path, "SERVING_r01.json")
+        c = self._round(tmp_path, "SERVING_r02.json", p99_ms=400.0)
+        rc = regress_main(["--family", "serving",
+                           "--baseline", b, "--current", c])
+        assert rc == 1
+        assert "p99_ms" in capsys.readouterr().out
+
+    def test_recall_drop_trips_tight(self, tmp_path):
+        """Recall is deterministic (same code + seed ⇒ same index):
+        its threshold is tight — a 7% drop is a retrieval-math change."""
+        b = self._round(tmp_path, "SERVING_r01.json")
+        c = self._round(tmp_path, "SERVING_r02.json", recall_at_10=0.90)
+        assert regress_main(["--family", "serving",
+                             "--baseline", b, "--current", c]) == 1
+
+    def test_throughput_collapse_trips(self, tmp_path):
+        b = self._round(tmp_path, "SERVING_r01.json")
+        c = self._round(tmp_path, "SERVING_r02.json",
+                        fast_users_per_s=400.0, value=400.0)
+        assert regress_main(["--family", "serving",
+                             "--baseline", b, "--current", c]) == 1
+
+    def test_across_the_board_improvement_never_trips(self, tmp_path):
+        b = self._round(tmp_path, "SERVING_r01.json")
+        c = self._round(tmp_path, "SERVING_r02.json",
+                        fast_users_per_s=2000.0, value=2000.0,
+                        p99_ms=40.0, overload_fast_p99_ms=90.0,
+                        qps_at_slo=200.0, recall_at_10=0.999,
+                        fast_vs_exact=6.0)
+        assert regress_main(["--family", "serving",
+                             "--baseline", b, "--current", c]) == 0
+
+    def test_serving_direction_rules(self):
+        from scripts.bench_regress import SERVING_KEYS, is_lower_better
+
+        for key in ("p99_ms", "p50_ms", "overload_fast_p99_ms",
+                    "overload_exact_p99_ms"):
+            assert is_lower_better(key, set()), key
+        for key in ("fast_users_per_s", "exact_users_per_s",
+                    "fast_vs_exact", "qps_at_slo", "recall_at_10"):
+            assert not is_lower_better(key, set()), key
+        for key in ("fast_users_per_s", "qps_at_slo", "recall_at_10",
+                    "p99_ms", "overload_fast_p99_ms"):
+            assert key in SERVING_KEYS
+
+    def test_serving_find_rounds(self, tmp_path):
+        from scripts.bench_regress import find_rounds
+
+        for n in (3, 1):
+            (tmp_path / f"SERVING_r{n:02d}.json").write_text("{}")
+        (tmp_path / "BENCH_r01.json").write_text("{}")
+        rounds = find_rounds(str(tmp_path), prefix="SERVING")
+        assert [os.path.basename(p) for p in rounds] == [
+            "SERVING_r01.json", "SERVING_r03.json"]
