@@ -64,7 +64,10 @@ import time
 
 import numpy as np
 
-# v5e (TPU v5 lite) single-chip peaks for the roofline accounting
+# v5e (TPU v5 lite) single-chip peaks for the roofline accounting.
+# MIRRORED in large_scale_recommendation_tpu/obs/introspect.py (the live
+# /rooflinez denominators) — this module cannot import the package at
+# module scope (backend-init ordering), so a change here changes there.
 HBM_PEAK_GBS = 819.0
 BF16_PEAK_TFLOPS = 197.0
 FP32_PEAK_TFLOPS = 49.0
@@ -149,7 +152,17 @@ def run_child() -> None:
     import jax.numpy as jnp
 
     from large_scale_recommendation_tpu.models.dsgd import DSGD, DSGDConfig
+    from large_scale_recommendation_tpu.obs.introspect import Introspector
     from large_scale_recommendation_tpu.ops import sgd as sgd_ops
+
+    # XLA introspection for the whole bench run (registry stays null —
+    # the introspector keeps its own records): every compile's wall is
+    # measured at the funnel, so the compile_count / xla_compile_wall_s
+    # extras below see EVERYTHING — warm-ups, bucket families, probes —
+    # not just the hand-bracketed headline warm-up (ISSUE 9: compile
+    # regressions were invisible to the regress gate before this)
+    introspector = Introspector()
+    introspector.install()
 
     device = jax.devices()[0]
     extra: dict = {"device": str(device), "nnz": nnz, "rank": rank,
@@ -433,7 +446,9 @@ def run_child() -> None:
         train_nnz, rank, kernel=bench_kernel, num_blocks=blocks,
         rows_u=int(U.shape[0]), rows_v=int(V.shape[0]),
         factor_bytes=jnp.dtype(bench_fdtype).itemsize)
-    flops_per_rating = 6 * rank
+    # FLOP model via the shared hand model (ops.sgd.dsgd_flops_per_sweep
+    # — the same one the /rooflinez cross-check column prices against)
+    flops_per_rating = sgd_ops.dsgd_flops_per_sweep(1, rank)
     eff_gbs = bytes_per_sweep * sweeps / train_wall / 1e9
     eff_tflops = throughput * flops_per_rating / 1e12
     # end-to-end including ALL setup (gen + blocking + placement + compile)
@@ -507,6 +522,16 @@ def run_child() -> None:
             extra["extras_skipped"] = (
                 f"headline took {elapsed:.0f}s ≥ extras deadline "
                 f"{extras_deadline:.0f}s (BENCH_EXTRAS_DEADLINE)")
+
+    # compile accounting from the introspection hook, LAST so the probes
+    # and serving extras above are counted too: compile_count is every
+    # XLA compile the whole run paid, xla_compile_wall_s their summed
+    # funnel wall (the hand-bracketed compile_wall_s above stays the
+    # headline-kernel warm-up). Both gate in bench_regress's default
+    # watch set, lower-is-better.
+    extra["compile_count"] = introspector.compile_count
+    extra["xla_compile_wall_s"] = round(introspector.compile_wall_s, 2)
+    introspector.uninstall()
 
     # the stderr extras echo goes FIRST, then the final stdout line: a
     # wrapper capturing the child with 2>&1 sees the JSON summary as the

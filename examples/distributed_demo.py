@@ -52,6 +52,18 @@ def main() -> None:
     cfg = DistributedConfig.from_env()
     multi = initialize_distributed(cfg)
 
+    # LSR_OBS_DIR=<shared dir>: run the whole demo with the obs layer +
+    # XLA introspection live and, at the end, aggregate every process's
+    # /metrics + /healthz into ONE pod endpoint (obs.fleet) — the
+    # pod_dryrun acceptance marker. Enabled FIRST so instruments bind
+    # at construction, like every obs consumer.
+    obs_dir = os.environ.get("LSR_OBS_DIR")
+    if obs_dir:
+        from large_scale_recommendation_tpu import obs as _obs
+
+        _obs.enable()
+        _obs.enable_introspection(start=False)
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -228,8 +240,76 @@ def main() -> None:
           "(parity OK)", flush=True)
     assert armse < 0.1, armse
 
+    if obs_dir:
+        _fleet_pass(obs_dir, pid, nproc)
+
     if pid == 0:
         print("DISTRIBUTED DEMO PASS", flush=True)
+
+
+def _fleet_pass(obs_dir: str, pid: int, nproc: int,
+                timeout_s: float = 60.0) -> None:
+    """The pod-observability half of the 2-process pass: every process
+    serves its own ``ObsServer`` and drops the URL into the shared dir;
+    process 0 aggregates them through ``obs.fleet`` over REAL sockets,
+    asserts the merged pod ``/metrics`` parses with every host present
+    and the pod ``/healthz`` is OK, and prints the ``POD FLEET OK``
+    marker ``scripts/pod_dryrun.py`` keys on. File-based sync: peers
+    keep their servers up until process 0 writes ``fleet.done``."""
+    import time as _time
+
+    from large_scale_recommendation_tpu.obs.fleet import (
+        FleetAggregator,
+        FleetServer,
+        parse_prometheus,
+    )
+    from large_scale_recommendation_tpu.obs.server import ObsServer, http_get
+
+    server = ObsServer().start()
+    own = os.path.join(obs_dir, f"proc{pid}.url")
+    with open(own + ".tmp", "w") as f:
+        f.write(server.url)
+    os.replace(own + ".tmp", own)  # atomic: readers never see a torn URL
+    done_marker = os.path.join(obs_dir, "fleet.done")
+    deadline = _time.monotonic() + timeout_s
+    try:
+        if pid != 0:
+            while not os.path.exists(done_marker):
+                if _time.monotonic() > deadline:
+                    raise TimeoutError("fleet.done never appeared")
+                _time.sleep(0.05)
+            return
+        urls = []
+        for p in range(nproc):
+            path = os.path.join(obs_dir, f"proc{p}.url")
+            while not os.path.exists(path):
+                if _time.monotonic() > deadline:
+                    raise TimeoutError(f"{path} never appeared")
+                _time.sleep(0.05)
+            with open(path) as f:
+                urls.append(f.read().strip())
+        fleet = FleetServer(FleetAggregator(urls)).start()
+        try:
+            code, text = http_get(fleet.url + "/metrics")
+            assert code == 200, (code, text[:300])
+            samples = parse_prometheus(text)  # strict: malformed raises
+            hosts = {labels.get("host") for _, labels, _ in samples}
+            assert len(hosts) == nproc, (hosts, nproc)
+            code, body = http_get(fleet.url + "/healthz")
+            import json as _json
+
+            report = _json.loads(body)
+            assert code == 200 and report["status"] == "ok", (code, body)
+            assert report["reachable"] == nproc, report
+            print(f"POD FLEET OK hosts={len(hosts)} "
+                  f"samples={len(samples)} url={fleet.url}", flush=True)
+        finally:
+            fleet.stop()
+            with open(done_marker + ".tmp", "w") as f:
+                f.write("done")
+            os.replace(done_marker + ".tmp", done_marker)
+    finally:
+        server.stop()
 
 
 if __name__ == "__main__":

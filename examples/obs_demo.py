@@ -26,8 +26,11 @@ doing their job:
 Artifacts under ``--out`` (default ``obs_out/``): ``metrics.prom``
 (fetched from the live ``/metrics`` route), ``metrics.jsonl``,
 ``trace.json`` (Perfetto-loadable), ``healthz.json`` (the final
-CRITICAL report), and ``postmortem/bundle_watchdog_trip_*/`` (the
-validated incident bundle). ``scripts/obs_report.py <url>/varz
+CRITICAL report), ``roofline.json`` (the per-kernel roofline table —
+XLA cost analysis joined with measured walls, rendered inline and by
+``scripts/obs_report.py --roofline``), and
+``postmortem/bundle_watchdog_trip_*/`` (the validated incident bundle,
+with a short ``profile/`` capture attached). ``scripts/obs_report.py <url>/varz
 --watch 2`` tails the same server live; ``/seriesz`` and ``/eventz``
 serve the recorder's history and the event ring.
 
@@ -62,7 +65,14 @@ def main(argv=None) -> int:
     reg, tracer = obs.enable()
     tracer.install_jax_compile_hook()
     recorder, journal = obs.enable_flight_recorder(
-        interval_s=0.25, bundle_dir=os.path.join(args.out, "postmortem"))
+        interval_s=0.25, bundle_dir=os.path.join(args.out, "postmortem"),
+        # watchdog-trip bundles get a short jax.profiler capture
+        # attached (<bundle>/profile/)
+        profile_on_trip_s=0.2)
+    # XLA introspection: every compile below lands in the roofline
+    # table (cost analysis joined with measured execute walls), the
+    # device-memory sampler feeds the recorder, and /rooflinez serves it
+    introspector = obs.enable_introspection(interval_s=0.25)
 
     from large_scale_recommendation_tpu.core.generators import (
         SyntheticMFGenerator,
@@ -213,8 +223,22 @@ def main(argv=None) -> int:
     print(f"# trace: {len(events)} spans, categories {cats} "
           f"— open trace.json in https://ui.perfetto.dev")
 
-    from scripts.obs_report import render_snapshot
+    from scripts.obs_report import render_roofline, render_snapshot
 
+    # ---- the per-kernel roofline table (ISSUE 9) -----------------------
+    # every compile above was captured at the funnel: XLA's own
+    # flops/bytes-accessed per compile key, joined with the measured
+    # execute walls — rendered here and dumped for
+    # `scripts/obs_report.py --roofline`
+    roofline = introspector.roofline()
+    roofline_path = os.path.join(args.out, "roofline.json")
+    with open(roofline_path, "w") as f:
+        json.dump(roofline, f, indent=2)
+    print(f"# wrote {roofline_path} "
+          f"({len(roofline['rows'])} compile keys, "
+          f"{roofline['compile_count']} compiles)")
+    print()
+    print(render_roofline(roofline))
     print()
     print(render_snapshot(reg.snapshot()))
     obs.disable()

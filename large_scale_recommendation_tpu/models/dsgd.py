@@ -247,7 +247,10 @@ class DSGD:
                 n_ratings, int(np.shape(U)[-1]), kernel=cfg.kernel,
                 num_blocks=k, rows_u=int(np.shape(U)[0]),
                 rows_v=int(np.shape(V)[0]),
-                factor_bytes=jnp.dtype(cfg.factor_dtype).itemsize)))
+                factor_bytes=jnp.dtype(cfg.factor_dtype).itemsize)),
+            flops_per_iteration=(
+                None if n_ratings is None else sgd_ops.dsgd_flops_per_sweep(
+                    n_ratings, int(np.shape(U)[-1]))))
         return U, V
 
     def _train_fn(self, args):

@@ -60,6 +60,7 @@ from __future__ import annotations
 
 from large_scale_recommendation_tpu.obs.anomaly import (
     AnomalyCheck,
+    MonotonicGrowthCheck,
     ewma_zscore,
     rate_of_change,
 )
@@ -67,6 +68,12 @@ from large_scale_recommendation_tpu.obs.events import (
     EventJournal,
     get_events,
     set_events,
+)
+from large_scale_recommendation_tpu.obs.fleet import (
+    FleetAggregator,
+    FleetServer,
+    merge_prometheus,
+    parse_prometheus,
 )
 from large_scale_recommendation_tpu.obs.health import (
     CRITICAL,
@@ -77,6 +84,13 @@ from large_scale_recommendation_tpu.obs.health import (
     SLOTracker,
     TrainingDivergedError,
     TrainingWatchdog,
+)
+from large_scale_recommendation_tpu.obs.introspect import (
+    Introspector,
+    capture_profile,
+    get_introspector,
+    profile_trace,
+    set_introspector,
 )
 from large_scale_recommendation_tpu.obs.recorder import (
     FlightRecorder,
@@ -116,9 +130,20 @@ __all__ = [
     "disable",
     "enabled",
     "enable_flight_recorder",
+    "enable_introspection",
+    "Introspector",
+    "get_introspector",
+    "set_introspector",
+    "capture_profile",
+    "profile_trace",
+    "FleetAggregator",
+    "FleetServer",
+    "merge_prometheus",
+    "parse_prometheus",
     "FlightRecorder",
     "EventJournal",
     "AnomalyCheck",
+    "MonotonicGrowthCheck",
     "ewma_zscore",
     "rate_of_change",
     "get_recorder",
@@ -182,16 +207,41 @@ def enable_flight_recorder(interval_s: float = 1.0,
     return recorder, journal
 
 
+def enable_introspection(interval_s: float = 1.0, start: bool = True,
+                         **introspector_kwargs) -> Introspector:
+    """Install the XLA-introspection layer: an ``Introspector`` hooked
+    into the jax compile funnel as the module-level default, with its
+    device-memory/roofline sampler running every ``interval_s`` unless
+    ``start=False``. Call AFTER ``enable()`` (the introspector binds
+    the live registry/tracer at construction — under the null layer it
+    still captures records, but publishes nothing). Returns the
+    introspector (``.installed`` is False when the jax internal moved
+    and the hook could not be placed)."""
+    prev = get_introspector()
+    if prev is not None:  # re-enable must not stack compile hooks or
+        prev.close()      # leak the old sampler thread
+    introspector = Introspector(**introspector_kwargs)
+    introspector.install()
+    set_introspector(introspector)
+    if start:
+        introspector.start(interval_s)
+    return introspector
+
+
 def disable() -> None:
-    """Restore the zero-cost defaults: null registry/tracer, and no
-    flight recorder or event journal at all (their sampler thread is
-    stopped first)."""
+    """Restore the zero-cost defaults: null registry/tracer, no flight
+    recorder or event journal, and no introspector (its compile hook is
+    removed and sampler threads are stopped first)."""
     from large_scale_recommendation_tpu.obs import registry as _r
     from large_scale_recommendation_tpu.obs import trace as _t
 
     recorder = get_recorder()
     if recorder is not None:
         recorder.stop()
+    introspector = get_introspector()
+    if introspector is not None:
+        introspector.close()
+    set_introspector(None)
     set_recorder(None)
     set_events(None)
     set_registry(_r.NULL_REGISTRY)

@@ -27,6 +27,13 @@ points is noise), DEGRADED at ``degraded_z`` deviations, CRITICAL at
 pages on collapses, not on the system getting faster. ``mode="delta"``
 first-differences the series, turning a monotonic counter into the rate
 signal the z-score actually wants.
+
+``MonotonicGrowthCheck`` is the complementary detector for the signal
+EWMA is structurally blind to: a slow LEAK never departs from its own
+recent baseline (each step is tiny) — it's the unbroken monotonic run
+that matters. It watches the ``device_bytes_in_use{device=}`` series
+``obs.introspect`` publishes (worst-wins across devices; absent series
+— CPU has no allocator stats surface — is the documented OK path).
 """
 
 from __future__ import annotations
@@ -187,3 +194,89 @@ class AnomalyCheck:
         if eff >= self.degraded_z:
             return degraded(**detail)
         return ok(**detail)
+
+
+class MonotonicGrowthCheck:
+    """Leak detector over device-memory series: flags sustained
+    MONOTONIC growth, the signature an EWMA z-score is structurally
+    blind to (a slow leak never departs from its own recent baseline —
+    each step is small; it's the run that kills the process).
+
+    Watches every recorder series whose key starts with
+    ``series_prefix`` (default ``device_bytes_in_use`` — one series per
+    local device, published by
+    ``obs.introspect.Introspector.sample_device_memory``), worst
+    verdict wins across devices. Per series: the trailing run of
+    non-decreasing samples with at least one strict increase must reach
+    ``min_run`` points to count as growth; growth over the run relative
+    to its start ≥ ``degraded_growth_frac`` → DEGRADED, ≥
+    ``critical_growth_frac`` → CRITICAL. No matching series (CPU — no
+    allocator stats surface, so the sampler publishes nothing) is OK
+    with a note: absent telemetry is the documented graceful path, not
+    an incident."""
+
+    def __init__(self, recorder, series_prefix: str = "device_bytes_in_use",
+                 min_run: int = 8, degraded_growth_frac: float = 0.05,
+                 critical_growth_frac: float = 0.5, max_points: int = 256):
+        if min_run < 2:
+            raise ValueError(f"min_run must be >= 2, got {min_run}")
+        if not 0 < degraded_growth_frac <= critical_growth_frac:
+            raise ValueError(
+                f"need 0 < degraded_growth_frac <= critical_growth_frac, "
+                f"got ({degraded_growth_frac}, {critical_growth_frac})")
+        self.recorder = recorder
+        self.series_prefix = series_prefix
+        self.min_run = int(min_run)
+        self.degraded_growth_frac = float(degraded_growth_frac)
+        self.critical_growth_frac = float(critical_growth_frac)
+        self.max_points = int(max_points)
+
+    def _verdict_for(self, key: str) -> CheckResult:
+        values = [v for v in self.recorder.series_values(
+            key, last_n=self.max_points) if math.isfinite(v)]
+        if len(values) < self.min_run:
+            return ok(series=key,
+                      note=f"warming ({len(values)}/{self.min_run} points)")
+        # trailing run of non-decreasing samples
+        run_start = len(values) - 1
+        while run_start > 0 and values[run_start - 1] <= values[run_start]:
+            run_start -= 1
+        run = values[run_start:]
+        base = run[0]
+        # "still leaking NOW": the latest STRICT increase must be
+        # recent (within the trailing min_run samples). Without this, a
+        # normal startup allocation ramp followed by a stable plateau
+        # keeps flagging until the ramp ages out of the whole window —
+        # flat samples extend the run, and the near-zero pre-ramp base
+        # makes growth_frac astronomical. A plateau of min_run flat
+        # samples clears the verdict instead.
+        tail = run[-self.min_run:]
+        still_growing = any(b > a for a, b in zip(tail, tail[1:]))
+        growing = (len(run) >= self.min_run and run[-1] > base
+                   and still_growing)
+        growth_frac = ((run[-1] - base) / max(abs(base), 1e-9)
+                       if growing else 0.0)
+        detail = {"series": key, "run_points": len(run),
+                  "growth_frac": round(growth_frac, 4),
+                  "last": run[-1], "run_start_value": base}
+        if growing and growth_frac >= self.critical_growth_frac:
+            return critical(**detail)
+        if growing and growth_frac >= self.degraded_growth_frac:
+            return degraded(**detail)
+        return ok(**detail)
+
+    def __call__(self) -> CheckResult:
+        keys = [k for k in self.recorder.series_names()
+                if k.startswith(self.series_prefix)]
+        if not keys:
+            return ok(note="no matching series (device memory stats "
+                           "absent on this backend)",
+                      prefix=self.series_prefix)
+        worst: CheckResult | None = None
+        from large_scale_recommendation_tpu.obs.health import SEVERITY
+
+        for key in keys:
+            res = self._verdict_for(key)
+            if worst is None or SEVERITY[res.status] > SEVERITY[worst.status]:
+                worst = res
+        return worst

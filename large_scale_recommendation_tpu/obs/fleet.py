@@ -1,0 +1,268 @@
+"""Pod-wide observability: scrape every process's obs endpoint into one
+pane of glass.
+
+PR 7 made multi-host runs real (``Partitioner.create()`` spans
+processes) but each process still serves its OWN ``/metrics`` +
+``/healthz`` — a pod has N scrape targets and no aggregate view, so
+"is the pod healthy" needs N curls and a head. This module is the
+aggregation layer ALX-style pod operation needs:
+
+- ``FleetAggregator`` — scrapes a fixed target list (each a process's
+  ``ObsServer`` base URL) and merges: one Prometheus text body with a
+  per-target ``host`` label injected into every sample (``# TYPE``
+  lines deduped, first writer wins), plus a pod health report with
+  **worst-status-wins** aggregation where an unreachable target counts
+  CRITICAL (a dead process in a pod IS an incident, not a gap in the
+  data).
+- ``FleetServer`` — the pod endpoint: ``/metrics`` (merged text),
+  ``/healthz`` (pod aggregate, 503 iff CRITICAL — the same contract as
+  the per-process route, so a load balancer probes the pod exactly
+  like a process), ``/fleetz`` (full per-target JSON). Scrapes run per
+  request (pull model), same zero-cost-when-idle discipline as
+  ``obs.server``.
+- ``parse_prometheus`` — a strict text-exposition parser, the
+  "aggregated pod /metrics parses" assertion in
+  ``scripts/pod_dryrun.py``'s 2-process pass and the fleet tests.
+
+Wiring (``examples/distributed_demo.py`` under ``LSR_OBS_DIR``): every
+process starts an ``ObsServer`` and drops its URL into a shared
+directory; process 0 reads the URLs, serves the fleet endpoint, and
+asserts the merged view covers every process — the pod_dryrun
+acceptance marker ``POD FLEET OK``.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import time
+from urllib.parse import urlparse
+
+from large_scale_recommendation_tpu.obs.health import (
+    CRITICAL,
+    OK,
+    SEVERITY,
+)
+from large_scale_recommendation_tpu.obs.registry import _escape_label
+from large_scale_recommendation_tpu.obs.server import (
+    PROM_CTYPE,
+    EndpointServerBase,
+    http_get,
+)
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(\S+)$')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _unescape_label(v: str) -> str:
+    return (v.replace("\\n", "\n").replace('\\"', '"')
+            .replace("\\\\", "\\"))
+
+
+def parse_prometheus(text: str) -> list[tuple[str, dict, float]]:
+    """Parse a Prometheus text-exposition body into
+    ``[(name, labels, value), ...]``. STRICT: a malformed sample line
+    raises ``ValueError`` — this is the "the merged pod /metrics
+    parses" contract, so silently skipping a bad line would defeat it.
+    Comment (``#``) and blank lines are structural, not samples."""
+    out = []
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line.strip() or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            raise ValueError(f"bad prometheus sample at line {i}: {line!r}")
+        name, labels_str, value_str = m.groups()
+        labels = {}
+        if labels_str:
+            body = labels_str[1:-1]
+            for lm in _LABEL_RE.finditer(body):
+                labels[lm.group(1)] = _unescape_label(lm.group(2))
+            # everything between matches must be separators — otherwise
+            # the line smuggled an unparseable label through
+            rest = _LABEL_RE.sub("", body).replace(",", "").strip()
+            if rest:
+                raise ValueError(
+                    f"bad labels at line {i}: {labels_str!r}")
+        try:
+            value = float(value_str)
+        except ValueError as e:
+            raise ValueError(
+                f"bad value at line {i}: {value_str!r}") from e
+        out.append((name, labels, value))
+    return out
+
+
+def add_host_label(text: str, host: str) -> str:
+    """Rewrite every sample line of a Prometheus body with a
+    ``host="..."`` label injected (``# TYPE``/comment lines pass
+    through) — how per-process scrapes stay distinguishable in the
+    merged pod view."""
+    esc = _escape_label(host)
+    lines = []
+    for line in text.splitlines():
+        if not line.strip() or line.startswith("#"):
+            lines.append(line)
+            continue
+        m = _SAMPLE_RE.match(line)
+        if m is None:
+            lines.append(line)  # merge must not corrupt; parse flags it
+            continue
+        name, labels_str, value_str = m.groups()
+        if labels_str:
+            inner = labels_str[1:-1]
+            labeled = f'{name}{{{inner},host="{esc}"}} {value_str}'
+        else:
+            labeled = f'{name}{{host="{esc}"}} {value_str}'
+        lines.append(labeled)
+    return "\n".join(lines)
+
+
+def merge_prometheus(bodies: list[tuple[str, str]]) -> str:
+    """Merge per-host Prometheus bodies into one: each host's samples
+    get its ``host`` label, ``# TYPE`` lines are deduped by metric name
+    (first writer wins — the processes run the same code, so types
+    agree)."""
+    seen_types: set[str] = set()
+    out: list[str] = []
+    for host, text in bodies:
+        for line in add_host_label(text, host).splitlines():
+            if line.startswith("# TYPE "):
+                name = line.split()[2] if len(line.split()) > 2 else line
+                if name in seen_types:
+                    continue
+                seen_types.add(name)
+            if line.strip():
+                out.append(line)
+    return "\n".join(out) + ("\n" if out else "")
+
+
+def _host_of(url: str) -> str:
+    netloc = urlparse(url).netloc
+    return netloc or url
+
+
+class FleetAggregator:
+    """Scrapes a fixed list of per-process obs endpoints into one pod
+    view. ``targets`` are base URLs (``http://127.0.0.1:8321``); the
+    injected ``host`` label is each URL's netloc. ``timeout_s`` bounds
+    each scrape — a hung process must not hang the pod endpoint."""
+
+    UNREACHABLE = "unreachable"
+
+    def __init__(self, targets: list[str], timeout_s: float = 10.0):
+        if not targets:
+            raise ValueError("fleet needs at least one target")
+        self.targets = [t.rstrip("/") for t in targets]
+        self.timeout_s = float(timeout_s)
+
+    def scrape(self, include_metrics: bool = True,
+               include_health: bool = True) -> dict:
+        """One pod scrape: per-target ``/healthz`` and/or ``/metrics``,
+        aggregated worst-status-wins. The two flags exist so each pod
+        route pays ONLY the N requests it needs — ``/healthz`` probes
+        skip the N full metrics bodies + text merge, Prometheus polls
+        of ``/metrics`` skip the N healthz fetches (a wedged member
+        costs one ``timeout_s``, not two). An unreachable target
+        (connection failure, unparseable ``/healthz``, non-200
+        ``/metrics`` when fetched) aggregates as CRITICAL — a 503
+        ``/healthz`` is a REACHABLE target reporting critical, and its
+        own status stands."""
+        if not (include_metrics or include_health):
+            raise ValueError("scrape needs at least one of "
+                             "include_metrics/include_health")
+        bodies: list[tuple[str, str]] = []
+        target_reports = []
+        worst = OK
+        for url in self.targets:
+            host = _host_of(url)
+            entry = {"url": url, "host": host}
+            status = OK
+            if include_health:
+                h_code, h_body = http_get(url + "/healthz",
+                                          timeout=self.timeout_s)
+                try:
+                    report = json.loads(h_body)
+                    status = report.get("status", self.UNREACHABLE)
+                except (json.JSONDecodeError, TypeError):
+                    # connection-level failures land here: http_get's
+                    # synthetic 599 carries no JSON body
+                    report = {"error": h_body[:200]}
+                    status = self.UNREACHABLE
+                entry["healthz_code"] = h_code
+                entry["report"] = report
+            if include_metrics:
+                m_code, m_body = http_get(url + "/metrics",
+                                          timeout=self.timeout_s)
+                entry["metrics_code"] = m_code
+                if m_code == 200:
+                    bodies.append((host, m_body))
+                else:
+                    status = self.UNREACHABLE
+            entry["status"] = status
+            severity = SEVERITY.get(status, SEVERITY[CRITICAL])
+            if severity > SEVERITY[worst]:
+                worst = status if status in SEVERITY else CRITICAL
+            target_reports.append(entry)
+        out = {
+            "time": time.time(),
+            "status": worst,
+            "targets": target_reports,
+            "reachable": sum(1 for t in target_reports
+                             if t["status"] != self.UNREACHABLE),
+            "expected": len(self.targets),
+        }
+        if include_metrics:
+            out["prometheus"] = merge_prometheus(bodies)
+        return out
+
+    def healthz(self) -> tuple[int, dict]:
+        """(http_status, pod report) — 503 iff the pod aggregate is
+        CRITICAL (including any unreachable member), the same contract
+        as the per-process route. Scrapes only each target's
+        ``/healthz`` (the metrics bodies contribute nothing to the
+        verdict)."""
+        view = self.scrape(include_metrics=False)
+        report = {
+            "status": (CRITICAL if view["status"] == self.UNREACHABLE
+                       else view["status"]),
+            "time": view["time"],
+            "reachable": view["reachable"],
+            "expected": view["expected"],
+            "targets": [{"url": t["url"], "status": t["status"]}
+                        for t in view["targets"]],
+        }
+        code = 503 if report["status"] == CRITICAL else 200
+        return code, report
+
+
+class FleetServer(EndpointServerBase):
+    """The pod endpoint over one ``FleetAggregator``: ``/metrics``
+    (merged Prometheus text), ``/healthz`` (pod aggregate JSON, 503 on
+    CRITICAL — ``/healthz``-only scrape), ``/fleetz`` (full per-target
+    view). Rides ``obs.server.EndpointServerBase`` — the SAME
+    lifecycle/handler plumbing as the per-process ``ObsServer``, so the
+    HTTP semantics cannot drift between the two."""
+
+    thread_prefix = "fleet-server"
+
+    def __init__(self, aggregator: FleetAggregator,
+                 host: str = "127.0.0.1", port: int = 0):
+        super().__init__(host=host, port=port)
+        self.aggregator = aggregator
+
+    def route(self, path: str, query: str):
+        if path == "/metrics":
+            # metrics-only scrape: a Prometheus poll must not also pay
+            # N healthz fetches whose bodies it discards
+            view = self.aggregator.scrape(include_health=False)
+            return 200, view["prometheus"], PROM_CTYPE
+        if path in ("/healthz", "/health"):
+            return self.aggregator.healthz()
+        if path == "/fleetz":
+            return 200, self.aggregator.scrape()
+        if path == "/":
+            return 200, {"routes": ["/metrics", "/healthz", "/fleetz"],
+                         "targets": self.aggregator.targets}
+        return None

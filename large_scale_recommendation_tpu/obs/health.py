@@ -146,6 +146,19 @@ class HealthMonitor:
         self.register(name or f"anomaly:{series}",
                       AnomalyCheck(recorder, series, **kwargs))
 
+    def watch_device_memory(self, recorder, name: str = "device_memory",
+                            **kwargs) -> None:
+        """Register an ``obs.anomaly.MonotonicGrowthCheck`` over the
+        per-device HBM series (``device_bytes_in_use{device=}``,
+        published by ``obs.introspect``): sustained monotonic growth —
+        the leak signature EWMA can't see — degrades ``/healthz``;
+        absent series (CPU) stays OK."""
+        from large_scale_recommendation_tpu.obs.anomaly import (
+            MonotonicGrowthCheck,
+        )
+
+        self.register(name, MonotonicGrowthCheck(recorder, **kwargs))
+
     # -- evaluation ----------------------------------------------------------
 
     def run(self) -> dict:

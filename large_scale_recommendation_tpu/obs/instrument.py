@@ -78,7 +78,8 @@ class TrainSegmentTimer:
             self._walls.append((int(iterations), wall))
 
     def finish(self, units_per_iteration: int | float | None,
-               bytes_per_iteration: int | float | None = None) -> None:
+               bytes_per_iteration: int | float | None = None,
+               flops_per_iteration: int | float | None = None) -> None:
         """Publish throughput gauges: ``phase="all"`` over every segment,
         ``phase="steady"`` excluding the first (compile-carrying) one —
         only when at least two segments ran, so a single-segment fit
@@ -88,9 +89,25 @@ class TrainSegmentTimer:
         sweep moves — ``ops.sgd.dsgd_bytes_per_sweep``) additionally
         publishes ``train_hbm_gbs`` gauges with the same phase split,
         so achieved bandwidth shows up in /metrics and the flight
-        recorder next to ratings/s (ISSUE 6)."""
+        recorder next to ratings/s (ISSUE 6). When an introspector is
+        installed (``obs.enable_introspection``), the hand model —
+        ``bytes_per_iteration`` and ``flops_per_iteration``
+        (``ops.sgd.dsgd_flops_per_sweep``) — is also registered against
+        this run's compile key, so the live roofline table
+        (``/rooflinez``) carries the XLA-vs-model cross-check column
+        (ISSUE 9)."""
         if not self._on or not self._walls or not units_per_iteration:
             return
+        if bytes_per_iteration or flops_per_iteration:
+            from large_scale_recommendation_tpu.obs.introspect import (
+                get_introspector,
+            )
+
+            introspector = get_introspector()
+            if introspector is not None:
+                introspector.register_model_cost(
+                    self._key, bytes_per_iteration=bytes_per_iteration,
+                    flops_per_iteration=flops_per_iteration)
 
         def rate(walls, units):
             iters = sum(i for i, _ in walls)

@@ -57,9 +57,17 @@ from large_scale_recommendation_tpu.obs.registry import (
 )
 from large_scale_recommendation_tpu.obs.trace import get_tracer
 
-BUNDLE_VERSION = 1
+# version 2 added device_memory.json; version-1 bundles (written before
+# the device-introspection layer) must stay loadable — an ARCHIVED
+# incident bundle is exactly the artifact this module exists to
+# preserve, so the loader validates per the version it finds
+BUNDLE_VERSION = 2
 BUNDLE_FILES = ("series.json", "events.jsonl", "trace.json", "health.json",
-                "metrics.json", "config.json")
+                "metrics.json", "config.json", "device_memory.json")
+_BUNDLE_FILES_BY_VERSION = {
+    1: BUNDLE_FILES[:-1],
+    2: BUNDLE_FILES,
+}
 # env prefixes worth freezing into a bundle — runtime knobs, never secrets
 _ENV_PREFIXES = ("JAX_", "XLA_", "OBS_", "BENCH_", "LIBTPU", "TPU_")
 
@@ -138,7 +146,8 @@ class FlightRecorder:
                  recent_points: int = 512, decimated_points: int = 512,
                  decimation: int = 8, max_series: int = 1024,
                  histogram_fields: tuple = ("count", "p50", "p99"),
-                 bundle_dir: str | None = None):
+                 bundle_dir: str | None = None,
+                 profile_on_trip_s: float = 0.0):
         self._registry = registry or get_registry()
         self.interval_s = float(interval_s)
         self.recent_points = int(recent_points)
@@ -147,6 +156,12 @@ class FlightRecorder:
         self.max_series = int(max_series)
         self.histogram_fields = tuple(histogram_fields)
         self.bundle_dir = bundle_dir
+        # seconds of jax.profiler capture to attach to AUTO-triggered
+        # bundles (watchdog trip, CRITICAL transition) — 0 disables.
+        # The capture runs AFTER the bundle publishes (forward-looking
+        # by nature: the profiler cannot record the past) and lands in
+        # <bundle>/profile/, best-effort
+        self.profile_on_trip_s = float(profile_on_trip_s)
         self.samples = 0
         # distinct keys refused past max_series (a set, not a counter:
         # the same overflow key is refused again on EVERY sample tick).
@@ -321,6 +336,21 @@ class FlightRecorder:
                 health_report=health_report)
             self.bundles_written += 1
             self.last_bundle = path
+        if self.profile_on_trip_s > 0 and trigger != "manual":
+            # attach a short forward-looking profiler capture to the
+            # published bundle (outside the bundle lock: the capture
+            # sleeps, and a concurrent trigger must not queue behind
+            # it). Best-effort: a busy/absent profiler never voids the
+            # bundle that just landed.
+            try:
+                from large_scale_recommendation_tpu.obs.introspect import (
+                    capture_profile,
+                )
+
+                capture_profile(os.path.join(path, "profile"),
+                                self.profile_on_trip_s)
+            except Exception:
+                pass
         return path
 
     def maybe_dump(self, trigger: str, detail: dict | None = None,
@@ -342,6 +372,17 @@ class FlightRecorder:
 # --------------------------------------------------------------------------
 # Bundle writer + schema contract
 # --------------------------------------------------------------------------
+
+
+def _get_introspector():
+    """Lazy resolve of the installed introspector (bundle writes are
+    cold paths; lazy resolution keeps construction order between the
+    recorder and the introspection layer irrelevant)."""
+    from large_scale_recommendation_tpu.obs.introspect import (
+        get_introspector,
+    )
+
+    return get_introspector()
 
 
 def _safe_health_report(monitor) -> dict:
@@ -376,6 +417,21 @@ def write_bundle(directory: str, *, trigger: str, detail: dict | None = None,
 
     series_doc = (recorder.snapshot() if recorder is not None
                   else {"series": {}, "note": "no flight recorder"})
+    # a FRESH device-memory sample (bytes-in-use/peak/limit per device +
+    # live-array breakdown) — the incident-time state, not the last
+    # cadence tick. Graceful everywhere: no introspector → a note doc;
+    # a failing sampler must not void the bundle
+    introspector = _get_introspector()
+    if introspector is not None:
+        try:
+            device_memory_doc = introspector.sample_device_memory(
+                publish=False)
+        except Exception as e:
+            device_memory_doc = {"note": f"sample failed: {e!r}",
+                                 "supported": False, "devices": []}
+    else:
+        device_memory_doc = {"note": "no introspector installed",
+                             "supported": False, "devices": []}
     event_lines = (events.tail(event_tail) if events is not None else [])
     trace_doc = {"traceEvents": tracer.events()[-span_tail:],
                  "displayTimeUnit": "ms"}
@@ -421,6 +477,7 @@ def write_bundle(directory: str, *, trigger: str, detail: dict | None = None,
         _write_json("health.json", health_report)
         _write_json("metrics.json", registry.snapshot())
         _write_json("config.json", config_doc)
+        _write_json("device_memory.json", device_memory_doc)
         _write_json("manifest.json", manifest)
         if os.path.isdir(directory):  # re-dump to the same explicit path
             import shutil
@@ -461,13 +518,15 @@ def load_bundle(directory: str) -> dict:
                              f"JSON: {e}") from e
 
     manifest = _load("manifest.json")
-    if manifest.get("bundle_version") != BUNDLE_VERSION:
+    version = manifest.get("bundle_version")
+    required_files = _BUNDLE_FILES_BY_VERSION.get(version)
+    if required_files is None:
         raise ValueError(f"bundle {directory}: unsupported bundle_version "
-                         f"{manifest.get('bundle_version')!r}")
+                         f"{version!r}")
     for key in ("created", "trigger", "files", "counts"):
         if key not in manifest:
             raise ValueError(f"bundle {directory}: manifest missing {key!r}")
-    for name in BUNDLE_FILES:
+    for name in required_files:
         if name not in manifest["files"]:
             raise ValueError(
                 f"bundle {directory}: manifest does not list {name}")
@@ -507,9 +566,18 @@ def load_bundle(directory: str) -> dict:
     config = _load("config.json")
     if not isinstance(config.get("env"), dict):
         raise ValueError(f"bundle {directory}: config.json has no env map")
+    if "device_memory.json" in required_files:
+        device_memory = _load("device_memory.json")
+        if not isinstance(device_memory.get("devices"), list):
+            raise ValueError(f"bundle {directory}: device_memory.json has "
+                             "no devices list")
+    else:  # a version-1 bundle predates the device-introspection layer
+        device_memory = {"note": "version-1 bundle (no device memory "
+                                 "sample)", "supported": False,
+                         "devices": []}
     return {"manifest": manifest, "series": series, "events": events,
             "trace": trace, "health": health, "metrics": metrics,
-            "config": config}
+            "config": config, "device_memory": device_memory}
 
 
 def validate_bundle(directory: str) -> dict:

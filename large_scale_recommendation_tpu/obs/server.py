@@ -18,6 +18,15 @@ minimal, can expose its live state to a scraper or a ``curl``:
   (``FlightRecorder.snapshot()``): the lead-up, not just the instant.
 - ``/eventz``   — the structured event journal's recent ring
   (``EventJournal.snapshot()``): swaps, checkpoints, trips, rolls.
+- ``/rooflinez`` — the live per-kernel roofline table
+  (``obs.introspect.Introspector.roofline()``): XLA flops/bytes per
+  compile key joined with measured execute walls, pct-of-peak columns.
+- ``/profilez``  — on-demand ``jax.profiler`` capture:
+  ``GET /profilez?seconds=N`` records N seconds (capped, default 1)
+  of the whole process into an artifact directory (``profile_dir`` or
+  a fresh tempdir) and returns its path. The request blocks for the
+  capture window; a concurrent capture answers 409 (the jax profiler
+  is a process singleton).
 
 Usage::
 
@@ -48,12 +57,20 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from large_scale_recommendation_tpu.obs.events import get_events
 from large_scale_recommendation_tpu.obs.health import CRITICAL
+from large_scale_recommendation_tpu.obs.introspect import get_introspector
 from large_scale_recommendation_tpu.obs.recorder import get_recorder
 from large_scale_recommendation_tpu.obs.registry import get_registry
 from large_scale_recommendation_tpu.obs.trace import get_tracer
 
 DEFAULT_TRACEZ_LIMIT = 256
 DEFAULT_EVENTZ_LIMIT = 256
+# /profilez bounds: default capture window and the hard cap a query
+# param cannot exceed — an endpoint request must not pin the profiler
+# (and the handler thread) for minutes
+DEFAULT_PROFILE_SECONDS = 1.0
+MAX_PROFILE_SECONDS = 60.0
+
+PROM_CTYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
 def http_get(url: str, timeout: float = 10.0) -> tuple[int, str]:
@@ -75,29 +92,63 @@ def http_get(url: str, timeout: float = 10.0) -> tuple[int, str]:
         return 599, repr(e)
 
 
-class ObsServer:
-    """Background-thread HTTP server over one registry/tracer/monitor.
+class _HandlerBase(BaseHTTPRequestHandler):
+    """Shared GET plumbing for every obs endpoint server (this one and
+    ``obs.fleet.FleetServer``): path/query split, route dispatch,
+    Content-Length framing, the 500-on-exception wrapper, quiet logs —
+    ONE copy so the HTTP semantics cannot drift between servers.
+    ``EndpointServerBase.start`` builds a per-instance subclass
+    carrying the owning server as ``endpoint``."""
 
-    ``registry``/``tracer`` default to the module-level ones AT
-    CONSTRUCTION (build the server after ``obs.enable()``), ``monitor``
-    is optional. ``port=0`` binds an ephemeral port — read ``.port`` /
-    ``.url`` after ``start()``. ``host`` defaults to loopback: exposing
-    metrics beyond the machine is a deployment decision, not a default.
-    """
+    endpoint: "EndpointServerBase"
 
-    def __init__(self, registry=None, tracer=None, monitor=None,
-                 recorder=None, events=None,
-                 host: str = "127.0.0.1", port: int = 0,
-                 tracez_limit: int = DEFAULT_TRACEZ_LIMIT,
-                 eventz_limit: int = DEFAULT_EVENTZ_LIMIT):
-        self.registry = registry or get_registry()
-        self.tracer = tracer or get_tracer()
-        self.monitor = monitor
-        # flight-recorder surfaces: default to whatever is installed at
-        # construction (None stays None — the routes answer with a note)
-        self.recorder = recorder if recorder is not None else get_recorder()
-        self.events = events if events is not None else get_events()
-        self.eventz_limit = int(eventz_limit)
+    def do_GET(self):  # noqa: N802 (http.server API)
+        raw_path, _, query = self.path.partition("?")
+        path = raw_path.rstrip("/") or "/"
+        try:
+            result = self.endpoint.route(path, query)
+            if result is None:
+                self._send_json(404, {"error": f"no route {path!r}"})
+            elif len(result) == 3:  # (code, text body, content type)
+                code, body, ctype = result
+                self._send(code, body, ctype)
+            else:  # (code, json-able doc)
+                code, doc = result
+                self._send_json(code, doc)
+        except Exception as e:  # surface, don't kill the thread
+            try:
+                self._send_json(500, {"error": repr(e)})
+            except OSError:
+                pass  # client went away mid-error
+
+    def _send(self, code: int, body: str, ctype: str) -> None:
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _send_json(self, code: int, doc: dict) -> None:
+        self._send(code, json.dumps(doc),
+                   "application/json; charset=utf-8")
+
+    def log_message(self, fmt, *args):  # quiet: scrapes are not news
+        pass
+
+
+class EndpointServerBase:
+    """Shared lifecycle for the obs endpoint servers: ephemeral-port
+    bind (``port=0`` → read ``.port``/``.url`` after ``start()``),
+    daemon ``serve_forever`` thread, deterministic ``stop()``
+    (shutdown + close + join), context-manager form. Subclasses
+    implement ``route(path, query)`` returning ``(code, doc)`` for
+    JSON, ``(code, text, content_type)`` for raw bodies, or ``None``
+    for 404."""
+
+    thread_prefix = "obs-endpoint"
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self.host = host
         self.port = int(port)
         # the port the caller ASKED for, kept separate from the bound
@@ -105,23 +156,23 @@ class ObsServer:
         # ephemeral port, not re-claim the last one (EADDRINUSE if any
         # other process grabbed it in between)
         self._requested_port = int(port)
-        self.tracez_limit = int(tracez_limit)
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
-    # -- lifecycle -----------------------------------------------------------
+    def route(self, path: str, query: str):
+        raise NotImplementedError
 
-    def start(self) -> "ObsServer":
+    def start(self):
         if self._httpd is not None:
             return self
-        handler = _make_handler(self)
+        handler = type("Handler", (_HandlerBase,), {"endpoint": self})
         self._httpd = ThreadingHTTPServer((self.host, self._requested_port),
                                           handler)
         self._httpd.daemon_threads = True
         self.port = self._httpd.server_address[1]
         self._thread = threading.Thread(
             target=self._httpd.serve_forever,
-            name=f"obs-server:{self.port}", daemon=True)
+            name=f"{self.thread_prefix}:{self.port}", daemon=True)
         self._thread.start()
         return self
 
@@ -142,11 +193,76 @@ class ObsServer:
     def running(self) -> bool:
         return self._httpd is not None
 
-    def __enter__(self) -> "ObsServer":
+    def __enter__(self):
         return self.start()
 
     def __exit__(self, exc_type, exc, tb) -> None:
         self.stop()
+
+
+class ObsServer(EndpointServerBase):
+    """Background-thread HTTP server over one registry/tracer/monitor.
+
+    ``registry``/``tracer`` default to the module-level ones AT
+    CONSTRUCTION (build the server after ``obs.enable()``), ``monitor``
+    is optional. ``port=0`` binds an ephemeral port — read ``.port`` /
+    ``.url`` after ``start()``. ``host`` defaults to loopback: exposing
+    metrics beyond the machine is a deployment decision, not a default.
+    """
+
+    thread_prefix = "obs-server"
+
+    def __init__(self, registry=None, tracer=None, monitor=None,
+                 recorder=None, events=None, introspector=None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 tracez_limit: int = DEFAULT_TRACEZ_LIMIT,
+                 eventz_limit: int = DEFAULT_EVENTZ_LIMIT,
+                 profile_dir: str | None = None):
+        super().__init__(host=host, port=port)
+        self.registry = registry or get_registry()
+        self.tracer = tracer or get_tracer()
+        self.monitor = monitor
+        # flight-recorder surfaces: default to whatever is installed at
+        # construction (None stays None — the routes answer with a note)
+        self.recorder = recorder if recorder is not None else get_recorder()
+        self.events = events if events is not None else get_events()
+        self.introspector = (introspector if introspector is not None
+                             else get_introspector())
+        self.profile_dir = profile_dir
+        self.eventz_limit = int(eventz_limit)
+        self.tracez_limit = int(tracez_limit)
+
+    # -- routing -------------------------------------------------------------
+
+    def route(self, path: str, query: str):
+        if path == "/metrics":
+            return 200, self.registry.to_prometheus(), PROM_CTYPE
+        if path in ("/healthz", "/health"):
+            return self.healthz()
+        if path == "/varz":
+            return 200, self.registry.snapshot()
+        if path == "/tracez":
+            return 200, self.tracez()
+        if path == "/seriesz":
+            return 200, self.seriesz()
+        if path == "/eventz":
+            return 200, self.eventz()
+        if path == "/rooflinez":
+            return 200, self.rooflinez()
+        if path == "/profilez":
+            from urllib.parse import parse_qs
+
+            raw = parse_qs(query).get("seconds", [None])[0]
+            try:
+                seconds = None if raw is None else float(raw)
+            except ValueError:  # client error, not a capture failure
+                return 400, {"error": f"bad seconds param {raw!r}"}
+            return self.profilez(seconds)
+        if path == "/":
+            return 200, {"routes": ["/metrics", "/healthz", "/varz",
+                                    "/tracez", "/seriesz", "/eventz",
+                                    "/rooflinez", "/profilez"]}
+        return None
 
     # -- route bodies (shared with tests / in-process callers) --------------
 
@@ -176,54 +292,34 @@ class ObsServer:
             return {"note": "no event journal attached", "recent": []}
         return self.events.snapshot(limit=self.eventz_limit)
 
+    def rooflinez(self) -> dict:
+        if self.introspector is None:
+            return {"note": "no introspector installed "
+                            "(obs.enable_introspection())", "rows": []}
+        return self.introspector.roofline()
 
-def _make_handler(server: ObsServer):
-    class Handler(BaseHTTPRequestHandler):
-        # one handler class per server instance; the closure carries the
-        # bound registry/tracer/monitor without module-global state
+    def profilez(self, seconds: float | None = None) -> tuple[int, dict]:
+        """(http_status, body) for ``/profilez``: run one N-second
+        profiler capture into ``profile_dir`` (fresh tempdir when
+        unset), 409 when a capture is already in flight."""
+        import os
+        import tempfile
 
-        def do_GET(self):  # noqa: N802 (http.server API)
-            path = self.path.split("?", 1)[0].rstrip("/") or "/"
-            try:
-                if path == "/metrics":
-                    self._send(200, server.registry.to_prometheus(),
-                               "text/plain; version=0.0.4; charset=utf-8")
-                elif path in ("/healthz", "/health"):
-                    code, report = server.healthz()
-                    self._send_json(code, report)
-                elif path == "/varz":
-                    self._send_json(200, server.registry.snapshot())
-                elif path == "/tracez":
-                    self._send_json(200, server.tracez())
-                elif path == "/seriesz":
-                    self._send_json(200, server.seriesz())
-                elif path == "/eventz":
-                    self._send_json(200, server.eventz())
-                elif path == "/":
-                    self._send_json(200, {"routes": ["/metrics", "/healthz",
-                                                     "/varz", "/tracez",
-                                                     "/seriesz", "/eventz"]})
-                else:
-                    self._send_json(404, {"error": f"no route {path!r}"})
-            except Exception as e:  # surface, don't kill the thread
-                try:
-                    self._send_json(500, {"error": repr(e)})
-                except OSError:
-                    pass  # client went away mid-error
+        from large_scale_recommendation_tpu.obs.introspect import (
+            capture_profile,
+        )
 
-        def _send(self, code: int, body: str, ctype: str) -> None:
-            data = body.encode("utf-8")
-            self.send_response(code)
-            self.send_header("Content-Type", ctype)
-            self.send_header("Content-Length", str(len(data)))
-            self.end_headers()
-            self.wfile.write(data)
+        seconds = (DEFAULT_PROFILE_SECONDS if seconds is None
+                   else min(max(0.0, float(seconds)), MAX_PROFILE_SECONDS))
+        if self.profile_dir is not None:
+            os.makedirs(self.profile_dir, exist_ok=True)
+            out_dir = tempfile.mkdtemp(prefix="profilez-",
+                                       dir=self.profile_dir)
+        else:
+            out_dir = tempfile.mkdtemp(prefix="profilez-")
+        try:
+            return 200, capture_profile(out_dir, seconds)
+        except RuntimeError as e:
+            return 409, {"error": str(e)}
 
-        def _send_json(self, code: int, doc: dict) -> None:
-            self._send(code, json.dumps(doc),
-                       "application/json; charset=utf-8")
 
-        def log_message(self, fmt, *args):  # quiet: scrapes are not news
-            pass
-
-    return Handler

@@ -40,6 +40,12 @@ from typing import Any
 # cap on buffered events: a runaway instrumented loop must not grow the
 # host heap without bound; overflow is counted, not silently dropped
 DEFAULT_MAX_EVENTS = 200_000
+# cap on the per-compile-key wall-aggregate table: compile keys embed
+# SHAPES, so a long-lived process with churning geometries (growing
+# catalogs, online table growth) mints fresh keys forever — same
+# bounded-memory discipline as the flight recorder's series table and
+# the introspector's record table
+DEFAULT_MAX_KEY_WALLS = 4096
 
 # span ids are PROCESS-unique (module-level, not per-tracer): an
 # enable()/disable()/enable() cycle must not restart the sequence, or a
@@ -66,18 +72,22 @@ class Span:
     display attributes via ``args``. ``id`` is process-unique and lands
     in the exported event's args — the correlation token
     ``obs.events.EventJournal`` stamps onto events emitted while this
-    span is open."""
+    span is open. ``key`` is the compile key (or None): while the span
+    is open, ``obs.introspect`` attributes any XLA compile that fires
+    to it, which is how executables join the span family."""
 
-    __slots__ = ("name", "cat", "t0", "args", "out", "id", "_tracer")
+    __slots__ = ("name", "cat", "t0", "args", "out", "id", "key",
+                 "_tracer")
 
     def __init__(self, tracer: "Tracer", name: str, cat: str, args: dict,
-                 span_id: int):
+                 span_id: int, key: Any = None):
         self._tracer = tracer
         self.name = name
         self.cat = cat
         self.args = args
         self.out = None
         self.id = span_id
+        self.key = key
         self.t0 = 0.0
 
     def __enter__(self) -> "Span":
@@ -105,6 +115,7 @@ class _NullSpan:
     cat = ""
     args: dict = {}
     id = None
+    key = None
 
     # writes to .out on the shared singleton are dropped (it has no
     # per-instance storage), which is exactly the point
@@ -141,6 +152,14 @@ class Tracer:
         self._lock = threading.Lock()
         self._local = threading.local()
         self._compile_keys: set = set()
+        # per-compile-key wall aggregates (compile/execute split), the
+        # measured half of the roofline join in ``obs.introspect``:
+        # key → {compile_count, compile_total_s, execute_count,
+        # execute_total_s, execute_min_s, execute_max_s, iterations}.
+        # Hard-capped: fresh keys past the cap are counted, not stored
+        self.max_key_walls = DEFAULT_MAX_KEY_WALLS
+        self.key_walls_dropped = 0
+        self._key_walls: dict = {}
         # perf_counter → epoch-anchored microseconds, so traces from
         # separate processes can be laid side by side
         self._origin = time.time() - time.perf_counter()
@@ -169,7 +188,7 @@ class Tracer:
                 else:
                     self._compile_keys.add(key)
                     cat = "compile"
-        return Span(self, name, cat, args, next(_SPAN_IDS))
+        return Span(self, name, cat, args, next(_SPAN_IDS), key)
 
     def depth(self) -> int:
         """Current nesting depth on the calling thread."""
@@ -184,8 +203,58 @@ class Tracer:
         stack = self._stack()
         return stack[-1].id if stack else None
 
+    def current_compile_key(self) -> Any:
+        """The compile key of the innermost OPEN keyed span on the
+        calling thread, or ``None`` — how ``obs.introspect`` attributes
+        an XLA compile firing mid-span to the span family that carried
+        it (the first call at a key is the one that pays the compile,
+        so any executable built while that span is open belongs to
+        it)."""
+        for span in reversed(self._stack()):
+            if span.key is not None:
+                return span.key
+        return None
+
+    def key_walls(self) -> dict:
+        """Snapshot of the per-compile-key wall aggregates: for every
+        keyed span family, the compile-labeled count/total wall and the
+        execute-labeled count/total/min/max walls plus the summed
+        ``iterations`` span arg (1 per span when absent) — the measured
+        side ``obs.introspect.roofline_rows`` joins against XLA's
+        cost analysis."""
+        with self._lock:
+            return {k: dict(v) for k, v in self._key_walls.items()}
+
+    def _aggregate_key_wall(self, span: Span, wall_s: float) -> None:
+        # caller holds self._lock
+        agg = self._key_walls.get(span.key)
+        if agg is None:
+            if len(self._key_walls) >= self.max_key_walls:
+                self.key_walls_dropped += 1
+                return
+            agg = self._key_walls[span.key] = {
+                "compile_count": 0, "compile_total_s": 0.0,
+                "execute_count": 0, "execute_total_s": 0.0,
+                "execute_min_s": float("inf"), "execute_max_s": 0.0,
+                "iterations": 0,
+            }
+        if span.cat == "compile":
+            agg["compile_count"] += 1
+            agg["compile_total_s"] += wall_s
+        else:
+            agg["execute_count"] += 1
+            agg["execute_total_s"] += wall_s
+            agg["execute_min_s"] = min(agg["execute_min_s"], wall_s)
+            agg["execute_max_s"] = max(agg["execute_max_s"], wall_s)
+            try:
+                agg["iterations"] += int(span.args.get("iterations", 1))
+            except (TypeError, ValueError):
+                agg["iterations"] += 1
+
     def _record(self, span: Span, t1: float) -> None:
         with self._lock:
+            if span.key is not None:
+                self._aggregate_key_wall(span, t1 - span.t0)
             if len(self._events) >= self.max_events:
                 self.dropped += 1
                 return
@@ -284,6 +353,8 @@ class NullTracer(Tracer):
     def __init__(self):  # no buffer, no lock
         self.max_events = 0
         self.dropped = 0
+        self.max_key_walls = 0
+        self.key_walls_dropped = 0
 
     def span(self, name: str, key: Any = None, **args):
         return NULL_SPAN
@@ -296,6 +367,12 @@ class NullTracer(Tracer):
 
     def current_span_id(self) -> int | None:
         return None
+
+    def current_compile_key(self) -> Any:
+        return None
+
+    def key_walls(self) -> dict:
+        return {}
 
     def install_jax_compile_hook(self, registry=None) -> bool:
         return False

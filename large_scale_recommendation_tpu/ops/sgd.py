@@ -55,6 +55,16 @@ def dsgd_bytes_per_sweep(nnz: int, rank: int, *, kernel: str = "xla",
     return int(nnz * (4 * rank * factor_bytes + 16))
 
 
+def dsgd_flops_per_sweep(nnz: int, rank: int) -> int:
+    """FLOPs one full DSGD sweep computes: ~6·rank per rating visit
+    (2·rank for the prediction dot, ~4·rank for the error broadcast and
+    the two factor deltas). The FLOP twin of ``dsgd_bytes_per_sweep`` —
+    the ONE hand model behind bench.py's ``effective_tflops`` and the
+    ``/rooflinez`` model column, so the accounting cannot drift between
+    them."""
+    return int(nnz * 6 * rank)
+
+
 def sgd_minibatch_update(
     U: jax.Array,
     V: jax.Array,

@@ -481,5 +481,8 @@ class MeshDSGD:
             None if n_ratings is None else sgd_ops.dsgd_bytes_per_sweep(
                 n_ratings, int(np.shape(U)[-1]), kernel=cfg.kernel,
                 num_blocks=k, rows_u=int(np.shape(U)[0]),
-                rows_v=int(np.shape(V)[0]), factor_bytes=fdt.itemsize)))
+                rows_v=int(np.shape(V)[0]), factor_bytes=fdt.itemsize)),
+            flops_per_iteration=(
+                None if n_ratings is None else sgd_ops.dsgd_flops_per_sweep(
+                    n_ratings, int(np.shape(U)[-1]))))
         return U, V

@@ -12,8 +12,10 @@ depth logged on every change (PSOfflineMF.scala:122,163), buffer depth every
   metric (BASELINE.md).
 - ``MetricsLog``: in-memory structured records + optional stdlib logging;
   the seam a dashboard would consume.
-- ``profile``: context manager around ``jax.profiler.trace`` producing
-  TensorBoard-loadable traces of the XLA timeline.
+- ``profile``: DEPRECATED capture shim — routes through the unified
+  ``obs.introspect.profile_trace`` layer (one process-singleton
+  profiler lock shared with ``/profilez`` and watchdog postmortem
+  captures) instead of calling ``jax.profiler`` on its own.
 
 These helpers predate the unified observability layer (``obs/``) and are
 now thin **shims over it**: each one keeps its original surface (every
@@ -436,14 +438,29 @@ def top_k_recommend(U, V, user_rows, k: int = 10,
 
 @contextlib.contextmanager
 def profile(log_dir: str | None) -> Iterator[None]:
-    """Trace the XLA timeline to ``log_dir`` (TensorBoard format).
+    """DEPRECATED shim: trace the XLA timeline to ``log_dir``
+    (TensorBoard format). No-op when ``log_dir`` is None so call sites
+    can leave the hook wired unconditionally.
 
-    No-op when ``log_dir`` is None so call sites can leave the hook wired
-    unconditionally."""
+    This no longer calls ``jax.profiler.trace`` on its own — it routes
+    through ``obs.introspect.profile_trace``, the ONE capture layer
+    (shared process-singleton lock + capture accounting with
+    ``/profilez`` and the watchdog postmortem auto-capture), so two
+    capture paths can never race the profiler singleton. New code
+    should call ``obs.introspect.profile_trace`` /
+    ``obs.capture_profile`` directly; this surface stays only for
+    existing callers (``bench.py``'s ``BENCH_PROFILE``) and warns."""
     if log_dir is None:
         yield
         return
-    import jax
+    import warnings
 
-    with jax.profiler.trace(log_dir):
+    warnings.warn(
+        "utils.metrics.profile is deprecated: use "
+        "obs.introspect.profile_trace (or GET /profilez on a running "
+        "ObsServer) — this shim routes there and will be removed",
+        DeprecationWarning, stacklevel=3)
+    from large_scale_recommendation_tpu.obs.introspect import profile_trace
+
+    with profile_trace(log_dir):
         yield

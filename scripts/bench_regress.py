@@ -65,6 +65,16 @@ DEFAULT_KEYS: dict[str, float] = {
     # regression even when ratings/s noise hides it
     "effective_hbm_gbs": 30.0,
     "pct_of_hbm_peak": 30.0,
+    # compile-time gate (ISSUE 9): compile_wall_s is the headline
+    # kernel's hand-bracketed warm-up, compile_count /
+    # xla_compile_wall_s the introspection hook's whole-run totals —
+    # LOWER is better (a bucket-family explosion or a cache miss shows
+    # up here long before throughput noise admits it). compile_count is
+    # near-deterministic for the same code path, so its threshold is
+    # tight; walls ride shared machines, so loose.
+    "compile_wall_s": 50.0,
+    "xla_compile_wall_s": 50.0,
+    "compile_count": 10.0,
 }
 
 # watched keys for the MULTICHIP_r*.json trajectory (the pod_dryrun
@@ -117,11 +127,11 @@ DEFAULT_HIGHER = ("_ratings_per_s", "_rows_per_s", "_users_per_s",
                   "_hbm_gbs", "_tflops", "_mbps", "qps_at_slo",
                   "recall_at", "_vs_exact")
 
-# keys where LOWER is better (walls, latencies, pad/layout overheads)
-# when watched explicitly
+# keys where LOWER is better (walls, latencies, pad/layout overheads,
+# compile counts) when watched explicitly
 DEFAULT_LOWER = ("_wall_s", "_ms_", "time_to_", "_s_p", "_pad_ratio",
                  "layout_mb", "layout_bytes", "p99_ms", "p50_ms",
-                 "shed_frac")
+                 "shed_frac", "compile_count")
 
 _NUM_PAIR = re.compile(
     r'"([A-Za-z_][A-Za-z0-9_]*)":\s*(-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)')

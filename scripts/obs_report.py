@@ -8,11 +8,20 @@ side::
     python scripts/obs_report.py metrics.jsonl --name serving_flush_s
     python scripts/obs_report.py http://127.0.0.1:8080/varz --watch 2
     python scripts/obs_report.py --bundle postmortem/bundle_watchdog_trip_000
+    python scripts/obs_report.py --roofline http://127.0.0.1:8080/rooflinez
+    python scripts/obs_report.py --roofline roofline.json
 
 ``--bundle <dir>`` renders a postmortem bundle (``obs.recorder``):
 validates it first (``validate_bundle`` — a torn bundle is an error,
 not a pretty table), then prints the trigger/detail, the health report,
 the event tail, and a per-series summary of the recorded lead-up.
+
+``--roofline <src>`` renders the live per-kernel roofline table
+(``obs.introspect``): one row per compile key with XLA's cost-analysis
+FLOPs/bytes-accessed, the measured execute wall, achieved GB/s and
+TFLOP/s, pct-of-HBM/FP32-peak, and the XLA-vs-hand-model bytes
+cross-check. ``src`` is a ``/rooflinez`` URL on a live server or a
+dumped roofline JSON file (``examples/obs_demo.py`` writes one).
 
 Input is a single-snapshot JSON file, a JSONL metrics log
 (``MetricsRegistry.append_jsonl``), or — live mode — an HTTP URL to a
@@ -293,6 +302,51 @@ def render_bundle(directory: str, name_filter: str | None = None,
     return "\n".join(out)
 
 
+def render_roofline(doc: dict, name_filter: str | None = None) -> str:
+    """Render one roofline document (``/rooflinez`` body or
+    ``Introspector.roofline()``): header with compile totals + chip
+    peaks, then one row per compile key. Wall-less rows (key compiled
+    but never executed a steady-state span) render with ``-`` in the
+    measured columns rather than being dropped — a compiled-but-unused
+    kernel is information."""
+    rows = doc.get("rows", [])
+    if name_filter:
+        rows = [r for r in rows if name_filter in r["key"]]
+    out = [
+        "# per-kernel roofline "
+        f"(HBM peak {_fmt(doc.get('hbm_peak_gbs'))} GB/s, "
+        f"fp32 peak {_fmt(doc.get('fp32_peak_tflops'))} TFLOP/s)",
+        f"compiles: {doc.get('compile_count', '-')} totalling "
+        f"{_fmt(doc.get('compile_wall_s'))}s"
+        + (f"; note: {doc['note']}" if doc.get("note") else ""),
+        "",
+    ]
+    if not rows:
+        out.append("(no compile records)")
+        return "\n".join(out)
+
+    def num(v, scale=1.0):
+        return "-" if v is None else _fmt(v * scale)
+
+    cells = [(r["key"][:64], str(r["compiles"]),
+              num(r.get("compile_wall_s")),
+              num(r.get("xla_flops"), 1e-9),
+              num(r.get("xla_bytes_accessed"), 1e-6),
+              str(r.get("execute_count", 0)),
+              num(r.get("wall_per_exec_s"), 1e3),
+              num(r.get("achieved_gbs")),
+              num(r.get("pct_of_hbm_peak")),
+              num(r.get("pct_of_fp32_peak")),
+              num(r.get("xla_vs_model_bytes")))
+             for r in sorted(rows,
+                             key=lambda r: -(r.get("xla_bytes_accessed")
+                                             or 0))]
+    header = ("compile key", "comp", "comp_s", "GFLOP", "MB_acc", "execs",
+              "ms/exec", "GB/s", "%HBM", "%FP32", "xla/model")
+    out.extend(format_table(header, cells))
+    return "\n".join(out)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", nargs="?", default=None,
@@ -308,9 +362,15 @@ def main(argv=None) -> int:
                     help="number of --watch polls (default: forever)")
     ap.add_argument("--bundle", default=None, metavar="DIR",
                     help="validate + render a postmortem bundle directory")
+    ap.add_argument("--roofline", default=None, metavar="SRC",
+                    help="render a per-kernel roofline table from a "
+                         "/rooflinez URL or a dumped roofline JSON file")
     args = ap.parse_args(argv)
     if args.bundle is not None:
         print(render_bundle(args.bundle, args.name))
+        return 0
+    if args.roofline is not None:
+        print(render_roofline(fetch_snapshot(args.roofline), args.name))
         return 0
     if args.path is None:
         ap.error("path is required unless --bundle is given")

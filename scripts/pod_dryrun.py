@@ -25,7 +25,11 @@ Layers, all chip-free:
    ``LSR_DRYRUN_NO_2PROC=1``): two real processes coordinate over
    localhost (``jax.distributed``), the global 4-device ring spans both
    — proving cross-process global arrays, ppermute across the process
-   boundary, and sharded checkpoint save/restore
+   boundary, sharded checkpoint save/restore, AND pod observability:
+   each process serves its own ``/metrics``+``/healthz``, process 0
+   aggregates them through ``obs.fleet`` over real sockets and asserts
+   the merged pod ``/metrics`` parses with both hosts labeled and the
+   pod ``/healthz`` is OK (the ``POD FLEET OK`` marker → ``fleet_ok``)
    (examples/distributed_demo.py is the workload).
 
 Prints ONE machine-readable JSON line LAST (stderr flushed first, so
@@ -64,12 +68,18 @@ def run_two_process_pass(timeout_s: float = 420.0) -> dict:
                 if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
     out: dict = {"n_processes": 2}
     t0 = time.perf_counter()
-    with tempfile.TemporaryDirectory() as ckdir:
+    with tempfile.TemporaryDirectory() as ckdir, \
+            tempfile.TemporaryDirectory() as obsdir:
         env_base.update({
             "LSR_COORDINATOR": f"127.0.0.1:{port}",
             "LSR_NUM_PROCESSES": "2",
             "JAX_PLATFORMS": "cpu",
             "LSR_CKPT_DIR": ckdir,
+            # pod observability: each process serves /metrics+/healthz,
+            # process 0 aggregates them through obs.fleet over real
+            # sockets and prints POD FLEET OK after asserting the
+            # merged pod /metrics parses and pod /healthz is OK
+            "LSR_OBS_DIR": obsdir,
         })
         procs = [
             subprocess.Popen(
@@ -105,11 +115,13 @@ def run_two_process_pass(timeout_s: float = 420.0) -> dict:
         out.update(skipped=True,
                    reason="jaxlib lacks cross-process CPU collectives")
         return out
+    out["fleet_ok"] = "POD FLEET OK" in joined
     out["ok"] = (
         all(p.returncode == 0 for p in procs)
         and "DISTRIBUTED DEMO PASS" in joined          # global-ring train
         and joined.count("SHARDED CKPT RESUME OK") == 2  # per-shard ckpt
         and joined.count("parity OK") == 2             # mesh ALS parity
+        and "POD FLEET OK" in joined                   # pod /metrics+/healthz
         and any(".shard0of2" in n for n in shard_files)
         and any(".shard1of2" in n for n in shard_files)
     )

@@ -42,6 +42,12 @@ if _OBS_OUT:
     _OBS_REG, _OBS_TRACER = _obs.enable()
     _OBS_RECORDER, _OBS_JOURNAL = _obs.enable_flight_recorder(
         interval_s=1.0, bundle_dir=os.path.join(_OBS_OUT, "postmortem"))
+    # XLA introspection for the whole session: every compile the suite
+    # pays is captured at the funnel (cost analysis + wall, attributed
+    # to the enclosing compile key), the device-memory sampler feeds
+    # the recorder, and sessionfinish renders the joined roofline as a
+    # tier-1 artifact (tier1_roofline.json/.txt)
+    _OBS_INTROSPECTOR = _obs.enable_introspection(interval_s=1.0)
     _OBS_MONITOR = _health.HealthMonitor()
 
     def _session_check():
@@ -74,6 +80,10 @@ def null_obs():
         get_events,
         set_events,
     )
+    from large_scale_recommendation_tpu.obs.introspect import (
+        get_introspector,
+        set_introspector,
+    )
     from large_scale_recommendation_tpu.obs.recorder import (
         get_recorder,
         set_recorder,
@@ -89,13 +99,20 @@ def null_obs():
 
     prev_r, prev_t = get_registry(), get_tracer()
     prev_j, prev_rec = get_events(), get_recorder()
+    prev_ins = get_introspector()
     was_running = prev_rec is not None and prev_rec.running
-    obs.disable()
+    ins_was_running = prev_ins is not None and prev_ins.running
+    obs.disable()  # closes the introspector too: compile funnel unpatched
     yield get_registry()
     set_registry(prev_r)
     set_tracer(prev_t)
     set_events(prev_j)
     set_recorder(prev_rec)
+    set_introspector(prev_ins)
+    if prev_ins is not None:  # an OBS_OUT session runs one suite-wide
+        prev_ins.install()
+        if ins_was_running:
+            prev_ins.start()
     if was_running:
         prev_rec.start()
 
@@ -110,6 +127,20 @@ def pytest_sessionfinish(session, exitstatus):
     os.makedirs(_OBS_OUT, exist_ok=True)
     _OBS_REG.append_jsonl(os.path.join(_OBS_OUT, "tier1_metrics.jsonl"))
     _OBS_TRACER.to_chrome_trace(os.path.join(_OBS_OUT, "tier1_trace.json"))
+    # the session's per-kernel roofline: every compile key the suite
+    # exercised, XLA cost analysis joined with measured execute walls
+    try:
+        from scripts.obs_report import render_roofline
+
+        _roofline = _OBS_INTROSPECTOR.roofline()
+        with open(os.path.join(_OBS_OUT, "tier1_roofline.json"), "w") as f:
+            json.dump(_roofline, f, indent=2)
+        with open(os.path.join(_OBS_OUT, "tier1_roofline.txt"), "w") as f:
+            f.write(render_roofline(_roofline) + "\n")
+    except Exception as e:  # artifact-only: never fail the session on it
+        with open(os.path.join(_OBS_OUT, "tier1_roofline_error.txt"),
+                  "w") as f:
+            f.write(repr(e))
     # scrape the session's endpoint server for real: the artifacts below
     # came over the socket, not from in-process calls (http_get turns a
     # dead-server connection failure into a synthetic 599, so both

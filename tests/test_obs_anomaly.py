@@ -12,6 +12,7 @@ import pytest
 from large_scale_recommendation_tpu import obs
 from large_scale_recommendation_tpu.obs.anomaly import (
     AnomalyCheck,
+    MonotonicGrowthCheck,
     ewma_mean_var,
     ewma_zscore,
     rate_of_change,
@@ -223,6 +224,101 @@ class TestAnomalyCheck:
             AnomalyCheck(rec, "x", warmup=1)
         with pytest.raises(ValueError):
             AnomalyCheck(rec, "x", degraded_z=5, critical_z=3)
+
+
+class TestMonotonicGrowth:
+    """The HBM leak detector (ISSUE 9): monotonic growth is the signal
+    the EWMA z-score can't see — each step sits inside the learned
+    variance; the unbroken run is what kills the process."""
+
+    def _fill(self, reg, rec, name, values, device="tpu:0"):
+        g = reg.gauge(name, device=device)
+        for v in values:
+            g.set(v)
+            rec.sample()
+
+    def test_absent_series_is_ok_graceful(self, flight_obs):
+        # CPU: no allocator stats surface → the sampler publishes no
+        # device_bytes_in_use series — the documented graceful path
+        _, _, rec, _ = flight_obs
+        check = MonotonicGrowthCheck(rec)
+        res = check()
+        assert res.status == OK
+        assert "absent" in res.detail["note"]
+
+    def test_steady_then_leak_degrades_then_criticals(self, flight_obs):
+        reg, _, rec, _ = flight_obs
+        check = MonotonicGrowthCheck(rec, min_run=8,
+                                     degraded_growth_frac=0.05,
+                                     critical_growth_frac=0.5)
+        base = 1000.0
+        # steady state with jitter: runs keep breaking, never flags
+        rng = np.random.default_rng(5)
+        self._fill(reg, rec, "device_bytes_in_use",
+                   base + rng.normal(0, 5, 30))
+        assert check().status == OK
+        # a slow monotonic climb: +1% per sample — EWMA-invisible
+        self._fill(reg, rec, "device_bytes_in_use",
+                   [base * (1 + 0.01 * i) for i in range(1, 12)])
+        res = check()
+        assert res.status == DEGRADED
+        assert res.detail["run_points"] >= 8
+        # keep leaking past +50% of the run start → CRITICAL
+        self._fill(reg, rec, "device_bytes_in_use",
+                   [base * (1.12 + 0.1 * i) for i in range(1, 8)])
+        assert check().status == CRITICAL
+
+    def test_flat_run_is_not_growth(self, flight_obs):
+        reg, _, rec, _ = flight_obs
+        check = MonotonicGrowthCheck(rec, min_run=4)
+        self._fill(reg, rec, "device_bytes_in_use", [512.0] * 20)
+        assert check().status == OK  # non-decreasing but never growing
+
+    def test_startup_ramp_then_plateau_clears(self, flight_obs):
+        """A normal allocation ramp (near-zero → model resident) that
+        then goes FLAT must clear within min_run plateau samples — a
+        plateau is stability, not a leak; without the recency guard the
+        near-zero ramp base made growth_frac astronomical and the check
+        read CRITICAL until the ramp aged out of the whole window."""
+        reg, _, rec, _ = flight_obs
+        check = MonotonicGrowthCheck(rec, min_run=4)
+        # the ramp itself IS monotonic growth: flagging during it is
+        # the detector's contract
+        self._fill(reg, rec, "device_bytes_in_use",
+                   [10.0 * 2 ** i for i in range(8)])
+        assert check().status == CRITICAL
+        # plateau: min_run flat samples later the verdict is clean
+        self._fill(reg, rec, "device_bytes_in_use", [10.0 * 2 ** 7] * 4)
+        assert check().status == OK
+
+    def test_worst_wins_across_devices(self, flight_obs):
+        reg, _, rec, _ = flight_obs
+        check = MonotonicGrowthCheck(rec, min_run=4,
+                                     degraded_growth_frac=0.05,
+                                     critical_growth_frac=10.0)
+        for i in range(10):
+            reg.gauge("device_bytes_in_use", device="tpu:0").set(100.0)
+            reg.gauge("device_bytes_in_use",
+                      device="tpu:1").set(100.0 * (1 + 0.05 * i))
+            rec.sample()
+        res = check()
+        assert res.status == DEGRADED
+        assert 'tpu:1' in res.detail["series"]
+
+    def test_watch_device_memory_registers(self, flight_obs):
+        _, _, rec, _ = flight_obs
+        monitor = HealthMonitor()
+        monitor.watch_device_memory(rec)
+        assert "device_memory" in monitor.names()
+        assert monitor.run()["status"] == OK  # absent series on CPU
+
+    def test_validation(self, flight_obs):
+        _, _, rec, _ = flight_obs
+        with pytest.raises(ValueError):
+            MonotonicGrowthCheck(rec, min_run=1)
+        with pytest.raises(ValueError):
+            MonotonicGrowthCheck(rec, degraded_growth_frac=0.9,
+                                 critical_growth_frac=0.1)
 
 
 class TestHealthzFlipsOnCollapse:

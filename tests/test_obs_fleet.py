@@ -1,0 +1,154 @@
+"""Fleet aggregation tests (ISSUE 9): the Prometheus merge/parse
+contract, pod aggregation over TWO real sockets (the test-pinned half
+of the pod_dryrun acceptance), worst-status-wins with unreachable
+targets, and the FleetServer endpoint routes."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from large_scale_recommendation_tpu.obs.fleet import (
+    FleetAggregator,
+    FleetServer,
+    add_host_label,
+    merge_prometheus,
+    parse_prometheus,
+)
+from large_scale_recommendation_tpu.obs.health import (
+    CRITICAL,
+    HealthMonitor,
+    critical,
+    ok,
+)
+from large_scale_recommendation_tpu.obs.registry import MetricsRegistry
+from large_scale_recommendation_tpu.obs.server import ObsServer, http_get
+
+
+class TestPrometheusText:
+    def test_parse_samples_and_labels(self):
+        text = ('# TYPE a counter\n'
+                'a{x="1",y="two"} 3\n'
+                'b 4.5\n'
+                'c{q="0.99"} 1e-3\n')
+        samples = parse_prometheus(text)
+        assert samples == [("a", {"x": "1", "y": "two"}, 3.0),
+                           ("b", {}, 4.5),
+                           ("c", {"q": "0.99"}, 1e-3)]
+
+    def test_parse_escaped_and_nested_label_values(self):
+        # the real hard case: watch_series health checks embed series
+        # keys (with quotes AND braces) as label VALUES
+        text = ('health_check_status'
+                '{check="anomaly:lag{partition=\\"0\\"}"} 1\n')
+        [(name, labels, value)] = parse_prometheus(text)
+        assert name == "health_check_status"
+        assert labels == {"check": 'anomaly:lag{partition="0"}'}
+        assert value == 1.0
+
+    def test_parse_rejects_malformed(self):
+        with pytest.raises(ValueError, match="bad prometheus sample"):
+            parse_prometheus("this is not a sample\n")
+        with pytest.raises(ValueError, match="bad value"):
+            parse_prometheus("a{x=\"1\"} notanumber\n")
+
+    def test_add_host_label(self):
+        out = add_host_label('# TYPE a counter\na{x="1"} 3\nb 4\n',
+                             "10.0.0.1:8321")
+        lines = out.splitlines()
+        assert lines[0] == "# TYPE a counter"
+        assert lines[1] == 'a{x="1",host="10.0.0.1:8321"} 3'
+        assert lines[2] == 'b{host="10.0.0.1:8321"} 4'
+        # round-trips through the strict parser
+        assert all(s[1]["host"] == "10.0.0.1:8321"
+                   for s in parse_prometheus(out))
+
+    def test_merge_dedupes_type_lines(self):
+        a = "# TYPE r counter\nr 1\n"
+        b = "# TYPE r counter\nr 2\n"
+        merged = merge_prometheus([("h1", a), ("h2", b)])
+        assert merged.count("# TYPE r counter") == 1
+        samples = parse_prometheus(merged)
+        assert {(s[1]["host"], s[2]) for s in samples} == \
+            {("h1", 1.0), ("h2", 2.0)}
+
+
+class TestFleetOverRealSockets:
+    """Two real ObsServers (separate registries/monitors) aggregated
+    over actual sockets — the in-process twin of the pod_dryrun
+    2-process pass."""
+
+    @pytest.fixture
+    def two_servers(self, null_obs):
+        r1, r2 = MetricsRegistry(), MetricsRegistry()
+        r1.counter("pod_requests_total", tier="serving").inc(5)
+        r2.counter("pod_requests_total", tier="serving").inc(7)
+        m1, m2 = HealthMonitor(registry=r1), HealthMonitor(registry=r2)
+        m1.register("probe", lambda: ok(note="p0"))
+        m2.register("probe", lambda: ok(note="p1"))
+        s1 = ObsServer(registry=r1, monitor=m1).start()
+        s2 = ObsServer(registry=r2, monitor=m2).start()
+        yield (s1, m1), (s2, m2)
+        s1.stop()
+        s2.stop()
+
+    def test_merged_metrics_covers_both_hosts(self, two_servers):
+        (s1, _), (s2, _) = two_servers
+        view = FleetAggregator([s1.url, s2.url]).scrape()
+        assert view["status"] == "ok"
+        assert view["reachable"] == 2
+        samples = parse_prometheus(view["prometheus"])  # strict
+        hosts = {labels["host"] for _, labels, _ in samples}
+        assert hosts == {f"127.0.0.1:{s1.port}", f"127.0.0.1:{s2.port}"}
+        values = sorted(v for name, _, v in samples
+                        if name == "pod_requests_total")
+        assert values == [5.0, 7.0]
+
+    def test_worst_status_wins(self, two_servers):
+        (s1, _), (s2, m2) = two_servers
+        agg = FleetAggregator([s1.url, s2.url])
+        code, report = agg.healthz()
+        assert (code, report["status"]) == (200, "ok")
+        m2.register("probe", lambda: critical(note="p1 broken"))
+        code, report = agg.healthz()
+        assert (code, report["status"]) == (503, CRITICAL)
+        statuses = {t["url"]: t["status"] for t in report["targets"]}
+        assert statuses[s1.url] == "ok"
+        assert statuses[s2.url] == CRITICAL
+
+    def test_unreachable_target_is_critical(self, two_servers):
+        (s1, _), (s2, _) = two_servers
+        dead = s2.url
+        s2.stop()  # port released: scrapes now fail at connect
+        view = FleetAggregator([s1.url, dead], timeout_s=3.0).scrape()
+        statuses = {t["url"]: t["status"] for t in view["targets"]}
+        assert statuses[dead] == FleetAggregator.UNREACHABLE
+        assert view["status"] == CRITICAL  # a dead member IS an incident
+        assert view["reachable"] == 1
+        code, report = FleetAggregator([s1.url, dead],
+                                       timeout_s=3.0).healthz()
+        assert code == 503
+        assert report["status"] == CRITICAL
+
+    def test_fleet_server_routes(self, two_servers):
+        (s1, _), (s2, _) = two_servers
+        with FleetServer(FleetAggregator([s1.url, s2.url])) as fleet:
+            code, text = http_get(fleet.url + "/metrics")
+            assert code == 200
+            hosts = {labels["host"]
+                     for _, labels, _ in parse_prometheus(text)}
+            assert len(hosts) == 2
+            code, body = http_get(fleet.url + "/healthz")
+            assert code == 200
+            assert json.loads(body)["status"] == "ok"
+            code, body = http_get(fleet.url + "/fleetz")
+            doc = json.loads(body)
+            assert doc["expected"] == 2
+            assert len(doc["targets"]) == 2
+            code, body = http_get(fleet.url + "/")
+            assert "/fleetz" in body
+
+    def test_needs_targets(self):
+        with pytest.raises(ValueError, match="at least one target"):
+            FleetAggregator([])

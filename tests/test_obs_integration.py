@@ -256,6 +256,37 @@ class TestNullPathZeroWork:
         assert wd.tripped and wd.last_bundle is None
         assert null_obs.names() == set()
 
+    def test_introspection_default_off_and_funnel_unpatched(
+            self, null_obs):
+        """The ISSUE-9 extension of the zero-cost pin: with nothing
+        enabled, get_introspector() is None (producer hooks bind that
+        None — TrainSegmentTimer.finish, the bundle writer, the
+        /rooflinez route) and the jax compile funnel is the PRISTINE
+        function — no wrapper, no per-compile work of any kind. An
+        OBS_OUT session patches suite-wide, so the installed hook (if
+        any) is parked for the duration of the check and restored."""
+        import jax._src.compiler as compiler
+
+        from large_scale_recommendation_tpu.obs.introspect import (
+            get_introspector,
+        )
+        from large_scale_recommendation_tpu.obs.server import ObsServer
+
+        assert get_introspector() is None  # null_obs cleared it
+        suite_ins = None
+        current = compiler.compile_or_get_cached
+        if hasattr(current, "__lsr_introspector__"):
+            suite_ins = current.__lsr_introspector__
+            suite_ins.uninstall()
+        try:
+            assert not hasattr(compiler.compile_or_get_cached,
+                               "__lsr_introspector__")
+            # the disabled-route answer carries no introspector either
+            assert ObsServer().rooflinez()["rows"] == []
+        finally:
+            if suite_ins is not None:
+                suite_ins.install()
+
 
 class TestLegacyShimMigration:
     """utils.metrics helpers keep their surfaces but mirror into the

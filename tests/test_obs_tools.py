@@ -126,6 +126,30 @@ class TestCompare:
         assert "effective_hbm_gbs" in DEFAULT_KEYS
         assert "pct_of_hbm_peak" in DEFAULT_KEYS
 
+    def test_compile_gate_keys_in_default_watch_set(self):
+        """The ISSUE-9 compile-time keys are gated by DEFAULT: a
+        compile-count explosion or a compile-wall blowup trips the gate
+        with no flags."""
+        from scripts.bench_regress import DEFAULT_KEYS
+
+        for key in ("compile_wall_s", "xla_compile_wall_s",
+                    "compile_count"):
+            assert key in DEFAULT_KEYS, key
+
+    def test_compile_keys_lower_is_better(self):
+        """Compile time/count regress when they GROW — lower-is-better
+        (compile_wall_s via the _wall_s pattern, compile_count via its
+        own DEFAULT_LOWER entry)."""
+        from scripts.bench_regress import is_lower_better
+
+        for key in ("compile_wall_s", "xla_compile_wall_s",
+                    "compile_count"):
+            assert is_lower_better(key, set()), key
+            rows = compare({key: 10.0}, {key: 20.0}, {key: 15.0})
+            assert rows[0]["verdict"] == "REGRESSION", key
+            rows = compare({key: 10.0}, {key: 5.0}, {key: 15.0})
+            assert rows[0]["verdict"] == "ok", key
+
 
 class TestGateEndToEnd:
     def _write(self, tmp_path, name, value, extra=None):

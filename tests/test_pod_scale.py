@@ -59,3 +59,8 @@ class TestPodShapedMesh:
         # the 2-process local-cluster pass ran (or skipped loudly)
         two = out["two_process"]
         assert two.get("ok") or two.get("skipped"), two
+        if two.get("ok"):
+            # the pod-observability half (ISSUE 9): process 0 merged
+            # both processes' /metrics+/healthz through obs.fleet over
+            # real sockets and the aggregate passed its asserts
+            assert two.get("fleet_ok"), two
