@@ -792,22 +792,52 @@ def _extra_lines(extra: dict, rank: int, jax, h2d_mbps: float,
             wall = time.perf_counter() - t0
             extra[f"als_rank{als_rank}_implicit_rows_per_s"] = round(
                 (anu + ani) * iters / wall, 1)
-            # ranking quality of the implicit fit (VERDICT r4 #8):
-            # held-out interactions ranked against the full catalog with
-            # train-seen exclusion. On this popularity-skewed synthetic
-            # workload NDCG mostly reflects how well iALS captures the
-            # interaction-frequency structure — the floor for a random
-            # model is ~k/n_items, so the margin is the signal.
-            from large_scale_recommendation_tpu.utils.metrics import (
-                ranking_metrics,
+            # ranking quality of the implicit fit (VERDICT r4 #8,
+            # re-protocoled in ISSUE 10): held-out positives ranked
+            # against SAMPLED negatives with train-seen items masked
+            # out of the pool — obs.quality.sampled_ranking_metrics,
+            # the ONE shared metric kernel with the online evaluator
+            # (its floor/ceiling are planted-structure-pinned in
+            # tests/test_obs_quality.py). The old full-unmasked-catalog
+            # protocol sat at the random floor (~k/n_items ≈ 0.0002 on
+            # this 59K catalog) for any merely-WEAK model — numerically
+            # indistinguishable from a broken eval, which is how
+            # ndcg=0.003 shipped for five rounds. The sampled protocol
+            # has a KNOWN floor: a random model ranks uniformly among
+            # num_negatives+1 candidates, HR10 ≈ 10/101 ≈ 0.099 — so
+            # the emitted floor key prices the margin explicitly and
+            # bench_regress --family quality gates the trajectory.
+            from large_scale_recommendation_tpu.obs.quality import (
+                catalog_coverage,
+                sampled_ranking_metrics,
             )
 
+            impl_negatives = 100
             ns = min(20_000, int(ahu.shape[0]))
-            rq = ranking_metrics(
+            rq = sampled_ranking_metrics(
                 iU, iV, np.asarray(ahu[:ns]), np.asarray(ahi[:ns]),
-                k=10, train_u=np.asarray(au), train_i=np.asarray(ai))
+                k=10, num_negatives=impl_negatives,
+                train_u=np.asarray(au), train_i=np.asarray(ai), seed=7)
             extra["als_implicit_ndcg"] = round(rq["ndcg"], 4)
             extra["als_implicit_hr10"] = round(rq["hr"], 4)
+            extra["als_implicit_hr10_floor"] = round(
+                10.0 / (impl_negatives + 1), 4)
+            extra["als_implicit_valid_negatives"] = round(
+                rq["valid_negatives"], 1)
+            # aggregate diversity of what would actually be served:
+            # fraction of the catalog surfaced across sampled users'
+            # top-10 lists (a head-only model ranks fine and covers
+            # nothing — the failure HR/NDCG can't see). Seeded RANDOM
+            # user sample — np.unique is sorted, so a [:2048] prefix
+            # would always measure the lowest-id users and bias the
+            # gated number wherever id order correlates with anything
+            cov_users = np.unique(np.asarray(ahu[:ns]))
+            if len(cov_users) > 2048:
+                cov_users = np.random.default_rng(7).choice(
+                    cov_users, 2048, replace=False)
+            extra["als_implicit_coverage"] = round(catalog_coverage(
+                iU, iV, cov_users, k=10, train_u=np.asarray(au),
+                train_i=np.asarray(ai)), 4)
             del iU, iV
             del iprep_u, iprep_v  # free before the HBM-hungry rank-256 pass
         del U, V

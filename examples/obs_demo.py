@@ -12,8 +12,18 @@ doing their job:
 4. Durable streaming ingest with a ``TrainingWatchdog(policy=
    "rollback")``, a stream-lag check, a checkpoint-staleness check, and
    the timed telemetry export keeping the lag gauges fresh.
-5. ``curl /healthz`` → 200, every check OK.
-6. **A NaN micro-batch is injected**: the watchdog trips BEFORE the
+5. **The model plane (ISSUE 10)**: an ``OnlineEvaluator`` reservoir
+   holdout (split out of every batch BEFORE ``partial_fit`` trains —
+   the eval set is never trained on) shadow-scored into ``eval_*``
+   gauges with threshold-free quality anomaly checks armed
+   (``watch_quality``), a ``DataQualityInspector`` in front of
+   training, and a ``LineageJournal`` stamping every catalog swap.
+   **A staleness condition is injected** — ingest continues while
+   swaps stop — and the freshness SLO check flips ``/healthz`` to 503;
+   the ``/lineagez`` tail shows every served ``catalog_version``'s
+   provenance (WAL watermark, train step, source); a re-swap recovers.
+6. ``curl /healthz`` → 200, every check OK.
+7. **A NaN micro-batch is injected**: the watchdog trips BEFORE the
    offset stamp, rolls the model back to the last durable checkpoint,
    and ``/healthz`` flips to 503 with the training check CRITICAL —
    the poisoned batch never reaches a checkpoint or a catalog swap.
@@ -73,6 +83,9 @@ def main(argv=None) -> int:
     # table (cost analysis joined with measured execute walls), the
     # device-memory sampler feeds the recorder, and /rooflinez serves it
     introspector = obs.enable_introspection(interval_s=0.25)
+    # catalog lineage: every swap below stamps its provenance, every
+    # flush joins the served version back — /lineagez serves the journal
+    lineage = obs.enable_lineage()
 
     from large_scale_recommendation_tpu.core.generators import (
         SyntheticMFGenerator,
@@ -136,17 +149,94 @@ def main(argv=None) -> int:
             log.append_arrays(0, ru, ri, rv)
         online = OnlineMF(OnlineMFConfig(num_factors=8,
                                          minibatch_size=512))
+        # the model plane (ISSUE 10): a reservoir holdout the model
+        # NEVER trains on (split before partial_fit sees each batch)
+        # and a per-batch data-quality inspector in front of training
+        from large_scale_recommendation_tpu.obs.dataquality import (
+            DataQualityInspector,
+        )
+        from large_scale_recommendation_tpu.obs.quality import (
+            OnlineEvaluator,
+        )
+
+        evaluator = OnlineEvaluator(online, holdout_fraction=0.15,
+                                    reservoir_size=2048,
+                                    min_eval_rows=64)
+        # duplicate policy priced at THIS workload's baseline (the
+        # synthetic stream has ~1% natural birthday collisions in
+        # 2K-pair batches over a 100K-pair space); the corruption
+        # classes keep the tight defaults
+        inspector = DataQualityInspector(
+            rating_range=(-50.0, 50.0),
+            class_policy={"duplicate_key": (0.05, 0.5)})
         driver = StreamingDriver(
             online, log, os.path.join(tmp, "ckpt"),
-            config=StreamingDriverConfig(batch_records=2_000))
+            config=StreamingDriverConfig(batch_records=2_000),
+            inspector=inspector, evaluator=evaluator)
         watchdog = TrainingWatchdog(policy="rollback",
                                     manager=driver.manager)
         online.watchdog = watchdog
         monitor.watch_watchdog(watchdog)
         monitor.watch_driver(driver, degraded_lag=50_000)
         monitor.watch_checkpoints(driver.manager, degraded_after_s=300)
+        monitor.watch_data_quality(inspector)
+        # quality anomaly checks: eval_rmse spikes / eval_ndcg drops
+        # flip /healthz with zero static per-model thresholds — they
+        # learn the series' normal from the flight recorder
+        monitor.watch_quality(recorder)
         driver.start_telemetry_export(interval_s=1.0)  # fresh lag gauges
         driver.run()
+
+        # ---- quality: shadow-score the never-trained-on holdout --------
+        qm = evaluator.evaluate()
+        print(f"# quality: holdout={evaluator.holdout_rows} rows "
+              f"(never trained on), eval_rmse={qm['rmse']:.3f} "
+              f"ndcg@10={qm.get('ndcg', float('nan')):.3f} "
+              f"hr@10={qm.get('hr', float('nan')):.3f} "
+              f"coverage={qm.get('coverage', float('nan')):.3f}")
+        print(f"# data quality: {inspector.batches} batches inspected, "
+              f"status={inspector.status()[0]!r}")
+
+        # ---- lineage + staleness: ingest continues, swaps stop ---------
+        sengine = driver.serving_engine(k=5, max_batch=64)
+        driver.refresh_serving()  # swap: provenance gains the watermark
+        r0 = sengine.recommend(np.arange(16, dtype=np.int64))
+        rec0 = lineage.resolve(r0.catalog_version)
+        print(f"# lineage: served catalog_version={r0.catalog_version} "
+              f"→ watermark={rec0['wal_offset_watermark']} "
+              f"step={rec0['train_step']} source={rec0['source']!r}")
+        monitor.watch_freshness(lineage, degraded_after_s=0.05,
+                                critical_after_s=0.2)
+        print("# inject: ingest continues while catalog swaps STOP")
+        ru, ri, rv, _ = gen.generate(2_000).to_numpy()
+        log.append_arrays(0, ru, ri, rv)
+        driver.run()  # applies the new records — but nobody refreshes
+        import time as _time
+
+        _time.sleep(0.3)  # the unservable records age past the SLO
+        # absorb the ok→CRITICAL transition in-process first: the
+        # transition freezes a postmortem bundle (+ profiler capture),
+        # and that work belongs here, not inside the HTTP request the
+        # assertion below times
+        monitor.run()
+        code, body = _curl(server.url + "/healthz")
+        report = json.loads(body)
+        print(f"# healthz (stale): HTTP {code}, "
+              f"freshness={report['checks']['freshness']['status']!r} "
+              f"(unservable_age_s="
+              f"{report['checks']['freshness']['detail'].get('unservable_age_s')})")
+        assert code == 503, body
+        _, lineagez = _curl(server.url + "/lineagez")
+        ltail = json.loads(lineagez)
+        print(f"# lineagez: {ltail['swaps']} swaps, tail:")
+        for r in ltail["records"][-3:]:
+            print(f"#   version={r['catalog_version']} "
+                  f"watermark={r['wal_offset_watermark']} "
+                  f"source={r['source']!r}")
+        driver.refresh_serving()  # the fix: swap → freshness recovers
+        code, _ = _curl(server.url + "/healthz")
+        print(f"# healthz (re-swapped): HTTP {code} — freshness OK again")
+        assert code == 200
 
         # ---- healthy: /healthz is 200 with every check OK --------------
         code, body = _curl(server.url + "/healthz")
@@ -206,6 +296,15 @@ def main(argv=None) -> int:
         prom_path = os.path.join(args.out, "metrics.prom")
         with open(prom_path, "w") as f:
             f.write(prom)
+        # the model plane's artifacts (the CI quality smoke parses
+        # both): the SERVED /lineagez body and the recorder's series
+        # snapshot — eval_*/dataq_* series must be present in it
+        _, lineagez_body = _curl(server.url + "/lineagez")
+        with open(os.path.join(args.out, "lineagez.json"), "w") as f:
+            f.write(lineagez_body)
+        recorder.sample()  # one last point: eval_*/dataq_* are current
+        with open(os.path.join(args.out, "seriesz.json"), "w") as f:
+            json.dump(recorder.snapshot(), f, indent=2)
     jsonl_path = os.path.join(args.out, "metrics.jsonl")
     reg.append_jsonl(jsonl_path)
     trace_path = os.path.join(args.out, "trace.json")
@@ -223,7 +322,18 @@ def main(argv=None) -> int:
     print(f"# trace: {len(events)} spans, categories {cats} "
           f"— open trace.json in https://ui.perfetto.dev")
 
-    from scripts.obs_report import render_roofline, render_snapshot
+    from scripts.obs_report import (
+        render_lineage,
+        render_quality,
+        render_roofline,
+        render_snapshot,
+    )
+
+    # ---- the model-quality & lineage tables (ISSUE 10) -----------------
+    print()
+    print(render_lineage(lineage.snapshot()))
+    print()
+    print(render_quality(recorder.snapshot()))
 
     # ---- the per-kernel roofline table (ISSUE 9) -----------------------
     # every compile above was captured at the funnel: XLA's own

@@ -57,6 +57,7 @@ from large_scale_recommendation_tpu.models.online import (
     OnlineMFConfig,
 )
 from large_scale_recommendation_tpu.obs.events import get_events
+from large_scale_recommendation_tpu.obs.lineage import get_lineage
 from large_scale_recommendation_tpu.obs.registry import get_registry
 from large_scale_recommendation_tpu.obs.trace import get_tracer
 
@@ -124,6 +125,10 @@ class AdaptiveMF:
         # retrain start/install/abort emissions are one `is not None`
         # test each, all on the (cold) retrain path
         self._events = get_events()
+        # lineage journal (obs.lineage): None unless installed — the
+        # retrain-swap provenance stamp in _install is one `is not
+        # None` test on the (cold) swap path
+        self._lineage = get_lineage()
         self._m_retrains = obs.counter("adaptive_retrains_total")
         self._m_retrain_s = obs.histogram("adaptive_retrain_s")
         self._manager = None
@@ -374,6 +379,27 @@ class AdaptiveMF:
         snapshot = self.to_model() if engines else None
         for engine in engines:
             engine.refresh(snapshot)
+        if self._lineage is not None and engines:
+            # enrich each engine's fresh stamp (engine.refresh recorded
+            # the swap instant) with what only the retrain layer knows:
+            # WHICH retrain produced this build, the online step it
+            # landed at, and PER PARTITION the WAL offset the online
+            # tables have absorbed (offsets from different partitions
+            # are independent number spaces — one flat max would let a
+            # high-offset partition mask another's staleness) — during
+            # a background retrain the stamps are frozen at the
+            # pre-retrain offsets, which is exactly what this build's
+            # history covers (buffered batches replay AFTER the swap
+            # and ship with the next refresh)
+            offsets = dict(self.online.consumed_offsets) or {0: None}
+            for engine in engines:
+                for p, off in offsets.items():
+                    self._lineage.record_swap(
+                        engine.version,
+                        retrain_id=self.retrain_count + 1,
+                        train_step=int(self.online.step),
+                        wal_offset_watermark=off, partition=p,
+                        source="retrain_install")
         if self._events is not None:
             self._events.emit("adaptive.retrain_install",
                               retrain_count=self.retrain_count + 1,

@@ -59,6 +59,11 @@ class ALS:
     def __init__(self, config: ALSConfig | None = None):
         self.config = config or ALSConfig()
         self.model: MFModel | None = None
+        # quality hook (obs.quality.OnlineEvaluator, same contract as
+        # DSGD.evaluator): an attached evaluator with a row-space
+        # holdout armed scores the fitted tables at the fit boundary
+        # (ALS runs one jitted segment). None = one pointer test.
+        self.evaluator = None
 
     def fit(self, ratings: Ratings) -> MFModel:
         cfg = self.config
@@ -103,6 +108,9 @@ class ALS:
             )
             h.out = (U, V)
         timer.finish(int(len(ru)))
+        if self.evaluator is not None:
+            self.evaluator.on_segment(U, V, label="als_planned",
+                                      step=cfg.iterations)
         self.model = MFModel(U=U, V=V, users=users, items=items)
         return self.model
 
@@ -180,6 +188,9 @@ class ALS:
                 gram_dtype=gram_dtype)
             h.out = (U, V)
         timer.finish(int(np.shape(u)[0]))
+        if self.evaluator is not None:
+            self.evaluator.on_segment(U, V, label="als_device_rounds",
+                                      step=cfg.iterations)
 
         # dense-vocab IdIndex pair with host-path semantics (ids unseen in
         # training stay unknown → predict 0, dropped from risk)

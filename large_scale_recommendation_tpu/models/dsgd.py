@@ -109,6 +109,13 @@ class DSGD:
         # segment is seconds of work — the sweep is noise) and trips per
         # the watchdog's policy. None = one pointer test per segment.
         self.watchdog = None
+        # quality hook (obs.quality.OnlineEvaluator): when attached
+        # (with a row-space holdout armed via set_offline_holdout),
+        # each segment boundary shadow-scores the tables and publishes
+        # eval_* gauges — the offline trainers' entry into the same
+        # quality series the online path feeds. None = one pointer
+        # test per segment.
+        self.evaluator = None
         # structured event journal (obs.events): None unless installed —
         # segment/checkpoint emissions are one `is not None` test each,
         # once per segment (seconds of work)
@@ -230,6 +237,11 @@ class DSGD:
                 # BEFORE the checkpoint: a tripped segment must not
                 # persist its poisoned tables as a resume point
                 self.watchdog.after_segment(U, V, label=kind)
+            if self.evaluator is not None:
+                # segment-boundary quality: the armed row-space holdout
+                # scores against THIS segment's tables (segments are
+                # seconds of work — the eval is noise next to them)
+                self.evaluator.on_segment(U, V, label=kind, step=done)
             if self._events is not None:
                 self._events.emit("train.segment", model="dsgd", kind=kind,
                                   iterations=int(seg), done=int(done),

@@ -35,6 +35,18 @@ package is that instrumentation layer, shared by every runtime tier:
   (``validate_bundle`` is the schema contract;
   ``scripts/obs_report.py --bundle`` renders one).
 
+- ``obs.quality`` / ``obs.dataquality`` / ``obs.lineage`` — the MODEL
+  plane: a reservoir-holdout ``OnlineEvaluator`` shadow-scoring the
+  live model on a cadence (``eval_rmse``/``eval_ndcg_at_k``/
+  ``eval_hr_at_k``/``eval_coverage`` gauges, watched threshold-free by
+  the anomaly machinery), a per-batch ingest ``DataQualityInspector``
+  (NaN/range/vocab/duplicate/skew classes behind a
+  ``DataQualityCheck``), and a ``LineageJournal`` stamping every
+  catalog swap with ``{catalog_version, wal_offset_watermark,
+  train_step, retrain_id, wall_time}`` — joined per request against
+  ``RecResult.catalog_version`` into staleness/freshness telemetry and
+  an ingest→serve ``FreshnessCheck`` SLO (``/lineagez``).
+
 Zero-cost when disabled — the design invariant every instrumented hot
 path relies on: the module-level defaults are a ``NullRegistry`` and
 ``NullTracer`` whose instruments are shared stateless singletons (no
@@ -64,6 +76,9 @@ from large_scale_recommendation_tpu.obs.anomaly import (
     ewma_zscore,
     rate_of_change,
 )
+from large_scale_recommendation_tpu.obs.dataquality import (
+    DataQualityInspector,
+)
 from large_scale_recommendation_tpu.obs.events import (
     EventJournal,
     get_events,
@@ -80,6 +95,7 @@ from large_scale_recommendation_tpu.obs.health import (
     DEGRADED,
     OK,
     CheckResult,
+    DataQualityCheck,
     HealthMonitor,
     SLOTracker,
     TrainingDivergedError,
@@ -91,6 +107,17 @@ from large_scale_recommendation_tpu.obs.introspect import (
     get_introspector,
     profile_trace,
     set_introspector,
+)
+from large_scale_recommendation_tpu.obs.lineage import (
+    FreshnessCheck,
+    LineageJournal,
+    get_lineage,
+    set_lineage,
+)
+from large_scale_recommendation_tpu.obs.quality import (
+    OnlineEvaluator,
+    catalog_coverage,
+    sampled_ranking_metrics,
 )
 from large_scale_recommendation_tpu.obs.recorder import (
     FlightRecorder,
@@ -159,6 +186,16 @@ __all__ = [
     "SLOTracker",
     "TrainingWatchdog",
     "TrainingDivergedError",
+    "DataQualityCheck",
+    "DataQualityInspector",
+    "OnlineEvaluator",
+    "sampled_ranking_metrics",
+    "catalog_coverage",
+    "LineageJournal",
+    "FreshnessCheck",
+    "get_lineage",
+    "set_lineage",
+    "enable_lineage",
     "ObsServer",
     "OK",
     "DEGRADED",
@@ -228,10 +265,26 @@ def enable_introspection(interval_s: float = 1.0, start: bool = True,
     return introspector
 
 
+def enable_lineage(capacity: int = 1024,
+                   ingest_marks: int = 512) -> LineageJournal:
+    """Install a ``LineageJournal`` as the module-level default — the
+    catalog-provenance layer every swap site stamps and every engine
+    flush joins against. Call AFTER ``enable()`` (the journal binds the
+    live registry for its staleness/freshness instruments) and BEFORE
+    building the engines/drivers whose swaps you want stamped — lineage
+    hooks bind at construction, same as the instruments. Returns the
+    journal (served at ``/lineagez`` by any subsequently built
+    ``ObsServer``)."""
+    journal = LineageJournal(capacity=capacity, ingest_marks=ingest_marks)
+    set_lineage(journal)
+    return journal
+
+
 def disable() -> None:
     """Restore the zero-cost defaults: null registry/tracer, no flight
-    recorder or event journal, and no introspector (its compile hook is
-    removed and sampler threads are stopped first)."""
+    recorder, event journal or lineage journal, and no introspector
+    (its compile hook is removed and sampler threads are stopped
+    first)."""
     from large_scale_recommendation_tpu.obs import registry as _r
     from large_scale_recommendation_tpu.obs import trace as _t
 
@@ -244,6 +297,7 @@ def disable() -> None:
     set_introspector(None)
     set_recorder(None)
     set_events(None)
+    set_lineage(None)
     set_registry(_r.NULL_REGISTRY)
     set_tracer(_t.NULL_TRACER)
 

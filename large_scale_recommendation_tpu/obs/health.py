@@ -159,6 +159,47 @@ class HealthMonitor:
 
         self.register(name, MonotonicGrowthCheck(recorder, **kwargs))
 
+    def watch_quality(self, recorder, source: str = "online",
+                      k: int = 10, name_prefix: str = "quality",
+                      **kwargs) -> None:
+        """Watch the ``obs.quality.OnlineEvaluator``'s series with the
+        THRESHOLD-FREE anomaly machinery: ``eval_rmse`` pages on spikes
+        (error exploding), ``eval_ndcg_at_k`` on drops (ranking
+        collapsing) — each an ``AnomalyCheck`` over the flight-recorder
+        series, learning the model's own recent normal; no static
+        per-model quality number anywhere. ``kwargs`` pass through to
+        both checks (``alpha``, ``warmup``, ``critical_z``, ...)."""
+        from large_scale_recommendation_tpu.obs.anomaly import AnomalyCheck
+        from large_scale_recommendation_tpu.obs.recorder import series_key
+
+        rmse_series = series_key("eval_rmse", {"source": source})
+        ndcg_series = series_key("eval_ndcg_at_k",
+                                 {"source": source, "k": k})
+        self.register(f"{name_prefix}:rmse",
+                      AnomalyCheck(recorder, rmse_series,
+                                   direction="spike", **kwargs))
+        self.register(f"{name_prefix}:ndcg",
+                      AnomalyCheck(recorder, ndcg_series,
+                                   direction="drop", **kwargs))
+
+    def watch_data_quality(self, inspector,
+                           name: str = "data_quality") -> None:
+        """Register a ``DataQualityCheck`` over an
+        ``obs.dataquality.DataQualityInspector``."""
+        self.register(name, DataQualityCheck(inspector))
+
+    def watch_freshness(self, lineage, degraded_after_s: float,
+                        critical_after_s: float | None = None,
+                        name: str = "freshness") -> None:
+        """Register the ingest→serve staleness SLO
+        (``obs.lineage.FreshnessCheck``) over a ``LineageJournal``:
+        pages when ingest keeps advancing while the servable watermark
+        stands still."""
+        from large_scale_recommendation_tpu.obs.lineage import FreshnessCheck
+
+        self.register(name, FreshnessCheck(lineage, degraded_after_s,
+                                           critical_after_s))
+
     # -- evaluation ----------------------------------------------------------
 
     def run(self) -> dict:
@@ -733,6 +774,26 @@ class StreamHealthCheck:
         if lag >= self.degraded_lag or growing:
             return degraded(**detail)
         return ok(**detail)
+
+
+class DataQualityCheck:
+    """Ingest data-quality health from an
+    ``obs.dataquality.DataQualityInspector``: the inspector keeps a
+    bounded window of per-batch violation fractions (NaN/Inf,
+    out-of-range, out-of-vocab, duplicate-key) plus the per-partition
+    arrival-skew ratio, and its ``status()`` applies the configured
+    degraded/critical policy — this check just surfaces that verdict to
+    the monitor. An inspector that has seen no batches is OK (a
+    not-yet-flowing stream is not a data incident)."""
+
+    def __init__(self, inspector):
+        self.inspector = inspector
+
+    def __call__(self) -> CheckResult:
+        if self.inspector.batches == 0:
+            return ok(note="no batches inspected yet")
+        status, detail = self.inspector.status()
+        return CheckResult(status, detail)
 
 
 class CheckpointStalenessCheck:

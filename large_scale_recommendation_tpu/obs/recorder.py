@@ -25,8 +25,9 @@ snapshots into one atomic bundle directory the moment something breaks.
 - ``write_bundle`` / ``FlightRecorder.dump`` — the postmortem artifact:
   a directory written atomically (tmp + rename) holding ``series.json``,
   ``events.jsonl``, ``trace.json`` (span tail), ``health.json``,
-  ``metrics.json``, ``config.json`` and a ``manifest.json`` indexing
-  them. Triggers: watchdog trip, a CRITICAL health transition
+  ``metrics.json``, ``config.json``, ``device_memory.json``,
+  ``lineage.json`` (catalog-swap provenance + the latest quality /
+  data-quality snapshots) and a ``manifest.json`` indexing them. Triggers: watchdog trip, a CRITICAL health transition
   (``HealthMonitor``), or an explicit ``dump()``. ``validate_bundle``
   is the schema contract the golden test and ``scripts/obs_report.py
   --bundle`` both run.
@@ -57,16 +58,20 @@ from large_scale_recommendation_tpu.obs.registry import (
 )
 from large_scale_recommendation_tpu.obs.trace import get_tracer
 
-# version 2 added device_memory.json; version-1 bundles (written before
-# the device-introspection layer) must stay loadable — an ARCHIVED
-# incident bundle is exactly the artifact this module exists to
-# preserve, so the loader validates per the version it finds
-BUNDLE_VERSION = 2
+# version 2 added device_memory.json; version 3 added lineage.json (the
+# model-plane freeze: catalog-swap provenance + the latest quality and
+# data-quality gauge snapshots). Bundles written before each layer must
+# stay loadable — an ARCHIVED incident bundle is exactly the artifact
+# this module exists to preserve, so the loader validates per the
+# version it finds
+BUNDLE_VERSION = 3
 BUNDLE_FILES = ("series.json", "events.jsonl", "trace.json", "health.json",
-                "metrics.json", "config.json", "device_memory.json")
+                "metrics.json", "config.json", "device_memory.json",
+                "lineage.json")
 _BUNDLE_FILES_BY_VERSION = {
-    1: BUNDLE_FILES[:-1],
-    2: BUNDLE_FILES,
+    1: BUNDLE_FILES[:-2],
+    2: BUNDLE_FILES[:-1],
+    3: BUNDLE_FILES,
 }
 # env prefixes worth freezing into a bundle — runtime knobs, never secrets
 _ENV_PREFIXES = ("JAX_", "XLA_", "OBS_", "BENCH_", "LIBTPU", "TPU_")
@@ -435,6 +440,28 @@ def write_bundle(directory: str, *, trigger: str, detail: dict | None = None,
     event_lines = (events.tail(event_tail) if events is not None else [])
     trace_doc = {"traceEvents": tracer.events()[-span_tail:],
                  "displayTimeUnit": "ms"}
+    metrics_doc = registry.snapshot()
+    # the model-plane freeze: catalog-swap provenance (the lineage
+    # journal, when installed) + the LATEST quality / data-quality
+    # instrument values, pulled from the same registry snapshot
+    # metrics.json ships — an incident bundle must answer "what was the
+    # model's quality and how stale was serving?" without a live process
+    from large_scale_recommendation_tpu.obs.lineage import get_lineage
+
+    lineage_journal = get_lineage()
+
+    def _metric_subset(prefix: str) -> list:
+        return [m for m in metrics_doc.get("metrics", [])
+                if m.get("name", "").startswith(prefix)]
+
+    lineage_doc = {
+        "lineage": (lineage_journal.snapshot()
+                    if lineage_journal is not None
+                    else {"note": "no lineage journal installed",
+                          "records": []}),
+        "quality": _metric_subset("eval_"),
+        "data_quality": _metric_subset("dataq_"),
+    }
     config_doc = {
         "time": created,
         "pid": os.getpid(),
@@ -475,9 +502,10 @@ def write_bundle(directory: str, *, trigger: str, detail: dict | None = None,
                 f.write(json.dumps(_json_safe(ev), default=repr) + "\n")
         _write_json("trace.json", trace_doc)
         _write_json("health.json", health_report)
-        _write_json("metrics.json", registry.snapshot())
+        _write_json("metrics.json", metrics_doc)
         _write_json("config.json", config_doc)
         _write_json("device_memory.json", device_memory_doc)
+        _write_json("lineage.json", lineage_doc)
         _write_json("manifest.json", manifest)
         if os.path.isdir(directory):  # re-dump to the same explicit path
             import shutil
@@ -575,9 +603,24 @@ def load_bundle(directory: str) -> dict:
         device_memory = {"note": "version-1 bundle (no device memory "
                                  "sample)", "supported": False,
                          "devices": []}
+    if "lineage.json" in required_files:
+        lineage = _load("lineage.json")
+        for key in ("lineage", "quality", "data_quality"):
+            if key not in lineage:
+                raise ValueError(f"bundle {directory}: lineage.json "
+                                 f"missing {key!r}")
+        if not isinstance(lineage["lineage"].get("records"), list):
+            raise ValueError(f"bundle {directory}: lineage.json lineage "
+                             "has no records list")
+    else:  # pre-model-plane bundle (version 1/2): synthesize the note
+        lineage = {"note": f"version-{version} bundle (no lineage/quality "
+                           "freeze)",
+                   "lineage": {"records": []}, "quality": [],
+                   "data_quality": []}
     return {"manifest": manifest, "series": series, "events": events,
             "trace": trace, "health": health, "metrics": metrics,
-            "config": config, "device_memory": device_memory}
+            "config": config, "device_memory": device_memory,
+            "lineage": lineage}
 
 
 def validate_bundle(directory: str) -> dict:

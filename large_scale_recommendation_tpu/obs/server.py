@@ -21,6 +21,10 @@ minimal, can expose its live state to a scraper or a ``curl``:
 - ``/rooflinez`` — the live per-kernel roofline table
   (``obs.introspect.Introspector.roofline()``): XLA flops/bytes per
   compile key joined with measured execute walls, pct-of-peak columns.
+- ``/lineagez`` — catalog lineage (``obs.lineage.LineageJournal``):
+  per-version swap provenance ``{catalog_version,
+  wal_offset_watermark, train_step, retrain_id, wall_time}`` plus the
+  ingest→serve freshness summary the staleness SLO verdicts on.
 - ``/profilez``  — on-demand ``jax.profiler`` capture:
   ``GET /profilez?seconds=N`` records N seconds (capped, default 1)
   of the whole process into an artifact directory (``profile_dir`` or
@@ -58,6 +62,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from large_scale_recommendation_tpu.obs.events import get_events
 from large_scale_recommendation_tpu.obs.health import CRITICAL
 from large_scale_recommendation_tpu.obs.introspect import get_introspector
+from large_scale_recommendation_tpu.obs.lineage import get_lineage
 from large_scale_recommendation_tpu.obs.recorder import get_recorder
 from large_scale_recommendation_tpu.obs.registry import get_registry
 from large_scale_recommendation_tpu.obs.trace import get_tracer
@@ -214,7 +219,7 @@ class ObsServer(EndpointServerBase):
 
     def __init__(self, registry=None, tracer=None, monitor=None,
                  recorder=None, events=None, introspector=None,
-                 host: str = "127.0.0.1", port: int = 0,
+                 lineage=None, host: str = "127.0.0.1", port: int = 0,
                  tracez_limit: int = DEFAULT_TRACEZ_LIMIT,
                  eventz_limit: int = DEFAULT_EVENTZ_LIMIT,
                  profile_dir: str | None = None):
@@ -228,6 +233,7 @@ class ObsServer(EndpointServerBase):
         self.events = events if events is not None else get_events()
         self.introspector = (introspector if introspector is not None
                              else get_introspector())
+        self.lineage = lineage if lineage is not None else get_lineage()
         self.profile_dir = profile_dir
         self.eventz_limit = int(eventz_limit)
         self.tracez_limit = int(tracez_limit)
@@ -249,6 +255,8 @@ class ObsServer(EndpointServerBase):
             return 200, self.eventz()
         if path == "/rooflinez":
             return 200, self.rooflinez()
+        if path == "/lineagez":
+            return 200, self.lineagez()
         if path == "/profilez":
             from urllib.parse import parse_qs
 
@@ -261,7 +269,8 @@ class ObsServer(EndpointServerBase):
         if path == "/":
             return 200, {"routes": ["/metrics", "/healthz", "/varz",
                                     "/tracez", "/seriesz", "/eventz",
-                                    "/rooflinez", "/profilez"]}
+                                    "/rooflinez", "/lineagez",
+                                    "/profilez"]}
         return None
 
     # -- route bodies (shared with tests / in-process callers) --------------
@@ -297,6 +306,12 @@ class ObsServer(EndpointServerBase):
             return {"note": "no introspector installed "
                             "(obs.enable_introspection())", "rows": []}
         return self.introspector.roofline()
+
+    def lineagez(self) -> dict:
+        if self.lineage is None:
+            return {"note": "no lineage journal installed "
+                            "(obs.enable_lineage())", "records": []}
+        return self.lineage.snapshot()
 
     def profilez(self, seconds: float | None = None) -> tuple[int, dict]:
         """(http_status, body) for ``/profilez``: run one N-second

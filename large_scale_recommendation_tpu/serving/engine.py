@@ -69,6 +69,7 @@ import jax.numpy as jnp
 
 from large_scale_recommendation_tpu.models.mf import MFModel, _assemble_topk
 from large_scale_recommendation_tpu.obs.events import get_events
+from large_scale_recommendation_tpu.obs.lineage import get_lineage
 from large_scale_recommendation_tpu.obs.registry import get_registry
 from large_scale_recommendation_tpu.obs.trace import get_tracer
 from large_scale_recommendation_tpu.parallel.partitioner import (
@@ -197,6 +198,12 @@ class ServingEngine:
         # structured event journal (obs.events): None unless installed —
         # the catalog-swap emission below is one `is not None` test
         self._events = get_events()
+        # lineage journal (obs.lineage): None unless installed — every
+        # swap stamps its provenance, every flush joins the served
+        # version back (the staleness gauge); one `is not None` test
+        # per swap/flush. Bound BEFORE the constructor's refresh() so
+        # the initial catalog build is stamped too.
+        self._lineage = get_lineage()
         self._m_qwait = obs.histogram("serving_queue_wait_s")
         self._m_assembly = obs.histogram("serving_batch_assembly_s")
         self._m_flush = obs.histogram("serving_flush_s")
@@ -254,6 +261,12 @@ class ServingEngine:
                 swap_detail = {"version": version,
                                "refreshes": self.stats["refreshes"],
                                "rows": int(self.catalog_rows)}
+        if self._lineage is not None:
+            # provenance stamp at the swap instant; layers that know
+            # more (the streaming driver's WAL watermark, the adaptive
+            # retrain id) enrich the SAME record by version. Outside
+            # the engine lock, same rule as the event emit.
+            self._lineage.record_swap(version, source="engine_refresh")
         if swap_detail is not None:
             # journaled OUTSIDE the engine lock: the emit may hit the
             # journal's JSONL disk mirror, and every submit/flush/serve
@@ -371,6 +384,8 @@ class ServingEngine:
                     "user_rows": int(0 if user_rows is None
                                      else len(user_rows)),
                     "delta_swaps": self.stats["delta_swaps"]}
+        if self._lineage is not None:
+            self._lineage.record_swap(version, source="engine_delta")
         if swap_detail is not None:
             # journaled OUTSIDE the engine lock, same rule as refresh()
             self._events.emit("serving.catalog_delta", **swap_detail)
@@ -605,7 +620,18 @@ class ServingEngine:
                 self._m_flush.observe(wall)
                 self._m_requests.inc(len(requests))
                 self._m_rows.inc(len(rows_all))
-            return results
+        if self._lineage is not None:
+            # the serve-side half of the lineage join: the version every
+            # result of this flush carries resolves to its provenance,
+            # pricing the per-request staleness gauge. Outside flush's
+            # own lock hold, AND the journal side is NON-BLOCKING
+            # (observe_serve try-acquires and skips the sample under
+            # contention) — the recommend() path re-enters flush with
+            # the engine RLock still held, so only the journal's own
+            # guarantee keeps a /lineagez scrape or bundle freeze from
+            # adding tail latency to the SLO-measured serving path.
+            self._lineage.observe_serve(version, requests=len(requests))
+        return results
 
     def _serve_rows(self, user_rows: np.ndarray,
                     stage1_only: bool = False):

@@ -57,6 +57,7 @@ from typing import Any, Callable
 import numpy as np
 
 from large_scale_recommendation_tpu.obs.events import get_events
+from large_scale_recommendation_tpu.obs.lineage import get_lineage
 from large_scale_recommendation_tpu.obs.registry import get_registry
 from large_scale_recommendation_tpu.obs.trace import get_tracer
 from large_scale_recommendation_tpu.streams.log import EventLog
@@ -109,7 +110,8 @@ class StreamingDriver:
     def __init__(self, model: Any, log: EventLog, checkpoint_dir: str,
                  partition: int = 0,
                  config: StreamingDriverConfig | None = None,
-                 on_batch: Callable[[StreamBatch], None] | None = None):
+                 on_batch: Callable[[StreamBatch], None] | None = None,
+                 inspector: Any = None, evaluator: Any = None):
         from large_scale_recommendation_tpu.models.adaptive import AdaptiveMF
 
         self.model = model
@@ -119,6 +121,17 @@ class StreamingDriver:
         self.manager = CheckpointManager(checkpoint_dir,
                                          keep=self.config.checkpoint_keep)
         self.on_batch = on_batch
+        # model-plane hooks, every one an `is not None` test per batch:
+        # the data-quality inspector (obs.dataquality) sees each batch's
+        # raw arrays BEFORE training; the online evaluator
+        # (obs.quality) routes a holdout fraction of each batch into
+        # its reservoir and zeroes those rows' weights so partial_fit
+        # never trains on them; the lineage journal (obs.lineage,
+        # module default — installed via obs.enable_lineage) receives
+        # per-batch ingest watermarks and per-swap provenance
+        self.inspector = inspector
+        self.evaluator = evaluator
+        self._lineage = get_lineage()
         self._adaptive = isinstance(model, AdaptiveMF)
         self._online = model.online if self._adaptive else model
         # ids touched since the last serving refresh — the WAL batches
@@ -301,14 +314,36 @@ class StreamingDriver:
 
     def _apply(self, batch: StreamBatch) -> None:
         offset = (batch.partition, batch.end_offset)
+        ratings = batch.ratings
+        if self.inspector is not None:
+            # observe-only: the gate makes rot visible, quarantine
+            # stays the queue's job — the batch trains unmodified
+            self.inspector.inspect_batch(batch)
+        if self.evaluator is not None:
+            # the holdout rows come OUT here — their weights zero, so
+            # the model (and the dirty-id tracking below) never sees
+            # them as real; the reservoir is out-of-sample forever
+            ratings = self.evaluator.split_batch(ratings)
         if self._adaptive:
-            self.model.process(batch.ratings, offset=offset)
+            self.model.process(ratings, offset=offset)
         else:
             self.model.partial_fit(
-                batch.ratings, offset=offset,
+                ratings, offset=offset,
                 emit_updates=self.config.emit_updates)
+        if self._lineage is not None:
+            # the ingest half of the freshness join: this offset landed
+            # (APPLIED — the model's own stamp is the proof, the same
+            # gate the checkpoint path uses below; a batch buffered
+            # during a background retrain is not applied yet, and its
+            # covering mark lands with the first post-swap batch whose
+            # stamp advances past it) at this wall time
+            applied = self._online.consumed_offsets.get(
+                batch.partition, 0)
+            if applied >= batch.end_offset:
+                self._lineage.note_ingest(applied,
+                                          partition=batch.partition)
         if self._engines:  # dirty-id tracking feeds delta refreshes
-            ru, ri, _, rw = batch.ratings.to_numpy()
+            ru, ri, _, rw = ratings.to_numpy()
             real = rw > 0
             du = np.unique(ru[real]).tolist()
             di = np.unique(ri[real]).tolist()
@@ -363,6 +398,15 @@ class StreamingDriver:
         engine.on_refresh = self.catalog_versions.append
         self.catalog_versions.append(engine.version)  # the bind itself
         self._engines.append(engine)
+        if self._lineage is not None:
+            # the engine stamped its own bind; enrich with what only
+            # this driver knows — which WAL offset the bound snapshot
+            # covers (the watermark every served result joins back to)
+            self._lineage.record_swap(
+                engine.version,
+                wal_offset_watermark=self.consumed_offset,
+                partition=self.partition,
+                train_step=int(self._online.step), source="engine_bind")
         return engine
 
     def refresh_serving(self, delta: bool | None = None) -> None:
@@ -427,6 +471,17 @@ class StreamingDriver:
             snapshot = self.model.to_model()
             for engine in self._engines:
                 engine.refresh(snapshot)
+        if self._lineage is not None:
+            # the swap provenance this refresh created: each engine's
+            # new version now covers everything this driver has applied
+            # — the consumed offset IS the servable watermark
+            watermark = self.consumed_offset
+            step = int(self._online.step)
+            for engine in self._engines:
+                self._lineage.record_swap(
+                    engine.version, wal_offset_watermark=watermark,
+                    partition=self.partition, train_step=step,
+                    source="stream_refresh")
 
     @staticmethod
     def _gather_rows(table_arr, rows: np.ndarray) -> np.ndarray:

@@ -17,6 +17,11 @@ silently as long as tier-1 stays green. This gate closes that gap::
                                       # lower-is-better; fast/exact
                                       # throughput, QPS-at-SLO and
                                       # recall@10 higher)
+    python scripts/bench_regress.py --family quality  # model-quality keys
+                                      # inside the BENCH rounds: implicit
+                                      # ndcg/hr10/coverage + the eval_*
+                                      # family higher-is-better,
+                                      # eval_rmse lower (ISSUE 10)
 
 It loads both rounds, compares the watched keys (higher-is-better rates
 by default; ``--lower`` flags wall-clock-style keys), prints a table,
@@ -110,11 +115,33 @@ SERVING_KEYS: dict[str, float] = {
     "overload_fast_p99_ms": 50.0,
 }
 
-# per-family round-file prefix + default watch set
+# watched keys for the MODEL-QUALITY trajectory (ISSUE 10): the keys
+# the BENCH rounds ACTUALLY carry — the implicit-ranking metrics
+# (sampled-negative protocol, obs.quality.sampled_ranking_metrics —
+# planted-structure-pinned) and the headline run's holdout rmse_final.
+# Ranking metrics and coverage are higher-is-better; rmse is
+# LOWER-is-better. The online evaluator's eval_* family is covered by
+# the DIRECTION rules below (watch via --key when a quality-bearing
+# round carries them), not listed here: a default watch key no round
+# can contain is permanent "missing" noise and an unconditional
+# --strict failure. Thresholds loose: ranking metrics on synthetic
+# workloads carry sampling noise, and the gate exists to catch the
+# ndcg-0.003-class collapse, not 5% drift.
+QUALITY_KEYS: dict[str, float] = {
+    "als_implicit_ndcg": 30.0,
+    "als_implicit_hr10": 30.0,
+    "als_implicit_coverage": 30.0,
+    "rmse_final": 30.0,
+}
+
+# per-family round-file prefix + default watch set. The quality family
+# reads the BENCH rounds — quality keys ride inside the bench extras,
+# they just gate under their own watch set (and direction rules).
 FAMILIES = {
     "bench": ("BENCH", DEFAULT_KEYS),
     "multichip": ("MULTICHIP", MULTICHIP_KEYS),
     "serving": ("SERVING", SERVING_KEYS),
+    "quality": ("BENCH", QUALITY_KEYS),
 }
 
 # keys where HIGHER is explicitly better (throughputs, achieved
@@ -125,13 +152,17 @@ FAMILIES = {
 DEFAULT_HIGHER = ("_ratings_per_s", "_rows_per_s", "_users_per_s",
                   "_per_s", "effective_hbm_gbs", "pct_of_hbm_peak",
                   "_hbm_gbs", "_tflops", "_mbps", "qps_at_slo",
-                  "recall_at", "_vs_exact")
+                  "recall_at", "_vs_exact",
+                  # quality family (ISSUE 10): ranking metrics and
+                  # catalog coverage regress when they DROP
+                  "_ndcg", "_hr10", "_hr_at", "ndcg_at", "coverage")
 
 # keys where LOWER is better (walls, latencies, pad/layout overheads,
-# compile counts) when watched explicitly
+# compile counts, eval error) when watched explicitly
 DEFAULT_LOWER = ("_wall_s", "_ms_", "time_to_", "_s_p", "_pad_ratio",
                  "layout_mb", "layout_bytes", "p99_ms", "p50_ms",
-                 "shed_frac", "compile_count")
+                 "shed_frac", "compile_count", "_rmse", "eval_rmse",
+                 "rmse_final", "staleness_s")
 
 _NUM_PAIR = re.compile(
     r'"([A-Za-z_][A-Za-z0-9_]*)":\s*(-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)')
@@ -258,7 +289,10 @@ def main(argv=None) -> int:
                          "sharded throughput higher-is-better) or "
                          "'serving' (SERVING_r*.json traffic-sim rounds "
                          "— p99 lower-is-better, throughput/QPS-at-SLO/"
-                         "recall higher-is-better)")
+                         "recall higher-is-better) or 'quality' (the "
+                         "model-quality keys inside the BENCH rounds — "
+                         "ranking/coverage higher-is-better, eval_rmse "
+                         "lower)")
     ap.add_argument("--current", default=None,
                     help="current round file (default: newest round of "
                          "the family)")

@@ -10,6 +10,8 @@ side::
     python scripts/obs_report.py --bundle postmortem/bundle_watchdog_trip_000
     python scripts/obs_report.py --roofline http://127.0.0.1:8080/rooflinez
     python scripts/obs_report.py --roofline roofline.json
+    python scripts/obs_report.py --lineage http://127.0.0.1:8080/lineagez
+    python scripts/obs_report.py --quality http://127.0.0.1:8080/seriesz
 
 ``--bundle <dir>`` renders a postmortem bundle (``obs.recorder``):
 validates it first (``validate_bundle`` — a torn bundle is an error,
@@ -22,6 +24,17 @@ FLOPs/bytes-accessed, the measured execute wall, achieved GB/s and
 TFLOP/s, pct-of-HBM/FP32-peak, and the XLA-vs-hand-model bytes
 cross-check. ``src`` is a ``/rooflinez`` URL on a live server or a
 dumped roofline JSON file (``examples/obs_demo.py`` writes one).
+
+``--lineage <src>`` renders catalog lineage (``obs.lineage``): the
+freshness summary (servable watermark vs latest ingest — the staleness
+SLO's inputs) and one row per swap's provenance record. ``src`` is a
+``/lineagez`` URL, a dumped lineage JSON, or a bundle ``lineage.json``.
+
+``--quality <src>`` renders the model-quality plane: the lead-up of
+every ``eval_*`` / ``dataq_*`` / ``lineage_*`` flight-recorder series
+from a ``/seriesz`` URL or dumped series JSON (``examples/obs_demo.py``
+writes one), or the frozen instrument values from a bundle
+``lineage.json``.
 
 Input is a single-snapshot JSON file, a JSONL metrics log
 (``MetricsRegistry.append_jsonl``), or — live mode — an HTTP URL to a
@@ -347,11 +360,96 @@ def render_roofline(doc: dict, name_filter: str | None = None) -> str:
     return "\n".join(out)
 
 
+def render_lineage(doc: dict, tail: int = 30) -> str:
+    """Render catalog lineage (``/lineagez`` body, a dumped lineage
+    JSON, or a bundle's ``lineage.json``): the freshness summary the
+    staleness SLO verdicts on, then one row per provenance record —
+    version, source, WAL watermark, train step, retrain id, age."""
+    if "lineage" in doc and isinstance(doc["lineage"], dict):
+        doc = doc["lineage"]  # a bundle lineage.json wraps the snapshot
+    records = doc.get("records", [])
+    fresh = doc.get("freshness", {}) or {}
+    now = doc.get("time", time.time())
+    out = [
+        "# catalog lineage "
+        f"({doc.get('swaps', '-')} swaps, {len(records)} records"
+        + (f", {doc['evicted']} evicted" if doc.get("evicted") else "")
+        + ")"
+        + (f"; note: {doc['note']}" if doc.get("note") else ""),
+        f"servable watermark: {_fmt(fresh.get('servable_watermark'))} "
+        f"(swap age {_fmt(fresh.get('servable_swap_age_s'))}s); "
+        f"latest ingest offset: "
+        f"{_fmt(fresh.get('latest_ingest_offset'))}; "
+        + ("INGEST AHEAD — oldest unservable record waited "
+           f"{_fmt(fresh.get('unservable_age_s'))}s"
+           if fresh.get("ingest_ahead") else "servable covers ingest"),
+        "",
+    ]
+    if not records:
+        out.append("(no provenance records)")
+        return "\n".join(out)
+    rows = [(str(r.get("catalog_version")), str(r.get("source") or "-"),
+             _fmt(r.get("wal_offset_watermark")),
+             _fmt(r.get("train_step")), _fmt(r.get("retrain_id")),
+             _fmt(round(now - r["wall_time"], 1))
+             if r.get("wall_time") else "-")
+            for r in records[-tail:]]
+    out.extend(format_table(("version", "source", "wal_watermark",
+                             "step", "retrain", "age_s"), rows))
+    return "\n".join(out)
+
+
+QUALITY_PREFIXES = ("eval_", "dataq_", "lineage_")
+
+
+def render_quality(doc: dict, name_filter: str | None = None) -> str:
+    """Render the model-quality plane from a ``/seriesz`` body (or a
+    dumped recorder snapshot / bundle ``series.json``): the lead-up of
+    every ``eval_*`` / ``dataq_*`` / ``lineage_*`` series — or, given a
+    bundle ``lineage.json`` (``quality``/``data_quality`` metric
+    lists), the latest frozen instrument values."""
+    if "quality" in doc and "lineage" in doc:  # a bundle lineage.json
+        rows = []
+        for m in doc.get("quality", []) + doc.get("data_quality", []):
+            val = m.get("value", m.get("count"))
+            rows.append((m["name"], _label_str(m.get("labels", {})),
+                         _fmt(val), m.get("type", "-")))
+        if not rows:
+            return "(no quality/data-quality instruments frozen)"
+        return "\n".join(["# model-quality snapshot (bundle)", ""]
+                         + format_table(("metric", "labels", "value",
+                                         "type"), rows))
+    series = doc.get("series", {})
+    keys = sorted(k for k in series
+                  if k.startswith(QUALITY_PREFIXES)
+                  and (name_filter is None or name_filter in k))
+    out = [f"# model-quality series ({len(keys)} of {len(series)})", ""]
+    if not keys:
+        out.append("(no eval_/dataq_/lineage_ series recorded — attach "
+                   "an OnlineEvaluator/DataQualityInspector and a "
+                   "flight recorder)")
+        return "\n".join(out)
+    rows = []
+    for key in keys:
+        vals = [v for _, v in series[key]["points"]] or [None]
+        numeric = [v for v in vals if isinstance(v, (int, float))]
+        rows.append((key, str(len(series[key]["points"])),
+                     _fmt(vals[0]),
+                     _fmt(min(numeric) if numeric else None),
+                     _fmt(max(numeric) if numeric else None),
+                     _fmt(vals[-1])))
+    out.extend(format_table(("series", "n", "first", "min", "max",
+                             "last"), rows))
+    return "\n".join(out)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", nargs="?", default=None,
                     help="snapshot JSON / metrics JSONL file, or "
                          "a live /varz URL")
+    # (--bundle/--roofline/--lineage/--quality below are the artifact
+    # renderers; path is only required for the snapshot/watch modes)
     ap.add_argument("--line", type=int, default=None,
                     help="0-based JSONL line (default: last)")
     ap.add_argument("--name", default=None,
@@ -365,12 +463,26 @@ def main(argv=None) -> int:
     ap.add_argument("--roofline", default=None, metavar="SRC",
                     help="render a per-kernel roofline table from a "
                          "/rooflinez URL or a dumped roofline JSON file")
+    ap.add_argument("--lineage", default=None, metavar="SRC",
+                    help="render catalog lineage from a /lineagez URL, "
+                         "a dumped lineage JSON, or a bundle's "
+                         "lineage.json")
+    ap.add_argument("--quality", default=None, metavar="SRC",
+                    help="render the eval_*/dataq_*/lineage_* series "
+                         "from a /seriesz URL or dumped series JSON "
+                         "(or a bundle lineage.json's frozen snapshot)")
     args = ap.parse_args(argv)
     if args.bundle is not None:
         print(render_bundle(args.bundle, args.name))
         return 0
     if args.roofline is not None:
         print(render_roofline(fetch_snapshot(args.roofline), args.name))
+        return 0
+    if args.lineage is not None:
+        print(render_lineage(fetch_snapshot(args.lineage)))
+        return 0
+    if args.quality is not None:
+        print(render_quality(fetch_snapshot(args.quality), args.name))
         return 0
     if args.path is None:
         ap.error("path is required unless --bundle is given")

@@ -48,6 +48,10 @@ if _OBS_OUT:
     # the recorder, and sessionfinish renders the joined roofline as a
     # tier-1 artifact (tier1_roofline.json/.txt)
     _OBS_INTROSPECTOR = _obs.enable_introspection(interval_s=1.0)
+    # catalog lineage for the whole session: every engine the suite
+    # builds stamps its swaps, and sessionfinish freezes the journal +
+    # the quality-plane series into tier1_quality.json
+    _OBS_LINEAGE = _obs.enable_lineage()
     _OBS_MONITOR = _health.HealthMonitor()
 
     def _session_check():
@@ -84,6 +88,10 @@ def null_obs():
         get_introspector,
         set_introspector,
     )
+    from large_scale_recommendation_tpu.obs.lineage import (
+        get_lineage,
+        set_lineage,
+    )
     from large_scale_recommendation_tpu.obs.recorder import (
         get_recorder,
         set_recorder,
@@ -99,7 +107,7 @@ def null_obs():
 
     prev_r, prev_t = get_registry(), get_tracer()
     prev_j, prev_rec = get_events(), get_recorder()
-    prev_ins = get_introspector()
+    prev_ins, prev_lin = get_introspector(), get_lineage()
     was_running = prev_rec is not None and prev_rec.running
     ins_was_running = prev_ins is not None and prev_ins.running
     obs.disable()  # closes the introspector too: compile funnel unpatched
@@ -108,6 +116,7 @@ def null_obs():
     set_tracer(prev_t)
     set_events(prev_j)
     set_recorder(prev_rec)
+    set_lineage(prev_lin)
     set_introspector(prev_ins)
     if prev_ins is not None:  # an OBS_OUT session runs one suite-wide
         prev_ins.install()
@@ -139,6 +148,27 @@ def pytest_sessionfinish(session, exitstatus):
             f.write(render_roofline(_roofline) + "\n")
     except Exception as e:  # artifact-only: never fail the session on it
         with open(os.path.join(_OBS_OUT, "tier1_roofline_error.txt"),
+                  "w") as f:
+            f.write(repr(e))
+    # the model-quality plane's artifact (ISSUE 10): the session's
+    # lineage journal + every eval_*/dataq_*/lineage_* series the
+    # suite's flight recorder captured, next to the roofline/bundle
+    try:
+        from large_scale_recommendation_tpu.obs.lineage import get_lineage
+
+        _lin = get_lineage()  # tests swap journals; freeze the current
+        _series = _OBS_RECORDER.snapshot()
+        _quality_doc = {
+            "lineage": (_lin.snapshot() if _lin is not None
+                        else {"note": "no lineage journal",
+                              "records": []}),
+            "series": {k: v for k, v in _series["series"].items()
+                       if k.startswith(("eval_", "dataq_", "lineage_"))},
+        }
+        with open(os.path.join(_OBS_OUT, "tier1_quality.json"), "w") as f:
+            json.dump(_quality_doc, f, indent=2)
+    except Exception as e:
+        with open(os.path.join(_OBS_OUT, "tier1_quality_error.txt"),
                   "w") as f:
             f.write(repr(e))
     # scrape the session's endpoint server for real: the artifacts below

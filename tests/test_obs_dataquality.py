@@ -1,0 +1,345 @@
+"""Ingest data-quality gate (``obs.dataquality``): per-class violation
+pins (NaN/Inf, out-of-range, out-of-vocab, duplicate-key, arrival
+skew), the windowed degraded/critical policy behind ``DataQualityCheck``,
+the driver chaining (inspect runs in front of ``partial_fit``, the
+batch trains unmodified), journal emission, and the zero-cost-off pin.
+"""
+
+import numpy as np
+import pytest
+
+from large_scale_recommendation_tpu import obs
+from large_scale_recommendation_tpu.obs.dataquality import (
+    VIOLATION_CLASSES,
+    DataQualityInspector,
+)
+from large_scale_recommendation_tpu.obs.events import get_events, set_events
+from large_scale_recommendation_tpu.obs.health import (
+    CRITICAL,
+    DEGRADED,
+    OK,
+    DataQualityCheck,
+    HealthMonitor,
+)
+from large_scale_recommendation_tpu.obs.recorder import (
+    get_recorder,
+    set_recorder,
+)
+from large_scale_recommendation_tpu.obs.registry import (
+    get_registry,
+    set_registry,
+)
+from large_scale_recommendation_tpu.obs.trace import get_tracer, set_tracer
+
+
+@pytest.fixture
+def live_obs():
+    prev = (get_registry(), get_tracer(), get_events(), get_recorder())
+    reg, tracer = obs.enable()
+    yield reg
+    set_registry(prev[0])
+    set_tracer(prev[1])
+    set_events(prev[2])
+    set_recorder(prev[3])
+
+
+def _clean(n=100, seed=0):
+    rng = np.random.default_rng(seed)
+    return (rng.integers(0, 1000, n), np.arange(n) % 997,
+            rng.normal(3.0, 1.0, n).astype(np.float32))
+
+
+class TestViolationClasses:
+    def test_clean_batch_zero_violations(self, live_obs):
+        insp = DataQualityInspector(rating_range=(-10, 10),
+                                    max_user_id=2000, max_item_id=2000)
+        counts = insp.inspect(*_clean())
+        assert counts == {c: 0 for c in VIOLATION_CLASSES}
+        assert insp.status()[0] == OK
+
+    def test_non_finite(self, live_obs):
+        insp = DataQualityInspector()
+        u, i, v = _clean()
+        v[3], v[7] = np.nan, np.inf
+        assert insp.inspect(u, i, v)["non_finite"] == 2
+
+    def test_out_of_range(self, live_obs):
+        insp = DataQualityInspector(rating_range=(1.0, 5.0))
+        u, i, _ = _clean()
+        v = np.full(100, 3.0, np.float32)
+        v[0], v[1] = 0.5, 6.0
+        assert insp.inspect(u, i, v)["out_of_range"] == 2
+        # a NaN is non_finite, never double-counted as out-of-range
+        v[2] = np.nan
+        counts = insp.inspect(u, i, v)
+        assert counts["non_finite"] == 1
+        assert counts["out_of_range"] == 2
+
+    def test_range_check_off_without_config(self, live_obs):
+        insp = DataQualityInspector()
+        u, i, _ = _clean()
+        assert insp.inspect(u, i, np.full(100, 999.0,
+                                          np.float32))["out_of_range"] == 0
+
+    def test_out_of_vocab(self, live_obs):
+        insp = DataQualityInspector(max_user_id=999, max_item_id=999)
+        u, i, v = _clean()
+        u[0] = -1         # negative always counts
+        u[1] = 5000       # past the user ceiling
+        i[2] = 1500       # past the item ceiling
+        assert insp.inspect(u, i, v)["out_of_vocab"] == 3
+
+    def test_negative_ids_count_without_ceilings(self, live_obs):
+        insp = DataQualityInspector()
+        u, i, v = _clean()
+        u[0] = -7
+        assert insp.inspect(u, i, v)["out_of_vocab"] == 1
+
+    def test_duplicate_keys(self, live_obs):
+        insp = DataQualityInspector()
+        u = np.array([1, 1, 1, 2, 3])
+        i = np.array([5, 5, 5, 6, 7])
+        v = np.ones(5, np.float32)
+        # three copies of (1,5) = two duplicates past the first
+        assert insp.inspect(u, i, v)["duplicate_key"] == 2
+
+    def test_duplicate_keys_no_collision_on_corrupt_ids(self, live_obs):
+        """Distinct pairs with negative / ≥2³¹ ids (exactly the corrupt
+        batches this inspector exists to catch) must not collide into
+        phantom duplicates — a packed scalar key would fold
+        (7, -5) and (6, 2³¹-5) onto one value."""
+        insp = DataQualityInspector()
+        u = np.array([7, 6], np.int64)
+        i = np.array([-5, 2 ** 31 - 5], np.int64)
+        counts = insp.inspect(u, i, np.ones(2, np.float32))
+        assert counts["duplicate_key"] == 0
+        assert counts["out_of_vocab"] == 1  # the negative id still flags
+
+    def test_weight_zero_rows_excluded(self, live_obs):
+        """Padding / already-quarantined rows never reach a kernel and
+        never count as violations either."""
+        insp = DataQualityInspector()
+        u = np.array([1, 2])
+        i = np.array([1, 2])
+        v = np.array([np.nan, 3.0], np.float32)
+        w = np.array([0.0, 1.0], np.float32)
+        counts = insp.inspect(u, i, v, weights=w)
+        assert counts["non_finite"] == 0
+
+    def test_arrival_skew(self, live_obs):
+        insp = DataQualityInspector(skew_threshold=3.0,
+                                    skew_window_s=60.0)
+        u, i, v = _clean(10)
+        insp.inspect(u, i, v, partition=0)
+        assert insp.last_skew == 1.0  # one partition can't be skewed
+        for _ in range(9):
+            insp.inspect(u, i, v, partition=0)
+        insp.inspect(u[:1], i[:1], v[:1], partition=1)
+        # partition 0: 100 records, partition 1: 1 → max/mean ≈ 1.98
+        assert insp.last_skew > 1.9
+        status, detail = insp.status()
+        assert "partition_skew" in detail
+
+
+class TestPolicyWindow:
+    def test_degraded_then_critical_fractions(self, live_obs):
+        insp = DataQualityInspector(degraded_frac=0.05,
+                                    critical_frac=0.5, window=4)
+        u, i, v = _clean()
+        v_bad = v.copy()
+        v_bad[:10] = np.nan  # 10% violation fraction
+        insp.inspect(u, i, v_bad)
+        status, detail = insp.status()
+        assert status == DEGRADED
+        assert "non_finite" in detail["offending"]
+        v_worse = v.copy()
+        v_worse[:60] = np.nan  # 60% ≥ critical_frac
+        insp.inspect(u, i, v_worse)
+        assert insp.status()[0] == CRITICAL
+
+    def test_window_makes_verdict_sticky_then_recovers(self, live_obs):
+        """One bad batch degrades for a WINDOW of clean batches, then
+        ages out — per-request /healthz evaluation can't consume it
+        (the StreamHealthCheck stickiness lesson)."""
+        insp = DataQualityInspector(degraded_frac=0.01,
+                                    critical_frac=0.5, window=4)
+        u, i, v = _clean()
+        bad = v.copy()
+        bad[:20] = np.nan
+        insp.inspect(u, i, bad)
+        assert insp.status()[0] == DEGRADED
+        for _ in range(2):
+            insp.inspect(u, i, v)
+            assert insp.status()[0] == DEGRADED  # still in window
+        for _ in range(4):
+            insp.inspect(u, i, v)
+        assert insp.status()[0] == OK  # aged out
+
+    def test_skew_alone_degrades_never_criticals(self, live_obs):
+        insp = DataQualityInspector(skew_threshold=2.0)
+        u, i, v = _clean()
+        for _ in range(10):
+            insp.inspect(u, i, v, partition=0)
+        insp.inspect(u[:1], i[:1], v[:1], partition=1)
+        status, detail = insp.status()
+        assert status == DEGRADED
+        assert detail.get("skewed") is True
+
+    def test_per_class_policy_overrides(self, live_obs):
+        """A dense/replayed stream's NATURAL duplicate rate must be
+        priceable per class without loosening the corruption classes:
+        23% duplicates stay OK under a (0.3, 0.8) duplicate policy
+        while 2% NaN still degrades under the tight default."""
+        insp = DataQualityInspector(
+            degraded_frac=0.01, critical_frac=0.10,
+            class_policy={"duplicate_key": (0.3, 0.8)})
+        u = np.zeros(100, np.int64)  # every row duplicates (0, 0)...
+        u[:77] = np.arange(77)       # ...except the unique prefix
+        i = np.zeros(100, np.int64)
+        v = np.ones(100, np.float32)
+        insp.inspect(u, i, v)  # 23 duplicate rows = 23% < 30%
+        assert insp.status()[0] == OK
+        v2 = v.copy()
+        v2[:2] = np.nan  # 2% NaN ≥ the tight 1% default
+        insp.inspect(np.arange(100), i, v2)  # no dupes this batch
+        assert insp.status()[0] == DEGRADED
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            DataQualityInspector(degraded_frac=0.0)
+        with pytest.raises(ValueError):
+            DataQualityInspector(degraded_frac=0.5, critical_frac=0.1)
+        with pytest.raises(ValueError):
+            DataQualityInspector(window=0)
+        with pytest.raises(ValueError):
+            DataQualityInspector(class_policy={"no_such_class": (0.1, 0.2)})
+        with pytest.raises(ValueError):
+            DataQualityInspector(class_policy={"non_finite": (0.5, 0.1)})
+
+
+class TestHealthCheckAndMetrics:
+    def test_data_quality_check_surface(self, live_obs):
+        insp = DataQualityInspector(degraded_frac=0.01)
+        check = DataQualityCheck(insp)
+        res = check()
+        assert res.status == OK  # nothing inspected: not an incident
+        assert "no batches" in res.detail["note"]
+        u, i, v = _clean()
+        bad = v.copy()
+        bad[:50] = np.inf
+        insp.inspect(u, i, bad)
+        assert check().status == CRITICAL
+
+    def test_watch_data_quality_registers(self, live_obs):
+        insp = DataQualityInspector()
+        monitor = HealthMonitor()
+        monitor.watch_data_quality(insp)
+        assert "data_quality" in monitor.names()
+        assert monitor.run()["status"] == OK
+
+    def test_metrics_published(self, live_obs):
+        insp = DataQualityInspector()
+        u, i, v = _clean()
+        v[0] = np.nan
+        insp.inspect(u, i, v)
+        names = {(m["name"], tuple(sorted(m["labels"].items())))
+                 for m in live_obs.snapshot()["metrics"]}
+        assert ("dataq_batches_total", ()) in names
+        assert ("dataq_violations_total",
+                (("cls", "non_finite"),)) in names
+        assert ("dataq_violation_frac",
+                (("cls", "non_finite"),)) in names
+        assert ("dataq_partition_skew", ()) in names
+
+    def test_event_journaled_once_per_offending_batch(self, live_obs):
+        _, journal = obs.enable_flight_recorder(start=False)
+        try:
+            insp = DataQualityInspector()
+            u, i, v = _clean()
+            v[:5] = np.nan
+            insp.inspect(u, i, v)
+            insp.inspect(u, i, _clean()[2])  # clean: no event
+            evs = journal.events(kind="data.quality_violation")
+            assert len(evs) == 1
+            assert evs[0]["detail"]["non_finite"] == 5
+        finally:
+            rec = get_recorder()
+            if rec is not None:
+                rec.stop()
+            set_recorder(None)
+            set_events(None)
+
+    def test_snapshot_json_safe(self, live_obs):
+        import json
+
+        insp = DataQualityInspector(rating_range=(0, 5))
+        u, i, v = _clean()
+        insp.inspect(u, i, v)
+        json.dumps(insp.snapshot())
+
+
+class TestDriverChaining:
+    def test_driver_inspects_every_batch_without_mutating(self,
+                                                          live_obs,
+                                                          tmp_path):
+        """The front-of-partial_fit chaining: every applied batch is
+        inspected (batch counts match) and training consumed the SAME
+        rows it would have uninspected."""
+        from large_scale_recommendation_tpu.models.online import (
+            OnlineMF,
+            OnlineMFConfig,
+        )
+        from large_scale_recommendation_tpu.streams.driver import (
+            StreamingDriver,
+            StreamingDriverConfig,
+        )
+        from large_scale_recommendation_tpu.streams.log import EventLog
+
+        log = EventLog(str(tmp_path / "log"))
+        rng = np.random.default_rng(0)
+        for _ in range(3):
+            log.append_arrays(0, rng.integers(0, 50, 400),
+                              rng.integers(0, 30, 400),
+                              rng.normal(3, 1, 400).astype(np.float32))
+        model = OnlineMF(OnlineMFConfig(num_factors=4,
+                                        minibatch_size=128))
+        insp = DataQualityInspector(rating_range=(-10, 10))
+        driver = StreamingDriver(
+            model, log, str(tmp_path / "ckpt"),
+            config=StreamingDriverConfig(batch_records=400),
+            inspector=insp)
+        applied = driver.run()
+        assert applied == 3
+        assert insp.batches == 3
+        assert insp.records == 1200
+        assert driver.records_processed == 1200  # observe-only
+
+    def test_zero_cost_off(self, tmp_path):
+        """No inspector, no evaluator → the driver's hooks are None and
+        nothing data-quality-shaped exists anywhere (one pointer test
+        per batch, the package discipline)."""
+        from large_scale_recommendation_tpu.models.online import (
+            OnlineMF,
+            OnlineMFConfig,
+        )
+        from large_scale_recommendation_tpu.streams.driver import (
+            StreamingDriver,
+        )
+        from large_scale_recommendation_tpu.streams.log import EventLog
+
+        from large_scale_recommendation_tpu.obs.lineage import (
+            get_lineage,
+            set_lineage,
+        )
+
+        prev = get_lineage()
+        set_lineage(None)  # force the true disabled state (an OBS_OUT
+        try:  # session may run a suite-wide journal)
+            log = EventLog(str(tmp_path / "log"))
+            model = OnlineMF(OnlineMFConfig(num_factors=4))
+            driver = StreamingDriver(model, log, str(tmp_path / "ckpt"))
+            assert driver.inspector is None
+            assert driver.evaluator is None
+            assert driver._lineage is None
+        finally:
+            set_lineage(prev)

@@ -256,6 +256,60 @@ class TestNullPathZeroWork:
         assert wd.tripped and wd.last_bundle is None
         assert null_obs.names() == set()
 
+    def test_model_plane_default_off_everywhere(self, null_obs,
+                                                tmp_path):
+        """The ISSUE-10 extension of the zero-cost pin: with nothing
+        enabled, get_lineage() is None and every stamping/joining site
+        binds that None — the engine's swap/flush hooks, the driver's
+        ingest watermark, the adaptive install — and a driver built
+        without an inspector/evaluator carries None hooks: one pointer
+        test per batch, no reservoir, no window deques, no journal."""
+        from large_scale_recommendation_tpu.models.adaptive import (
+            AdaptiveMF,
+            AdaptiveMFConfig,
+        )
+        from large_scale_recommendation_tpu.obs.lineage import (
+            get_lineage,
+            set_lineage,
+        )
+        from large_scale_recommendation_tpu.serving.engine import (
+            ServingEngine,
+        )
+        from large_scale_recommendation_tpu.streams.driver import (
+            StreamingDriver,
+        )
+
+        prev = get_lineage()
+        set_lineage(None)  # an OBS_OUT session runs one suite-wide
+        try:
+            assert get_lineage() is None
+            engine = ServingEngine(_tiny_model(), k=3, max_batch=32)
+            assert engine._lineage is None
+            model = OnlineMF(OnlineMFConfig(num_factors=4,
+                                            minibatch_size=64))
+            log = EventLog(str(tmp_path / "log"))
+            driver = StreamingDriver(model, log, str(tmp_path / "ckpt"))
+            assert driver._lineage is None
+            assert driver.inspector is None
+            assert driver.evaluator is None
+            adaptive = AdaptiveMF(AdaptiveMFConfig(num_factors=4))
+            assert adaptive._lineage is None
+            # the offline trainers' quality hook defaults off too
+            from large_scale_recommendation_tpu.models.als import ALS
+            from large_scale_recommendation_tpu.models.dsgd import DSGD
+
+            assert DSGD().evaluator is None
+            assert ALS().evaluator is None
+            # the whole null stream path still runs clean, recording
+            # nothing anywhere
+            _fill_log(log, n_batches=1)
+            driver.serving_engine(k=3, max_batch=32)
+            driver.run()
+            driver.refresh_serving()
+            assert null_obs.names() == set()
+        finally:
+            set_lineage(prev)
+
     def test_introspection_default_off_and_funnel_unpatched(
             self, null_obs):
         """The ISSUE-9 extension of the zero-cost pin: with nothing

@@ -1,0 +1,481 @@
+"""Catalog lineage (``obs.lineage``): journal upsert/eviction/resolve
+semantics, the freshness state machine behind the staleness SLO, the
+``/lineagez`` route, and the acceptance paths — every served
+``RecResult.catalog_version`` on a real ``StreamingDriver`` run joins
+to a provenance record whose watermark ≤ the consumed offset at serve
+time (surviving a kill/restart resume), and an injected staleness
+condition (ingest continues, swaps stop) flips ``/healthz`` to 503
+over a real socket.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from large_scale_recommendation_tpu import obs
+from large_scale_recommendation_tpu.obs.events import get_events, set_events
+from large_scale_recommendation_tpu.obs.health import (
+    CRITICAL,
+    DEGRADED,
+    OK,
+    HealthMonitor,
+)
+from large_scale_recommendation_tpu.obs.lineage import (
+    FreshnessCheck,
+    LineageJournal,
+    get_lineage,
+    set_lineage,
+)
+from large_scale_recommendation_tpu.obs.recorder import (
+    get_recorder,
+    set_recorder,
+)
+from large_scale_recommendation_tpu.obs.registry import (
+    get_registry,
+    set_registry,
+)
+from large_scale_recommendation_tpu.obs.trace import get_tracer, set_tracer
+
+
+@pytest.fixture
+def lineage_obs():
+    """Live registry + installed lineage journal, previous layer
+    restored after (an OBS_OUT session may run its own suite-wide)."""
+    prev = (get_registry(), get_tracer(), get_events(), get_recorder(),
+            get_lineage())
+    reg, _ = obs.enable()
+    journal = obs.enable_lineage(capacity=64)
+    yield reg, journal
+    set_registry(prev[0])
+    set_tracer(prev[1])
+    set_events(prev[2])
+    set_recorder(prev[3])
+    set_lineage(prev[4])
+
+
+class TestJournal:
+    def test_record_upsert_merges_by_version(self, lineage_obs):
+        """The multi-site stamping contract: the engine stamps first
+        (no watermark), the driver enriches the SAME record — one
+        record per servable build, first wall_time wins."""
+        _, j = lineage_obs
+        a = j.record_swap(5, source="engine_refresh")
+        assert a["wal_offset_watermark"] is None
+        t0 = a["wall_time"]
+        b = j.record_swap(5, wal_offset_watermark=400, train_step=7,
+                          source="stream_refresh")
+        assert b["wall_time"] == t0  # creation instant preserved
+        assert b["wal_offset_watermark"] == 400
+        assert b["train_step"] == 7
+        assert len(j) == 1
+        assert j.swaps == 2
+
+    def test_eviction_is_bounded(self, lineage_obs):
+        _, j = lineage_obs
+        for v in range(100):
+            j.record_swap(v)
+        assert len(j) == 64  # capacity
+        assert j.evicted == 36
+        assert j.resolve(0) is None  # oldest evicted
+        assert j.resolve(99) is not None
+
+    def test_resolve_unknown_none(self, lineage_obs):
+        _, j = lineage_obs
+        assert j.resolve(12345) is None
+
+    def test_observe_serve_publishes_staleness_and_join_counters(
+            self, lineage_obs):
+        reg, j = lineage_obs
+        j.record_swap(3, wal_offset_watermark=10)
+        stale = j.observe_serve(3, requests=4)
+        assert stale is not None and stale >= 0.0
+        assert j.observe_serve(999) is None  # unresolved
+        metrics = {(m["name"], tuple(sorted(m["labels"].items()))): m
+                   for m in reg.snapshot()["metrics"]}
+        assert metrics[("lineage_serve_joins_total",
+                        (("resolved", "true"),))]["value"] == 4
+        assert metrics[("lineage_serve_joins_total",
+                        (("resolved", "false"),))]["value"] == 1
+        assert ("lineage_staleness_s", ()) in metrics
+
+    def test_ingest_to_servable_freshness_priced_once(self, lineage_obs):
+        """The freshness histogram observes when a record FIRST gains a
+        watermark: the newest covered ingest mark prices how long data
+        waited to become servable."""
+        reg, j = lineage_obs
+        t0 = time.time()
+        j.note_ingest(100, t=t0 - 5.0)
+        j.note_ingest(200, t=t0 - 1.0)
+        j.record_swap(1, wal_offset_watermark=150, wall_time=t0)
+        metrics = {m["name"]: m for m in reg.snapshot()["metrics"]}
+        h = metrics["lineage_ingest_to_servable_s"]
+        assert h["count"] == 1
+        # watermark 150 covers only the offset-100 mark (5 s old)
+        assert h["max"] == pytest.approx(5.0, abs=0.2)
+        j.record_swap(1, train_step=3)  # re-stamp: no second observe
+        assert reg.snapshot()["metrics"]
+        h = {m["name"]: m for m in reg.snapshot()["metrics"]}[
+            "lineage_ingest_to_servable_s"]
+        assert h["count"] == 1
+
+    def test_snapshot_and_tail(self, lineage_obs):
+        _, j = lineage_obs
+        for v in range(5):
+            j.record_swap(v, wal_offset_watermark=v * 10)
+        doc = j.snapshot(limit=3)
+        assert doc["returned"] == 3
+        assert doc["swaps"] == 5
+        assert [r["catalog_version"] for r in j.tail(2)] == [3, 4]
+        json.dumps(doc)  # JSON-safe
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            LineageJournal(capacity=0)
+
+
+class TestFreshness:
+    def test_no_ingest_is_ok(self, lineage_obs):
+        _, j = lineage_obs
+        check = FreshnessCheck(j, degraded_after_s=1.0)
+        assert check().status == OK
+
+    def test_servable_covers_ingest_is_ok(self, lineage_obs):
+        _, j = lineage_obs
+        j.note_ingest(100)
+        j.record_swap(1, wal_offset_watermark=100)
+        check = FreshnessCheck(j, degraded_after_s=0.0)
+        assert check().status == OK
+
+    def test_ingest_ahead_ages_to_degraded_then_critical(self,
+                                                         lineage_obs):
+        _, j = lineage_obs
+        t0 = time.time()
+        j.record_swap(1, wal_offset_watermark=100, wall_time=t0 - 10)
+        j.note_ingest(100, t=t0 - 10)
+        j.note_ingest(250, t=t0 - 2.0)  # ingested, never became servable
+        check = FreshnessCheck(j, degraded_after_s=1.0,
+                               critical_after_s=5.0)
+        res = check()
+        assert res.status == DEGRADED
+        assert res.detail["ingest_ahead"] is True
+        assert res.detail["unservable_age_s"] == pytest.approx(2.0,
+                                                               abs=0.5)
+        tight = FreshnessCheck(j, degraded_after_s=0.5,
+                               critical_after_s=1.0)
+        assert tight().status == CRITICAL
+
+    def test_oldest_unservable_record_prices_the_age(self, lineage_obs):
+        """The SLO ages from the OLDEST waiting record, not the newest
+        arrival — a stream that keeps ingesting must not keep resetting
+        its own staleness clock."""
+        _, j = lineage_obs
+        t0 = time.time()
+        j.record_swap(1, wal_offset_watermark=100, wall_time=t0 - 30)
+        j.note_ingest(150, t=t0 - 20)  # oldest unservable: 20 s
+        j.note_ingest(300, t=t0 - 0.1)  # still arriving
+        f = j.freshness()
+        assert f["unservable_age_s"] == pytest.approx(20.0, abs=0.5)
+
+    def test_ingest_without_any_swap_pages(self, lineage_obs):
+        _, j = lineage_obs
+        j.note_ingest(100, t=time.time() - 10)
+        check = FreshnessCheck(j, degraded_after_s=1.0)
+        res = check()
+        assert res.status == DEGRADED
+        assert "no servable watermark" in res.detail["note"]
+
+    def test_partitions_are_independent_offset_spaces(self, lineage_obs):
+        """Two drivers sharing the journal: partition 1 sits at offset
+        50,000 while partition 0's swap covers offset 100 — neither a
+        false page (p1's high offsets are NOT 'ahead' of p0's swap) nor
+        a masked one (p0 falling behind still ages) may result."""
+        _, j = lineage_obs
+        t0 = time.time()
+        j.note_ingest(100, partition=0, t=t0 - 5)
+        j.note_ingest(50_000, partition=1, t=t0 - 5)
+        j.record_swap(1, wal_offset_watermark=100, partition=0,
+                      wall_time=t0 - 4)
+        j.record_swap(2, wal_offset_watermark=50_000, partition=1,
+                      wall_time=t0 - 4)
+        f = j.freshness()
+        assert f["ingest_ahead"] is False  # both partitions covered
+        assert f["partitions"][0]["servable_watermark"] == 100
+        assert f["partitions"][1]["servable_watermark"] == 50_000
+        check = FreshnessCheck(j, degraded_after_s=0.5)
+        assert check().status == OK
+        # now ONLY partition 0 falls behind: the high-offset partition
+        # must not mask it
+        j.note_ingest(300, partition=0, t=t0 - 3)
+        res = check()
+        assert res.status == DEGRADED
+        assert res.detail["partitions"][0]["ingest_ahead"] is True
+        f = j.freshness()
+        assert f["partitions"][1]["ingest_ahead"] is False
+
+    def test_multi_partition_record_merges_watermarks(self, lineage_obs):
+        """An adaptive retrain over several partitions stamps one
+        record with a per-partition watermark map; the flat field keeps
+        the max for single-partition readers."""
+        _, j = lineage_obs
+        j.record_swap(9, wal_offset_watermark=100, partition=0)
+        rec = j.record_swap(9, wal_offset_watermark=7_000, partition=1)
+        assert rec["watermarks"] == {0: 100, 1: 7_000}
+        assert rec["wal_offset_watermark"] == 7_000
+        assert len(j) == 1
+
+    def test_validation(self, lineage_obs):
+        _, j = lineage_obs
+        with pytest.raises(ValueError):
+            FreshnessCheck(j, degraded_after_s=-1.0)
+        with pytest.raises(ValueError):
+            FreshnessCheck(j, degraded_after_s=5.0, critical_after_s=1.0)
+
+    def test_watch_freshness_registers(self, lineage_obs):
+        _, j = lineage_obs
+        monitor = HealthMonitor()
+        monitor.watch_freshness(j, degraded_after_s=1.0)
+        assert "freshness" in monitor.names()
+        assert monitor.run()["status"] == OK
+
+
+def _fill_log(log, gen, n_batches=3, n=1500):
+    for _ in range(n_batches):
+        ru, ri, rv, _ = gen.generate(n).to_numpy()
+        log.append_arrays(0, ru, ri, rv)
+
+
+def _driver(model, log, ckpt_dir, **kwargs):
+    from large_scale_recommendation_tpu.streams.driver import (
+        StreamingDriver,
+        StreamingDriverConfig,
+    )
+
+    return StreamingDriver(model, log, ckpt_dir,
+                           config=StreamingDriverConfig(
+                               batch_records=1500),
+                           **kwargs)
+
+
+class TestDriverJoinEndToEnd:
+    def test_every_served_version_resolves_with_covering_watermark(
+            self, lineage_obs, tmp_path):
+        """THE acceptance join on a real driver run: every served
+        ``RecResult.catalog_version`` resolves in the journal to a
+        record whose WAL watermark ≤ the consumed offset at serve time
+        — across initial bind, delta refresh, and full refresh."""
+        from large_scale_recommendation_tpu.core.generators import (
+            SyntheticMFGenerator,
+        )
+        from large_scale_recommendation_tpu.models.online import (
+            OnlineMF,
+            OnlineMFConfig,
+        )
+        from large_scale_recommendation_tpu.streams.log import EventLog
+
+        _, journal = lineage_obs
+        gen = SyntheticMFGenerator(num_users=200, num_items=80, rank=4,
+                                   noise=0.1, seed=0)
+        log = EventLog(str(tmp_path / "log"))
+        _fill_log(log, gen, n_batches=2)
+        model = OnlineMF(OnlineMFConfig(num_factors=4,
+                                        minibatch_size=512))
+        driver = _driver(model, log, str(tmp_path / "ckpt"))
+        engine = driver.serving_engine(k=5, max_batch=64)
+        served = []
+
+        def serve_and_check():
+            res = engine.recommend(np.arange(16, dtype=np.int64))
+            rec = journal.resolve(res.catalog_version)
+            assert rec is not None, res.catalog_version
+            assert rec["wal_offset_watermark"] is not None
+            assert rec["wal_offset_watermark"] <= driver.consumed_offset
+            served.append((res.catalog_version,
+                           rec["wal_offset_watermark"]))
+
+        serve_and_check()  # the bind itself is provenanced
+        driver.run()
+        driver.refresh_serving()  # delta path
+        serve_and_check()
+        _fill_log(log, gen, n_batches=1)
+        driver.run()
+        driver.refresh_serving(delta=False)  # full-rebuild path
+        serve_and_check()
+        # watermarks advance with the stream
+        assert served[-1][1] > served[0][1]
+        # and the engine flushes joined: resolved counter ≥ serves
+        reg = get_registry()
+        joins = reg.counter("lineage_serve_joins_total", resolved="true")
+        assert joins.value >= 3
+
+    def test_join_survives_kill_restart_resume(self, lineage_obs,
+                                               tmp_path):
+        """Kill/restart: a NEW driver+model resumed from the checkpoint
+        re-binds serving, and served versions STILL resolve with a
+        covering watermark (fresh records — the provenance chain
+        continues across the crash)."""
+        from large_scale_recommendation_tpu.core.generators import (
+            SyntheticMFGenerator,
+        )
+        from large_scale_recommendation_tpu.models.online import (
+            OnlineMF,
+            OnlineMFConfig,
+        )
+        from large_scale_recommendation_tpu.streams.log import EventLog
+
+        _, journal = lineage_obs
+        gen = SyntheticMFGenerator(num_users=200, num_items=80, rank=4,
+                                   noise=0.1, seed=0)
+        log = EventLog(str(tmp_path / "log"))
+        _fill_log(log, gen, n_batches=2)
+        model = OnlineMF(OnlineMFConfig(num_factors=4,
+                                        minibatch_size=512))
+        driver = _driver(model, log, str(tmp_path / "ckpt"))
+        driver.run()  # checkpoints (factors, step, offset) atomically
+        pre_crash_offset = driver.consumed_offset
+
+        # ---- crash: everything in-process dies except the journal
+        # (in a real restart the journal is fresh — new swaps re-stamp;
+        # here it persists, which also pins that STALE records from the
+        # previous life don't satisfy the new serve joins)
+        del driver, model
+        _fill_log(log, gen, n_batches=1)  # the tail the crash missed
+
+        model2 = OnlineMF(OnlineMFConfig(num_factors=4,
+                                         minibatch_size=512))
+        driver2 = _driver(model2, log, str(tmp_path / "ckpt"))
+        assert driver2.resume()
+        assert driver2.consumed_offset == pre_crash_offset
+        driver2.run()  # replays the tail
+        engine = driver2.serving_engine(k=5, max_batch=64)
+        driver2.refresh_serving()
+        res = engine.recommend(np.arange(16, dtype=np.int64))
+        rec = journal.resolve(res.catalog_version)
+        assert rec is not None
+        assert rec["wal_offset_watermark"] == driver2.consumed_offset
+        assert rec["wal_offset_watermark"] > pre_crash_offset
+
+    def test_adaptive_retrain_swap_carries_retrain_id(self, lineage_obs,
+                                                      tmp_path):
+        from large_scale_recommendation_tpu.core.generators import (
+            SyntheticMFGenerator,
+        )
+        from large_scale_recommendation_tpu.models.adaptive import (
+            AdaptiveMF,
+            AdaptiveMFConfig,
+        )
+        from large_scale_recommendation_tpu.streams.log import EventLog
+
+        _, journal = lineage_obs
+        gen = SyntheticMFGenerator(num_users=120, num_items=50, rank=4,
+                                   noise=0.1, seed=0)
+        log = EventLog(str(tmp_path / "log"))
+        _fill_log(log, gen, n_batches=3, n=800)
+        model = AdaptiveMF(AdaptiveMFConfig(
+            num_factors=4, minibatch_size=256, offline_every=2,
+            offline_iterations=2, background=False))
+        driver = _driver(model, log, str(tmp_path / "ckpt"))
+        engine = driver.serving_engine(k=5, max_batch=64)
+        driver.run()  # 3 batches → at least one retrain swap
+        assert model.retrain_count >= 1
+        res = engine.recommend(np.arange(8, dtype=np.int64))
+        rec = journal.resolve(res.catalog_version)
+        assert rec is not None
+        assert rec["source"] == "retrain_install"
+        assert rec["retrain_id"] == model.retrain_count
+        assert rec["wal_offset_watermark"] is not None
+        assert rec["wal_offset_watermark"] <= driver.consumed_offset
+
+
+class TestLineagezRoute:
+    def test_lineagez_served_over_socket(self, lineage_obs):
+        from large_scale_recommendation_tpu.obs.server import (
+            ObsServer,
+            http_get,
+        )
+
+        _, j = lineage_obs
+        j.note_ingest(100)
+        j.record_swap(1, wal_offset_watermark=100, train_step=3,
+                      source="test")
+        with ObsServer() as server:
+            code, body = http_get(server.url + "/lineagez")
+            assert code == 200
+            doc = json.loads(body)
+            code, root = http_get(server.url + "/")
+            assert "/lineagez" in json.loads(root)["routes"]
+        assert doc["swaps"] == 1
+        assert doc["records"][0]["catalog_version"] == 1
+        assert doc["records"][0]["wal_offset_watermark"] == 100
+        assert doc["freshness"]["servable_watermark"] == 100
+
+    def test_route_without_journal_notes(self):
+        from large_scale_recommendation_tpu.obs.server import (
+            ObsServer,
+            http_get,
+        )
+
+        prev = get_lineage()
+        set_lineage(None)
+        try:
+            with ObsServer() as server:
+                code, body = http_get(server.url + "/lineagez")
+        finally:
+            set_lineage(prev)
+        assert code == 200
+        doc = json.loads(body)
+        assert "note" in doc and doc["records"] == []
+
+
+class TestStalenessFlipsHealthz:
+    def test_ingest_continues_swaps_stop_503s_healthz(self, lineage_obs,
+                                                      tmp_path):
+        """THE staleness acceptance pin (ISSUE 10): ingest keeps
+        applying WAL batches while nobody refreshes serving → the
+        freshness SLO check flips /healthz to 503 over a real socket;
+        a re-swap recovers it to 200."""
+        from large_scale_recommendation_tpu.core.generators import (
+            SyntheticMFGenerator,
+        )
+        from large_scale_recommendation_tpu.models.online import (
+            OnlineMF,
+            OnlineMFConfig,
+        )
+        from large_scale_recommendation_tpu.obs.server import (
+            ObsServer,
+            http_get,
+        )
+        from large_scale_recommendation_tpu.streams.log import EventLog
+
+        _, journal = lineage_obs
+        gen = SyntheticMFGenerator(num_users=200, num_items=80, rank=4,
+                                   noise=0.1, seed=0)
+        log = EventLog(str(tmp_path / "log"))
+        _fill_log(log, gen, n_batches=2)
+        model = OnlineMF(OnlineMFConfig(num_factors=4,
+                                        minibatch_size=512))
+        driver = _driver(model, log, str(tmp_path / "ckpt"))
+        driver.serving_engine(k=5, max_batch=64)
+        driver.run()
+        driver.refresh_serving()
+        monitor = HealthMonitor()
+        monitor.watch_freshness(journal, degraded_after_s=0.02,
+                                critical_after_s=0.05)
+        with ObsServer(monitor=monitor) as server:
+            code, body = http_get(server.url + "/healthz")
+            assert code == 200, body  # servable covers ingest
+            # the injection: ingest continues, swaps STOP
+            _fill_log(log, gen, n_batches=1)
+            driver.run()
+            time.sleep(0.1)  # unservable records age past the SLO
+            code, body = http_get(server.url + "/healthz")
+            assert code == 503, body
+            report = json.loads(body)
+            assert report["checks"]["freshness"]["status"] == CRITICAL
+            assert report["checks"]["freshness"]["detail"][
+                "ingest_ahead"] is True
+            driver.refresh_serving()  # the fix
+            code, body = http_get(server.url + "/healthz")
+        assert code == 200, body

@@ -1,0 +1,422 @@
+"""Model-quality observability (``obs.quality``): the sampled ranking
+metric's planted-structure pins (floor ≈ k/(n+1) for a random model,
+ceiling ≈ 1 for the true factors — the eval itself must be trustworthy
+before any training-side number is), catalog coverage, the reservoir
+holdout's never-trained-on contract, the DSGD/ALS segment hook, and the
+acceptance path — training on label-shuffled ratings mid-stream flips
+``/healthz`` to 503 through the threshold-free quality anomaly checks
+over a real socket.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from large_scale_recommendation_tpu import obs
+from large_scale_recommendation_tpu.core.types import Ratings
+from large_scale_recommendation_tpu.models.online import (
+    OnlineMF,
+    OnlineMFConfig,
+)
+from large_scale_recommendation_tpu.obs.events import get_events, set_events
+from large_scale_recommendation_tpu.obs.health import (
+    CRITICAL,
+    OK,
+    HealthMonitor,
+)
+from large_scale_recommendation_tpu.obs.lineage import (
+    get_lineage,
+    set_lineage,
+)
+from large_scale_recommendation_tpu.obs.quality import (
+    OnlineEvaluator,
+    catalog_coverage,
+    sampled_ranking_metrics,
+)
+from large_scale_recommendation_tpu.obs.recorder import (
+    get_recorder,
+    series_key,
+    set_recorder,
+)
+from large_scale_recommendation_tpu.obs.registry import (
+    get_registry,
+    set_registry,
+)
+from large_scale_recommendation_tpu.obs.trace import get_tracer, set_tracer
+
+
+@pytest.fixture
+def flight_obs():
+    prev = (get_registry(), get_tracer(), get_events(), get_recorder(),
+            get_lineage())
+    reg, tracer = obs.enable()
+    recorder, journal = obs.enable_flight_recorder(start=False)
+    yield reg, tracer, recorder, journal
+    recorder.stop()
+    set_registry(prev[0])
+    set_tracer(prev[1])
+    set_events(prev[2])
+    set_recorder(prev[3])
+    set_lineage(prev[4])
+
+
+def _planted(nu=200, ni=500, r=16, seed=0):
+    """True factor tables with unit-variance scores: each user's argmax
+    item is a positive the TRUE model must rank near the top and a
+    random model must rank uniformly."""
+    rng = np.random.default_rng(seed)
+    U = rng.normal(size=(nu, r)).astype(np.float32)
+    V = rng.normal(size=(ni, r)).astype(np.float32)
+    pos = np.argmax(U @ V.T, axis=1)
+    return U, V, np.arange(nu), pos.astype(np.int64)
+
+
+class TestSampledRankingMetrics:
+    def test_planted_structure_ceiling_and_floor(self):
+        """The trustworthiness pin (the ndcg=0.003-for-five-rounds
+        lesson): the metric's value is interpretable because its
+        extremes are KNOWN. True factors rank their own argmax positives
+        ≈ perfectly; random factors score ≈ the analytic floor
+        k/(num_negatives+1) — and the two are separated by an order of
+        magnitude, so a near-floor score indicts the model, not the
+        eval."""
+        U, V, eu, ei = _planted()
+        k, n_neg = 10, 100
+        good = sampled_ranking_metrics(U, V, eu, ei, k=k,
+                                       num_negatives=n_neg, seed=1)
+        assert good["hr"] >= 0.95
+        assert good["ndcg"] >= 0.9
+        rng = np.random.default_rng(9)
+        U_rand = rng.normal(size=U.shape).astype(np.float32)
+        bad = sampled_ranking_metrics(U_rand, V, eu, ei, k=k,
+                                      num_negatives=n_neg, seed=1)
+        floor = k / (n_neg + 1)
+        assert bad["hr"] <= 2.5 * floor  # uniform rank, sampling noise
+        assert bad["hr"] >= floor / 4
+        assert good["hr"] > 5 * bad["hr"]
+        assert good["ndcg"] > 5 * bad["ndcg"]
+
+    def test_train_seen_negatives_masked_out(self):
+        """A train-seen item must not count as a negative: a catalog
+        where the user's ONLY better-scoring item was trained on ranks
+        the positive first with masking, last-ish without."""
+        U = np.ones((1, 1), np.float32)
+        V = np.array([[0.5], [10.0], [0.1]], np.float32)
+        eu, ei = np.array([0]), np.array([0])  # positive scores 0.5
+        with_mask = sampled_ranking_metrics(
+            U, V, eu, ei, k=1, num_negatives=64,
+            train_u=np.array([0]), train_i=np.array([1]), seed=0)
+        assert with_mask["hr"] == 1.0  # only item 2 (0.1) survives
+        without = sampled_ranking_metrics(U, V, eu, ei, k=1,
+                                          num_negatives=64, seed=0)
+        assert without["hr"] == 0.0  # item 1 (10.0) outranks it
+        # masked slots shrink the VALID pool, never the sampled shape
+        assert with_mask["valid_negatives"] < without["valid_negatives"]
+
+    def test_item_mask_excludes_phantom_rows(self):
+        """Phantom padding rows never enter the negative pool."""
+        U = np.ones((1, 1), np.float32)
+        V = np.array([[0.5], [99.0], [0.1]], np.float32)
+        mask = np.array([True, False, True])  # row 1 is padding
+        res = sampled_ranking_metrics(U, V, np.array([0]), np.array([0]),
+                                      k=1, num_negatives=64,
+                                      item_mask=mask, seed=0)
+        assert res["hr"] == 1.0  # the 99.0 phantom never sampled
+
+    def test_positive_self_collision_masked(self):
+        """With a 1-item pool every sampled negative IS the positive —
+        all masked, rank 0, hit."""
+        U = np.ones((1, 2), np.float32)
+        V = np.ones((1, 2), np.float32)
+        res = sampled_ranking_metrics(U, V, np.array([0]), np.array([0]),
+                                      k=5, num_negatives=16, seed=0)
+        assert res["hr"] == 1.0
+        assert res["valid_negatives"] == 0.0
+
+    def test_empty_eval_set(self):
+        U, V, _, _ = _planted(nu=4, ni=4, r=2)
+        res = sampled_ranking_metrics(U, V, np.zeros(0, np.int64),
+                                      np.zeros(0, np.int64))
+        assert res["n"] == 0 and np.isnan(res["hr"])
+
+
+class TestCatalogCoverage:
+    def test_identical_users_cover_exactly_k(self):
+        """The aggregate-diversity failure HR can't see: every user
+        getting the same list covers exactly k of the catalog."""
+        rng = np.random.default_rng(0)
+        V = rng.normal(size=(50, 8)).astype(np.float32)
+        U = np.tile(rng.normal(size=(1, 8)).astype(np.float32), (30, 1))
+        cov = catalog_coverage(U, V, np.arange(30), k=10)
+        assert cov == pytest.approx(10 / 50)
+
+    def test_diverse_users_cover_more(self):
+        U, V, eu, _ = _planted(nu=100, ni=50, r=16)
+        cov = catalog_coverage(U, V, eu, k=10)
+        assert cov > 10 / 50
+
+    def test_item_mask_shrinks_denominator_and_pool(self):
+        rng = np.random.default_rng(1)
+        V = rng.normal(size=(40, 4)).astype(np.float32)
+        U = rng.normal(size=(20, 4)).astype(np.float32)
+        mask = np.zeros(40, bool)
+        mask[:20] = True
+        cov = catalog_coverage(U, V, np.arange(20), k=30, item_mask=mask)
+        # ≤ 20 real items exist; every surfaced row must be a real one
+        assert 0.0 < cov <= 1.0
+
+    def test_empty_inputs_nan(self):
+        U, V, _, _ = _planted(nu=4, ni=4, r=2)
+        assert np.isnan(catalog_coverage(U, V, np.zeros(0, np.int64)))
+
+
+def _batch(rng, Ut, Vt, n=2000, shuffle=False, noise=0.05):
+    nu, ni = Ut.shape[0], Vt.shape[0]
+    u = rng.integers(0, nu, n)
+    i = rng.integers(0, ni, n)
+    v = (Ut[u] * Vt[i]).sum(1) + rng.normal(0, noise, n)
+    if shuffle:
+        v = rng.permutation(v)
+    return Ratings.from_arrays(u, i, v.astype(np.float32))
+
+
+def _tables(nu=100, ni=40, r=6, seed=3):
+    rng = np.random.default_rng(seed)
+    Ut = rng.normal(size=(nu, r)).astype(np.float32) / np.sqrt(r)
+    Vt = rng.normal(size=(ni, r)).astype(np.float32)
+    return rng, Ut, Vt
+
+
+class TestOnlineEvaluator:
+    def test_split_batch_zeroes_holdout_weights_in_place_shape(self,
+                                                               flight_obs):
+        """The never-trained-on contract, mechanically: the returned
+        batch has the SAME shape (offset stamps and padding layout
+        survive) with exactly the reservoir-absorbed rows' weights
+        zeroed — weight-0 is the padding contract every kernel already
+        skips, so partial_fit cannot train on them."""
+        rng, Ut, Vt = _tables()
+        ev = OnlineEvaluator(None, holdout_fraction=0.3, seed=0)
+        b = _batch(rng, Ut, Vt, n=1000)
+        out = ev.split_batch(b)
+        assert out.n == b.n
+        zeroed = int((np.asarray(out.weights) == 0).sum())
+        assert zeroed == ev.held_out_total > 0
+        # the held-out values live in the reservoir, nowhere else
+        assert ev.holdout_rows == ev.held_out_total
+
+    def test_holdout_rows_never_trained(self, flight_obs):
+        """End-to-end: rows the evaluator held out contribute ZERO
+        training updates — the online ratings counter (real rows only)
+        equals offered minus held out."""
+        reg, _, _, _ = flight_obs
+        rng, Ut, Vt = _tables()
+        m = OnlineMF(OnlineMFConfig(num_factors=8, minibatch_size=512))
+        ev = OnlineEvaluator(m, holdout_fraction=0.25, seed=0)
+        offered = 0
+        for _ in range(4):
+            b = _batch(rng, Ut, Vt, n=1000)
+            offered += 1000
+            m.partial_fit(ev.split_batch(b))
+        trained = reg.counter("online_ratings_total").value
+        assert trained == offered - ev.held_out_total
+        assert ev.held_out_total > 0
+
+    def test_reservoir_is_bounded(self, flight_obs):
+        rng, Ut, Vt = _tables()
+        ev = OnlineEvaluator(None, holdout_fraction=0.5,
+                             reservoir_size=64, seed=0)
+        for _ in range(6):
+            ev.split_batch(_batch(rng, Ut, Vt, n=500))
+        assert ev.held_out_total > 64
+        assert ev.holdout_rows == 64  # capped forever
+
+    def test_evaluate_publishes_gauges_and_warms(self, flight_obs):
+        reg, _, _, _ = flight_obs
+        rng, Ut, Vt = _tables()
+        m = OnlineMF(OnlineMFConfig(num_factors=8, minibatch_size=512))
+        ev = OnlineEvaluator(m, holdout_fraction=0.2, min_eval_rows=64,
+                             seed=0)
+        assert ev.evaluate() is None  # empty reservoir: warming
+        for _ in range(3):
+            m.partial_fit(ev.split_batch(_batch(rng, Ut, Vt, n=1500)))
+        metrics = ev.evaluate()
+        assert metrics is not None
+        assert np.isfinite(metrics["rmse"])
+        assert 0.0 <= metrics["hr"] <= 1.0
+        assert 0.0 < metrics["coverage"] <= 1.0
+        names = {mm["name"] for mm in reg.snapshot()["metrics"]}
+        for name in ("eval_rmse", "eval_ndcg_at_k", "eval_hr_at_k",
+                     "eval_coverage", "eval_holdout_rows",
+                     "eval_runs_total"):
+            assert name in names, name
+        # gauges carry the source label
+        snap = {(mm["name"], tuple(sorted(mm["labels"].items())))
+                for mm in reg.snapshot()["metrics"]}
+        assert ("eval_rmse", (("source", "online"),)) in snap
+
+    def test_cadence_uses_shared_periodic_machinery(self, flight_obs):
+        ev = OnlineEvaluator(None, seed=0)
+        ev.start(interval_s=30.0)
+        try:
+            assert ev.running
+            task = ev._task
+            ev.start(interval_s=30.0)  # idempotent: same task reused
+            assert ev._task is task
+        finally:
+            ev.stop()
+        assert not ev.running
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            OnlineEvaluator(None, holdout_fraction=0.0)
+        with pytest.raises(ValueError):
+            OnlineEvaluator(None, holdout_fraction=1.5)
+        with pytest.raises(ValueError):
+            OnlineEvaluator(None, reservoir_size=0)
+
+    def test_snapshot_json_safe(self, flight_obs):
+        ev = OnlineEvaluator(None, seed=0)
+        doc = ev.snapshot()
+        json.dumps(doc)
+        assert doc["holdout_rows"] == 0
+
+
+class TestSegmentHook:
+    def test_on_segment_without_holdout_is_noop(self, flight_obs):
+        ev = OnlineEvaluator(None, seed=0)
+        assert ev.on_segment(np.ones((4, 2), np.float32),
+                             np.ones((4, 2), np.float32)) is None
+        assert ev.evaluations == 0
+
+    def test_on_segment_scores_offline_holdout(self, flight_obs):
+        """Planted tables score ≈ 0 rmse and high HR through the hook;
+        the gauges land labeled with the segment kind."""
+        reg, _, _, _ = flight_obs
+        U, V, eu, ei = _planted(nu=64, ni=128, r=8)
+        vals = (U[eu] * V[ei]).sum(1).astype(np.float32)
+        ev = OnlineEvaluator(None, seed=0)
+        ev.set_offline_holdout(eu, ei, vals)
+        metrics = ev.on_segment(U, V, label="dsgd_segment", step=5)
+        assert metrics["rmse"] == pytest.approx(0.0, abs=1e-4)
+        assert metrics["hr"] >= 0.9
+        snap = {(mm["name"], tuple(sorted(mm["labels"].items())))
+                for mm in reg.snapshot()["metrics"]}
+        assert ("eval_rmse", (("source", "dsgd_segment"),)) in snap
+
+    def test_dsgd_calls_hook_at_segment_boundaries(self, flight_obs):
+        """The integration pin: an attached evaluator fires once per
+        segment during a real ``DSGD.fit``, and eval_rmse lands under
+        the segment kind. Row mapping comes from re-running the
+        deterministic blocking pass with fit's exact arguments."""
+        from large_scale_recommendation_tpu.core.generators import (
+            SyntheticMFGenerator,
+        )
+        from large_scale_recommendation_tpu.data import blocking
+        from large_scale_recommendation_tpu.models.dsgd import (
+            DSGD,
+            DSGDConfig,
+        )
+
+        reg, _, _, _ = flight_obs
+        gen = SyntheticMFGenerator(num_users=120, num_items=60, rank=4,
+                                   noise=0.1, seed=0)
+        train, hold = gen.generate(8_000), gen.generate(1_000)
+        cfg = DSGDConfig(num_factors=8, iterations=2, num_blocks=2,
+                         minibatch_size=512, learning_rate=0.05,
+                         lambda_=0.01, lr_schedule="constant")
+        solver = DSGD(cfg)
+        # blocking is deterministic given (ratings, seed, layout knobs):
+        # the same call fit() makes maps the holdout ids to rows
+        problem = blocking.block_problem(
+            train, num_blocks=2, seed=cfg.seed,
+            minibatch_multiple=cfg.minibatch_size,
+            minibatch_sort=cfg.minibatch_sort)
+        hu, hi, hv, hw = hold.to_numpy()
+        u_rows, u_mask = problem.users.rows_for(hu)
+        i_rows, i_mask = problem.items.rows_for(hi)
+        keep = (u_mask * i_mask * hw) > 0
+        ev = OnlineEvaluator(None, seed=0)
+        ev.set_offline_holdout(
+            u_rows[keep], i_rows[keep], hv[keep],
+            item_mask=problem.items.ids >= 0)
+        solver.evaluator = ev
+        solver.fit(train, checkpoint_every=1,
+                   checkpoint_manager=None)
+        assert ev.evaluations == 2  # one per segment (2 iterations / 1)
+        snap = {(mm["name"], tuple(sorted(mm["labels"].items()))):
+                mm for mm in reg.snapshot()["metrics"]}
+        key = ("eval_rmse", (("source", "dsgd_segment"),))
+        assert key in snap
+        assert np.isfinite(snap[key]["value"])
+
+    def test_als_calls_hook_at_fit_boundary(self, flight_obs):
+        from large_scale_recommendation_tpu.models.als import ALS, ALSConfig
+
+        reg, _, _, _ = flight_obs
+        rng = np.random.default_rng(0)
+        n = 4000
+        u = rng.integers(0, 50, n)
+        i = rng.integers(0, 30, n)
+        v = rng.normal(3.0, 1.0, n).astype(np.float32)
+        solver = ALS(ALSConfig(num_factors=4, iterations=2))
+        ev = OnlineEvaluator(None, seed=0)
+        solver.evaluator = ev
+        model = solver.fit_device(u, i, v, 50, 30)
+        assert model is not None
+        assert ev.evaluations == 0  # no holdout armed: zero extra work
+        # arm a row-space holdout (fit_device rows ARE the dense ids)
+        ev.set_offline_holdout(u[:256], i[:256], v[:256])
+        solver.fit_device(u, i, v, 50, 30)
+        assert ev.evaluations == 1
+        snap = {(mm["name"], tuple(sorted(mm["labels"].items())))
+                for mm in reg.snapshot()["metrics"]}
+        assert ("eval_rmse", (("source", "als_device_rounds"),)) in snap
+
+
+class TestQualityCollapseFlipsHealthz:
+    def test_label_shuffle_503s_healthz_with_no_per_model_threshold(
+            self, flight_obs):
+        """THE acceptance pin (ISSUE 10): train on label-shuffled
+        ratings mid-stream → eval_rmse spikes off its learned baseline
+        → the watch_quality AnomalyCheck goes CRITICAL → /healthz
+        answers 503 over a real socket. No static per-model quality
+        number appears anywhere in the wiring — the check learned this
+        model's normal from the flight recorder."""
+        from large_scale_recommendation_tpu.obs.server import (
+            ObsServer,
+            http_get,
+        )
+
+        reg, _, rec, _ = flight_obs
+        rng, Ut, Vt = _tables()
+        m = OnlineMF(OnlineMFConfig(num_factors=8, minibatch_size=512,
+                                    learning_rate=0.2,
+                                    iterations_per_batch=2))
+        ev = OnlineEvaluator(m, holdout_fraction=0.15,
+                             reservoir_size=1024, min_eval_rows=32,
+                             seed=0)
+        monitor = HealthMonitor()
+        monitor.watch_quality(rec)
+        # learn the model's normal: clean planted stream to convergence
+        for _ in range(40):
+            m.partial_fit(ev.split_batch(_batch(rng, Ut, Vt)))
+            ev.evaluate()
+            rec.sample()
+        with ObsServer(monitor=monitor) as server:
+            code, body = http_get(server.url + "/healthz")
+            assert code == 200, body
+            assert json.loads(body)["status"] == OK
+            # the collapse: label-shuffled ratings mid-stream
+            for _ in range(4):
+                m.partial_fit(ev.split_batch(
+                    _batch(rng, Ut, Vt, shuffle=True)))
+            ev.evaluate()
+            rec.sample()
+            code, body = http_get(server.url + "/healthz")
+        assert code == 503, body
+        report = json.loads(body)
+        check = report["checks"]["quality:rmse"]
+        assert check["status"] == CRITICAL
+        assert check["detail"]["z"] > 6.0  # far off the learned normal

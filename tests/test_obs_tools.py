@@ -293,6 +293,68 @@ class TestGateEndToEnd:
         assert parsed_any >= 2  # enough healthy rounds to actually gate
 
 
+class TestQualityLineageRenderers:
+    def test_render_lineage_snapshot(self):
+        from scripts.obs_report import render_lineage
+
+        doc = {"time": 100.0, "swaps": 3, "evicted": 0,
+               "records": [
+                   {"catalog_version": 1, "wall_time": 90.0,
+                    "wal_offset_watermark": 500, "train_step": 4,
+                    "retrain_id": None, "source": "stream_refresh",
+                    "seq": 1}],
+               "freshness": {"servable_watermark": 500,
+                             "servable_swap_age_s": 10.0,
+                             "latest_ingest_offset": 700,
+                             "ingest_ahead": True,
+                             "unservable_age_s": 6.0}}
+        out = render_lineage(doc)
+        assert "stream_refresh" in out
+        assert "500" in out
+        assert "INGEST AHEAD" in out
+
+    def test_render_lineage_accepts_bundle_file_shape(self):
+        from scripts.obs_report import render_lineage
+
+        bundle_doc = {"lineage": {"records": [], "swaps": 0,
+                                  "freshness": {}},
+                      "quality": [], "data_quality": []}
+        assert "no provenance records" in render_lineage(bundle_doc)
+
+    def test_render_quality_series_and_bundle_shapes(self):
+        from scripts.obs_report import render_quality
+
+        series_doc = {"series": {
+            'eval_rmse{source="online"}': {
+                "points": [[1, 0.5], [2, 0.4]], "n": 2},
+            "online_batch_s:p50": {"points": [[1, 0.1]], "n": 1}}}
+        out = render_quality(series_doc)
+        assert "eval_rmse" in out
+        assert "online_batch_s" not in out  # non-quality series filtered
+        bundle_doc = {"lineage": {"records": []},
+                      "quality": [{"name": "eval_rmse",
+                                   "labels": {"source": "online"},
+                                   "type": "gauge", "value": 0.42}],
+                      "data_quality": []}
+        out = render_quality(bundle_doc)
+        assert "0.42" in out
+
+    def test_cli_modes(self, tmp_path, capsys):
+        import json as _json
+
+        from scripts.obs_report import main as report_main
+
+        p = tmp_path / "lineage.json"
+        p.write_text(_json.dumps({"records": [], "swaps": 0,
+                                  "freshness": {}}))
+        assert report_main(["--lineage", str(p)]) == 0
+        assert "catalog lineage" in capsys.readouterr().out
+        q = tmp_path / "series.json"
+        q.write_text(_json.dumps({"series": {}}))
+        assert report_main(["--quality", str(q)]) == 0
+        assert "model-quality" in capsys.readouterr().out
+
+
 class TestWatchDeltas:
     def _snap(self, t, counter=0.0, gauge=0.0, hist_count=0):
         return {"time": t, "metrics": [
@@ -414,3 +476,80 @@ class TestServingFamily:
         rounds = find_rounds(str(tmp_path), prefix="SERVING")
         assert [os.path.basename(p) for p in rounds] == [
             "SERVING_r01.json", "SERVING_r03.json"]
+
+
+class TestQualityFamily:
+    """``--family quality`` (ISSUE 10): the model-quality keys ride
+    inside the BENCH rounds — implicit ranking/coverage and the eval_*
+    family gate higher-is-better, eval_rmse lower — following the
+    PR 7/8 family pattern (direction + watch-set unit twins)."""
+
+    BASE = {"als_implicit_ndcg": 0.45, "als_implicit_hr10": 0.62,
+            "als_implicit_coverage": 0.30, "rmse_final": 0.85}
+
+    def _round(self, tmp_path, name, **over):
+        extra = dict(self.BASE, **over)
+        p = tmp_path / name
+        p.write_text(json.dumps(  # the real bench line shape
+            {"metric": "ratings/s", "value": 1000.0,
+             "unit": "ratings/s", "extra": extra}))
+        return str(p)
+
+    def test_ndcg_collapse_alone_trips(self, tmp_path, capsys):
+        """The ndcg=0.003 scenario the family exists for: a ranking
+        collapse trips the gate even with throughput untouched."""
+        b = self._round(tmp_path, "BENCH_r01.json")
+        c = self._round(tmp_path, "BENCH_r02.json",
+                        als_implicit_ndcg=0.003, als_implicit_hr10=0.007)
+        rc = regress_main(["--family", "quality",
+                           "--baseline", b, "--current", c])
+        assert rc == 1
+        assert "als_implicit_ndcg" in capsys.readouterr().out
+
+    def test_rmse_blowup_trips_lower_is_better(self, tmp_path):
+        b = self._round(tmp_path, "BENCH_r01.json")
+        c = self._round(tmp_path, "BENCH_r02.json", rmse_final=2.0)
+        assert regress_main(["--family", "quality",
+                             "--baseline", b, "--current", c]) == 1
+
+    def test_coverage_collapse_trips(self, tmp_path):
+        b = self._round(tmp_path, "BENCH_r01.json")
+        c = self._round(tmp_path, "BENCH_r02.json",
+                        als_implicit_coverage=0.05)
+        assert regress_main(["--family", "quality",
+                             "--baseline", b, "--current", c]) == 1
+
+    def test_across_the_board_improvement_never_trips(self, tmp_path):
+        b = self._round(tmp_path, "BENCH_r01.json")
+        c = self._round(tmp_path, "BENCH_r02.json",
+                        als_implicit_ndcg=0.9, als_implicit_hr10=0.95,
+                        als_implicit_coverage=0.6, rmse_final=0.4)
+        assert regress_main(["--family", "quality",
+                             "--baseline", b, "--current", c]) == 0
+
+    def test_quality_direction_rules(self):
+        """Direction rules cover BOTH the bench-borne keys and the
+        evaluator's eval_* family (watchable via --key on
+        quality-bearing rounds)."""
+        from scripts.bench_regress import QUALITY_KEYS, is_lower_better
+
+        for key in ("als_implicit_ndcg", "als_implicit_hr10",
+                    "als_implicit_coverage", "eval_ndcg_at_k",
+                    "eval_hr_at_k", "eval_coverage"):
+            assert not is_lower_better(key, set()), key
+        for key in ("eval_rmse", "rmse_final", "lineage_staleness_s"):
+            assert is_lower_better(key, set()), key
+        for key in self.BASE:
+            assert key in QUALITY_KEYS, key
+
+    def test_quality_family_reads_bench_rounds(self):
+        """The family maps onto the BENCH prefix and watches ONLY keys
+        a bench round can actually carry — a default watch key no
+        round contains would be permanent 'missing' noise and an
+        unconditional --strict failure."""
+        from scripts.bench_regress import QUALITY_KEYS, FAMILIES
+
+        prefix, keys = FAMILIES["quality"]
+        assert prefix == "BENCH"
+        assert keys is not FAMILIES["bench"][1]
+        assert not any(k.startswith("eval_") for k in QUALITY_KEYS)
