@@ -63,6 +63,11 @@ def main() -> None:
 
         _obs.enable()
         _obs.enable_introspection(start=False)
+        # the causal plane (ISSUE 12): lineage + critical-path analyzer
+        # armed BEFORE any log/driver/engine is built, so the
+        # cross-process trace pass below stamps every hop
+        _obs.enable_lineage()
+        _obs.enable_disttrace()
 
     import jax
     import jax.numpy as jnp
@@ -241,10 +246,105 @@ def main() -> None:
     assert armse < 0.1, armse
 
     if obs_dir:
+        _stream_trace_pass(obs_dir, pid)
         _fleet_pass(obs_dir, pid, nproc)
 
     if pid == 0:
         print("DISTRIBUTED DEMO PASS", flush=True)
+
+
+def _atomic_write(path: str, text: str) -> None:
+    with open(path + ".tmp", "w") as f:
+        f.write(text)
+    os.replace(path + ".tmp", path)  # readers never see a torn file
+
+
+def _wait_for(path: str, deadline: float) -> None:
+    import time as _time
+
+    while not os.path.exists(path):
+        if _time.monotonic() > deadline:
+            raise TimeoutError(f"{path} never appeared")
+        _time.sleep(0.05)
+
+
+def _stream_trace_pass(obs_dir: str, pid: int,
+                       timeout_s: float = 60.0) -> None:
+    """The CROSS-PROCESS half of the distributed-tracing acceptance
+    (ISSUE 12): process 0 is the WAL producer (its tracer stamps
+    ``wal/append`` spans whose trace ids derive from the acked
+    offsets), process 1 the ingest→train→swap→serve consumer (its
+    tracer stamps the ingest/partial_fit/swap/flush hops). No context
+    ever crosses the boundary except through the WAL offsets
+    themselves — the deterministic-trace-id design the pod assembler
+    joins on. Process 1 publishes the sampled record id
+    (``sample.json``); ``_fleet_pass`` later resolves it against the
+    ``/podtracez`` merge and prints the ``POD TRACE OK`` marker."""
+    import json as _json
+    import time as _time
+
+    import numpy as np
+
+    from large_scale_recommendation_tpu.streams.log import EventLog
+
+    deadline = _time.monotonic() + timeout_s
+    wal_dir = os.path.join(obs_dir, "wal")
+    wal_done = os.path.join(obs_dir, "wal.done")
+    sample_path = os.path.join(obs_dir, "sample.json")
+    if pid == 0:
+        rng = np.random.default_rng(11)
+        log = EventLog(wal_dir, fsync=False)
+        for _ in range(3):
+            log.append_arrays(0, rng.integers(0, 300, 2000),
+                              rng.integers(0, 150, 2000),
+                              rng.random(2000).astype(np.float32) * 5)
+        end = log.end_offset(0)
+        log.close()
+        _atomic_write(wal_done, str(end))
+        # the consumer's spans must exist before the pod-trace fetch in
+        # _fleet_pass — wait for its sampled-record marker
+        _wait_for(sample_path, deadline)
+        print(f"[p{pid}] trace pass: produced {end} records", flush=True)
+        return
+    if pid != 1:
+        return
+    import jax
+
+    from large_scale_recommendation_tpu.models.online import (
+        OnlineMF,
+        OnlineMFConfig,
+    )
+    from large_scale_recommendation_tpu.parallel.partitioner import (
+        Partitioner,
+    )
+    from large_scale_recommendation_tpu.streams.driver import (
+        StreamingDriver,
+        StreamingDriverConfig,
+    )
+
+    _wait_for(wal_done, deadline)
+    log = EventLog(wal_dir, fsync=False)
+    model = OnlineMF(OnlineMFConfig(num_factors=8, minibatch_size=256))
+    driver = StreamingDriver(
+        model, log, os.path.join(obs_dir, "trace_ckpt"),
+        config=StreamingDriverConfig(batch_records=1024,
+                                     checkpoint_every=8))
+    # the engine must NOT span the process-global mesh: this consumer
+    # serves alone, and a default (global) partitioner would turn its
+    # catalog shard into a collective the producer never joins — pin it
+    # to ONE local device
+    engine = driver.serving_engine(
+        k=5, max_batch=64,
+        mesh=Partitioner(devices=jax.local_devices()[:1]))
+    driver.run()                      # catch up on the foreign appends
+    driver.refresh_serving()          # the covering servable swap
+    engine.recommend(np.arange(8, dtype=np.int64))  # first serve
+    log.close()
+    sampled = int(driver.consumed_offset) - 1
+    _atomic_write(sample_path,
+                  _json.dumps({"partition": 0, "offset": sampled}))
+    print(f"[p{pid}] trace pass: consumed through offset {sampled}",
+          flush=True)
 
 
 def _fleet_pass(obs_dir: str, pid: int, nproc: int,
@@ -267,9 +367,7 @@ def _fleet_pass(obs_dir: str, pid: int, nproc: int,
 
     server = ObsServer().start()
     own = os.path.join(obs_dir, f"proc{pid}.url")
-    with open(own + ".tmp", "w") as f:
-        f.write(server.url)
-    os.replace(own + ".tmp", own)  # atomic: readers never see a torn URL
+    _atomic_write(own, server.url)  # readers never see a torn URL
     done_marker = os.path.join(obs_dir, "fleet.done")
     deadline = _time.monotonic() + timeout_s
     try:
@@ -303,13 +401,48 @@ def _fleet_pass(obs_dir: str, pid: int, nproc: int,
             assert report["reachable"] == nproc, report
             print(f"POD FLEET OK hosts={len(hosts)} "
                   f"samples={len(samples)} url={fleet.url}", flush=True)
+            _pod_trace_pass(fleet.url, obs_dir)
         finally:
             fleet.stop()
-            with open(done_marker + ".tmp", "w") as f:
-                f.write("done")
-            os.replace(done_marker + ".tmp", done_marker)
+            _atomic_write(done_marker, "done")
     finally:
         server.stop()
+
+
+def _pod_trace_pass(fleet_url: str, obs_dir: str) -> None:
+    """Fetch the ``/podtracez`` merge over a real socket, validate it
+    as a Chrome trace, resolve the sampled record's id to ONE assembled
+    distributed trace spanning WAL append → ingest batch → partial_fit
+    → catalog swap → first servable flush ACROSS the process boundary
+    (≥ 2 source pids on the chain), persist ``pod_trace.json``
+    (Perfetto-loadable — the CI artifact), and print the
+    ``POD TRACE OK`` marker ``scripts/pod_dryrun.py`` keys on."""
+    import json as _json
+
+    from large_scale_recommendation_tpu.obs.disttrace import (
+        resolve_record_trace,
+    )
+    from large_scale_recommendation_tpu.obs.server import http_get
+    from large_scale_recommendation_tpu.obs.trace import (
+        validate_chrome_trace,
+    )
+
+    code, body = http_get(fleet_url + "/podtracez")
+    assert code == 200, (code, body[:300])
+    doc = _json.loads(body)
+    validate_chrome_trace(doc)  # the merge is a well-formed trace
+    with open(os.path.join(obs_dir, "sample.json")) as f:
+        sample = _json.load(f)
+    chain = resolve_record_trace(doc, sample["partition"],
+                                 sample["offset"])
+    assert chain["complete"], chain
+    assert len(chain["processes"]) >= 2, chain  # crossed the boundary
+    with open(os.path.join(obs_dir, "pod_trace.json"), "w") as f:
+        _json.dump(doc, f)
+    print(f"POD TRACE OK record={chain['trace_id']} "
+          f"hops={len(chain['hops'])} "
+          f"processes={len(chain['processes'])} "
+          f"events={len(doc['traceEvents'])}", flush=True)
 
 
 if __name__ == "__main__":

@@ -56,6 +56,7 @@ from large_scale_recommendation_tpu.models.online import (
     OnlineMF,
     OnlineMFConfig,
 )
+from large_scale_recommendation_tpu.obs.disttrace import get_disttrace
 from large_scale_recommendation_tpu.obs.events import get_events
 from large_scale_recommendation_tpu.obs.lineage import get_lineage
 from large_scale_recommendation_tpu.obs.registry import get_registry
@@ -129,6 +130,10 @@ class AdaptiveMF:
         # retrain-swap provenance stamp in _install is one `is not
         # None` test on the (cold) swap path
         self._lineage = get_lineage()
+        # critical-path analyzer (obs.disttrace): retrain swaps mark
+        # the servable instant per partition — one `is not None` test
+        # on the same cold swap path
+        self._disttrace = get_disttrace()
         self._m_retrains = obs.counter("adaptive_retrains_total")
         self._m_retrain_s = obs.histogram("adaptive_retrain_s")
         self._manager = None
@@ -242,8 +247,16 @@ class AdaptiveMF:
         if self.config.background:
             self._state = "Batch"
             self._retrained = None
+            # capture the ENCLOSING trace context before the thread
+            # hop: the retrain span re-enters it on the retrain thread
+            # and so parents back to the triggering batch's span (and
+            # carries its trace id) in the exported trace — without
+            # this the retrain lane's spans parent to nothing
+            ctx = (self._trace.capture_context()
+                   if self._trace.enabled else None)
             self._thread = threading.Thread(
-                target=self._retrain_into_slot, args=(history,), daemon=True
+                target=self._retrain_into_slot, args=(history, ctx),
+                daemon=True
             )
             self._thread.start()
         else:
@@ -312,8 +325,15 @@ class AdaptiveMF:
                 self._m_retrains.inc()
         return model
 
-    def _retrain_into_slot(self, history: Ratings) -> None:
-        self._retrained = self._retrain(history)
+    def _retrain_into_slot(self, history: Ratings, ctx=None) -> None:
+        if ctx is not None:
+            # re-enter the captured context on the retrain thread: the
+            # retrain span (top-level on this thread's stack) exports
+            # parent_span_id = the triggering batch's span
+            with self._trace.activate(ctx):
+                self._retrained = self._retrain(history)
+        else:
+            self._retrained = self._retrain(history)
 
     def _finish_batch(self) -> BatchUpdates:
         """Swap the retrained model in and replay the buffered queue."""
@@ -379,7 +399,9 @@ class AdaptiveMF:
         snapshot = self.to_model() if engines else None
         for engine in engines:
             engine.refresh(snapshot)
-        if self._lineage is not None and engines:
+        if engines and (self._lineage is not None
+                        or self._disttrace is not None
+                        or self._trace.enabled):
             # enrich each engine's fresh stamp (engine.refresh recorded
             # the swap instant) with what only the retrain layer knows:
             # WHICH retrain produced this build, the online step it
@@ -390,16 +412,33 @@ class AdaptiveMF:
             # a background retrain the stamps are frozen at the
             # pre-retrain offsets, which is exactly what this build's
             # history covers (buffered batches replay AFTER the swap
-            # and ship with the next refresh)
+            # and ship with the next refresh). The critical-path mark
+            # re-uses the lineage record's wall_time (the swap instant)
+            # and the trace instant carries the version↔watermark join.
             offsets = dict(self.online.consumed_offsets) or {0: None}
             for engine in engines:
                 for p, off in offsets.items():
-                    self._lineage.record_swap(
-                        engine.version,
-                        retrain_id=self.retrain_count + 1,
-                        train_step=int(self.online.step),
-                        wal_offset_watermark=off, partition=p,
-                        source="retrain_install")
+                    t_swap = None
+                    if self._lineage is not None:
+                        rec = self._lineage.record_swap(
+                            engine.version,
+                            retrain_id=self.retrain_count + 1,
+                            train_step=int(self.online.step),
+                            wal_offset_watermark=off, partition=p,
+                            source="retrain_install")
+                        t_swap = rec["wall_time"]
+                    if off is None:
+                        continue
+                    if self._disttrace is not None:
+                        self._disttrace.note_swap(
+                            engine.version, partition=p,
+                            watermark=off, t=t_swap)
+                    if self._trace.enabled:
+                        self._trace.instant(
+                            "lineage/swap_watermark",
+                            version=int(engine.version), partition=int(p),
+                            watermark=int(off),
+                            source="retrain_install")
         if self._events is not None:
             self._events.emit("adaptive.retrain_install",
                               retrain_count=self.retrain_count + 1,

@@ -47,6 +47,19 @@ package is that instrumentation layer, shared by every runtime tier:
   ``RecResult.catalog_version`` into staleness/freshness telemetry and
   an ingest→serve ``FreshnessCheck`` SLO (``/lineagez``).
 
+- ``obs.disttrace`` — the CAUSAL plane: deterministic cross-process
+  trace identity (``record_trace_id`` — WAL offsets are the
+  propagation tokens; ``TraceContext`` carries trace id + parent span
+  across thread/process boundaries), pod trace assembly
+  (``assemble_pod_trace`` merges per-process Chrome exports into one
+  Perfetto-loadable timeline — ``/podtracez`` on the ``FleetServer``;
+  ``resolve_record_trace`` resolves one record id to its WAL append →
+  ingest → partial_fit → swap → flush chain), and a
+  ``CriticalPathAnalyzer`` decomposing each sampled record's
+  ingest→servable wall into ``critical_path_s{stage}`` gauges that
+  reconcile against the lineage freshness histogram
+  (``/criticalpathz``).
+
 Zero-cost when disabled — the design invariant every instrumented hot
 path relies on: the module-level defaults are a ``NullRegistry`` and
 ``NullTracer`` whose instruments are shared stateless singletons (no
@@ -78,6 +91,14 @@ from large_scale_recommendation_tpu.obs.anomaly import (
 )
 from large_scale_recommendation_tpu.obs.dataquality import (
     DataQualityInspector,
+)
+from large_scale_recommendation_tpu.obs.disttrace import (
+    CriticalPathAnalyzer,
+    assemble_pod_trace,
+    get_disttrace,
+    record_trace_id,
+    resolve_record_trace,
+    set_disttrace,
 )
 from large_scale_recommendation_tpu.obs.events import (
     EventJournal,
@@ -137,8 +158,10 @@ from large_scale_recommendation_tpu.obs.registry import (
 from large_scale_recommendation_tpu.obs.server import ObsServer
 from large_scale_recommendation_tpu.obs.trace import (
     NullTracer,
+    TraceContext,
     Tracer,
     get_tracer,
+    process_namespace,
     set_tracer,
     validate_chrome_trace,
 )
@@ -196,6 +219,15 @@ __all__ = [
     "get_lineage",
     "set_lineage",
     "enable_lineage",
+    "TraceContext",
+    "process_namespace",
+    "CriticalPathAnalyzer",
+    "assemble_pod_trace",
+    "resolve_record_trace",
+    "record_trace_id",
+    "get_disttrace",
+    "set_disttrace",
+    "enable_disttrace",
     "ObsServer",
     "OK",
     "DEGRADED",
@@ -280,6 +312,21 @@ def enable_lineage(capacity: int = 1024,
     return journal
 
 
+def enable_disttrace(capacity: int = 256,
+                     marks: int = 1024) -> CriticalPathAnalyzer:
+    """Install a ``CriticalPathAnalyzer`` as the module-level default —
+    the ingest→servable critical-path layer the WAL, driver, adaptive
+    and engine tiers stamp. Call AFTER ``enable()`` (the analyzer binds
+    the live registry for its ``critical_path_s{stage}`` gauges) and
+    BEFORE building the logs/drivers/engines whose path you want
+    attributed — hooks bind at construction, same as the instruments.
+    Returns the analyzer (served at ``/criticalpathz`` by any
+    subsequently built ``ObsServer``)."""
+    analyzer = CriticalPathAnalyzer(capacity=capacity, marks=marks)
+    set_disttrace(analyzer)
+    return analyzer
+
+
 def disable() -> None:
     """Restore the zero-cost defaults: null registry/tracer, no flight
     recorder, event journal or lineage journal, and no introspector
@@ -298,6 +345,7 @@ def disable() -> None:
     set_recorder(None)
     set_events(None)
     set_lineage(None)
+    set_disttrace(None)
     set_registry(_r.NULL_REGISTRY)
     set_tracer(_t.NULL_TRACER)
 

@@ -7,7 +7,10 @@ checkpoint commits, retrain start/install/abort, watchdog findings,
 dead-letter quarantines, WAL segment rolls, health transitions. Each
 event carries:
 
-- ``time`` (epoch seconds) and a process-monotonic ``seq``
+- ``time`` (epoch seconds), a process-monotonic ``seq``, and a
+  globally-unique ``id`` — the seq NAMESPACED by ``(host, pid)``
+  (``obs.trace.process_namespace``), so event tails merged across a pod
+  stay joinable with zero id collisions
 - ``kind`` — dotted taxonomy name (``serving.catalog_swap``,
   ``stream.checkpoint``, ``watchdog.trip``, ... — the catalog lives in
   docs/OBSERVABILITY.md)
@@ -38,7 +41,10 @@ import time
 from collections import deque
 
 from large_scale_recommendation_tpu.obs.registry import get_registry
-from large_scale_recommendation_tpu.obs.trace import get_tracer
+from large_scale_recommendation_tpu.obs.trace import (
+    get_tracer,
+    process_namespace,
+)
 
 DEBUG = "debug"
 INFO = "info"
@@ -113,6 +119,10 @@ class EventJournal:
         with self._lock:
             self._seq += 1
             ev["seq"] = self._seq
+            # globally-unique record id: the seq namespaced by
+            # (host, pid), same discipline as Span.id — pod-merged
+            # event tails join with zero collisions
+            ev["id"] = f"{process_namespace()}:{self._seq}"
             self._ring.append(ev)
             self.total += 1
         self._m_events[severity].inc()

@@ -17,9 +17,13 @@ aggregation layer ALX-style pod operation needs:
 - ``FleetServer`` — the pod endpoint: ``/metrics`` (merged text),
   ``/healthz`` (pod aggregate, 503 iff CRITICAL — the same contract as
   the per-process route, so a load balancer probes the pod exactly
-  like a process), ``/fleetz`` (full per-target JSON). Scrapes run per
-  request (pull model), same zero-cost-when-idle discipline as
-  ``obs.server``.
+  like a process), ``/fleetz`` (full per-target JSON), ``/podtracez``
+  (every process's ``/tracez`` tail assembled into ONE
+  Perfetto-loadable pod timeline via
+  ``obs.disttrace.assemble_pod_trace`` — synthetic pids +
+  ``process_name`` metadata, span ids already (host, pid)-namespaced).
+  Scrapes run per request (pull model), same zero-cost-when-idle
+  discipline as ``obs.server``.
 - ``parse_prometheus`` — a strict text-exposition parser, the
   "aggregated pod /metrics parses" assertion in
   ``scripts/pod_dryrun.py``'s 2-process pass and the fleet tests.
@@ -48,6 +52,7 @@ from large_scale_recommendation_tpu.obs.server import (
     PROM_CTYPE,
     EndpointServerBase,
     http_get,
+    parse_query_int,
 )
 
 _SAMPLE_RE = re.compile(
@@ -217,6 +222,41 @@ class FleetAggregator:
             out["prometheus"] = merge_prometheus(bodies)
         return out
 
+    def pod_trace(self, limit: int = 8192) -> dict:
+        """Scrape every target's ``/tracez`` tail (``limit`` events
+        each; 0 = each process's whole buffer) and assemble ONE
+        Perfetto-loadable pod timeline
+        (``obs.disttrace.assemble_pod_trace``): per-target events are
+        re-homed onto synthetic pids with a ``process_name`` metadata
+        row carrying the host label, so colliding OS pids/tids across
+        processes can never corrupt the merge, while the (host, pid)-
+        namespaced span/event ids keep every args-level join intact.
+        Unreachable or unparseable targets are skipped and listed under
+        ``unreachable`` — a partial pod timeline beats none when one
+        member is wedged."""
+        from large_scale_recommendation_tpu.obs.disttrace import (
+            assemble_pod_trace,
+        )
+
+        sources: list[tuple[str, dict]] = []
+        skipped: list[str] = []
+        for url in self.targets:
+            host = _host_of(url)
+            code, body = http_get(f"{url}/tracez?limit={int(limit)}",
+                                  timeout=self.timeout_s)
+            if code != 200:
+                skipped.append(host)
+                continue
+            try:
+                doc = json.loads(body)
+            except json.JSONDecodeError:
+                skipped.append(host)
+                continue
+            sources.append((host, {"traceEvents": doc.get("recent", [])}))
+        out = assemble_pod_trace(sources)
+        out["unreachable"] = skipped
+        return out
+
     def healthz(self) -> tuple[int, dict]:
         """(http_status, pod report) — 503 iff the pod aggregate is
         CRITICAL (including any unreachable member), the same contract
@@ -241,9 +281,11 @@ class FleetServer(EndpointServerBase):
     """The pod endpoint over one ``FleetAggregator``: ``/metrics``
     (merged Prometheus text), ``/healthz`` (pod aggregate JSON, 503 on
     CRITICAL — ``/healthz``-only scrape), ``/fleetz`` (full per-target
-    view). Rides ``obs.server.EndpointServerBase`` — the SAME
-    lifecycle/handler plumbing as the per-process ``ObsServer``, so the
-    HTTP semantics cannot drift between the two."""
+    view), ``/podtracez`` (the assembled pod timeline — load it at
+    https://ui.perfetto.dev). Rides ``obs.server.EndpointServerBase``
+    — the SAME lifecycle/handler plumbing as the per-process
+    ``ObsServer``, so the HTTP semantics cannot drift between the
+    two."""
 
     thread_prefix = "fleet-server"
 
@@ -262,7 +304,14 @@ class FleetServer(EndpointServerBase):
             return self.aggregator.healthz()
         if path == "/fleetz":
             return 200, self.aggregator.scrape()
+        if path == "/podtracez":
+            limit, err = parse_query_int(query, "limit")
+            if err is not None:
+                return 400, {"error": err}
+            return 200, self.aggregator.pod_trace(
+                limit=8192 if limit is None else limit)
         if path == "/":
-            return 200, {"routes": ["/metrics", "/healthz", "/fleetz"],
+            return 200, {"routes": ["/metrics", "/healthz", "/fleetz",
+                                    "/podtracez"],
                          "targets": self.aggregator.targets}
         return None

@@ -25,6 +25,11 @@ minimal, can expose its live state to a scraper or a ``curl``:
   per-version swap provenance ``{catalog_version,
   wal_offset_watermark, train_step, retrain_id, wall_time}`` plus the
   ingest→serve freshness summary the staleness SLO verdicts on.
+- ``/criticalpathz`` — the ingest→servable critical path
+  (``obs.disttrace.CriticalPathAnalyzer``): per-stage attribution
+  (queue wait / train apply / swap lag / flush wait) plus the newest
+  completed samples (``scripts/obs_report.py --critical-path``
+  renders it).
 - ``/profilez``  — on-demand ``jax.profiler`` capture:
   ``GET /profilez?seconds=N`` records N seconds (capped, default 1)
   of the whole process into an artifact directory (``profile_dir`` or
@@ -59,6 +64,7 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from large_scale_recommendation_tpu.obs.disttrace import get_disttrace
 from large_scale_recommendation_tpu.obs.events import get_events
 from large_scale_recommendation_tpu.obs.health import CRITICAL
 from large_scale_recommendation_tpu.obs.introspect import get_introspector
@@ -95,6 +101,27 @@ def http_get(url: str, timeout: float = 10.0) -> tuple[int, str]:
         return e.code, e.read().decode()
     except (urllib.error.URLError, OSError) as e:
         return 599, repr(e)
+
+
+def parse_query_int(query: str, name: str):
+    """``(value, error)`` for one ``?name=N`` integer query param —
+    the ONE copy of the 400-on-junk contract every endpoint route
+    shares (``/tracez?limit=``, the fleet ``/podtracez?limit=``).
+    Absent → ``(None, None)``; non-integer OR negative → ``(None,
+    message)`` (a negative limit is a client error, not a request for
+    the whole 200k-event buffer)."""
+    from urllib.parse import parse_qs
+
+    raw = parse_qs(query).get(name, [None])[0]
+    if raw is None:
+        return None, None
+    try:
+        value = int(raw)
+    except ValueError:
+        return None, f"bad {name} param {raw!r}"
+    if value < 0:
+        return None, f"bad {name} param {raw!r} (must be >= 0)"
+    return value, None
 
 
 class _HandlerBase(BaseHTTPRequestHandler):
@@ -219,7 +246,8 @@ class ObsServer(EndpointServerBase):
 
     def __init__(self, registry=None, tracer=None, monitor=None,
                  recorder=None, events=None, introspector=None,
-                 lineage=None, host: str = "127.0.0.1", port: int = 0,
+                 lineage=None, disttrace=None,
+                 host: str = "127.0.0.1", port: int = 0,
                  tracez_limit: int = DEFAULT_TRACEZ_LIMIT,
                  eventz_limit: int = DEFAULT_EVENTZ_LIMIT,
                  profile_dir: str | None = None):
@@ -234,6 +262,8 @@ class ObsServer(EndpointServerBase):
         self.introspector = (introspector if introspector is not None
                              else get_introspector())
         self.lineage = lineage if lineage is not None else get_lineage()
+        self.disttrace = (disttrace if disttrace is not None
+                          else get_disttrace())
         self.profile_dir = profile_dir
         self.eventz_limit = int(eventz_limit)
         self.tracez_limit = int(tracez_limit)
@@ -248,7 +278,10 @@ class ObsServer(EndpointServerBase):
         if path == "/varz":
             return 200, self.registry.snapshot()
         if path == "/tracez":
-            return 200, self.tracez()
+            limit, err = parse_query_int(query, "limit")
+            if err is not None:  # client error, not a server failure
+                return 400, {"error": err}
+            return 200, self.tracez(limit)
         if path == "/seriesz":
             return 200, self.seriesz()
         if path == "/eventz":
@@ -257,6 +290,8 @@ class ObsServer(EndpointServerBase):
             return 200, self.rooflinez()
         if path == "/lineagez":
             return 200, self.lineagez()
+        if path == "/criticalpathz":
+            return 200, self.criticalpathz()
         if path == "/profilez":
             from urllib.parse import parse_qs
 
@@ -270,7 +305,7 @@ class ObsServer(EndpointServerBase):
             return 200, {"routes": ["/metrics", "/healthz", "/varz",
                                     "/tracez", "/seriesz", "/eventz",
                                     "/rooflinez", "/lineagez",
-                                    "/profilez"]}
+                                    "/criticalpathz", "/profilez"]}
         return None
 
     # -- route bodies (shared with tests / in-process callers) --------------
@@ -285,9 +320,14 @@ class ObsServer(EndpointServerBase):
         code = 503 if report.get("status") == CRITICAL else 200
         return code, report
 
-    def tracez(self) -> dict:
+    def tracez(self, limit: int | None = None) -> dict:
+        """``limit`` overrides the construction-time tail bound
+        (``?limit=N``; 0 = the whole buffer) — the pod trace assembler
+        (``FleetAggregator.pod_trace``) asks for a deep tail so the
+        merged timeline isn't missing the early WAL/ingest spans."""
         events = self.tracer.events()
-        return {"recent": events[-self.tracez_limit:],
+        n = self.tracez_limit if limit is None else max(0, int(limit))
+        return {"recent": events[-n:] if n else list(events),
                 "total_buffered": len(events),
                 "dropped": self.tracer.dropped}
 
@@ -312,6 +352,13 @@ class ObsServer(EndpointServerBase):
             return {"note": "no lineage journal installed "
                             "(obs.enable_lineage())", "records": []}
         return self.lineage.snapshot()
+
+    def criticalpathz(self) -> dict:
+        if self.disttrace is None:
+            return {"note": "no critical-path analyzer installed "
+                            "(obs.enable_disttrace())", "samples": [],
+                    "stages": {}}
+        return self.disttrace.snapshot()
 
     def profilez(self, seconds: float | None = None) -> tuple[int, dict]:
         """(http_status, body) for ``/profilez``: run one N-second

@@ -24,6 +24,15 @@ as Chrome trace-event *complete* events (``"ph": "X"``, microsecond
 ``chrome://tracing``. ``validate_chrome_trace`` is the schema contract
 the golden test pins.
 
+Distributed tracing (``obs.disttrace`` builds on these primitives):
+
+- span ids are NAMESPACED by ``(host, pid)`` (``process_namespace()``),
+  so per-process exports merged into one pod timeline can never collide;
+- ``TraceContext`` is the explicit causal token carried across thread
+  and process boundaries (``capture_context``/``activate``); exported
+  events carry ``trace_id``/``parent_span_id`` in their args, so causal
+  chains reconstruct from the artifacts alone.
+
 ``NullTracer`` is the zero-cost disabled twin: ``span()`` returns one
 shared stateless no-op context manager.
 """
@@ -33,6 +42,7 @@ from __future__ import annotations
 import itertools
 import json
 import os
+import socket
 import threading
 import time
 from typing import Any
@@ -47,11 +57,103 @@ DEFAULT_MAX_EVENTS = 200_000
 # the introspector's record table
 DEFAULT_MAX_KEY_WALLS = 4096
 
-# span ids are PROCESS-unique (module-level, not per-tracer): an
-# enable()/disable()/enable() cycle must not restart the sequence, or a
-# journal/bundle spanning both cycles would join events against the
-# wrong spans. 0/None means "no span"; next() is atomic under the GIL.
+# span sequence numbers are PROCESS-unique (module-level, not
+# per-tracer): an enable()/disable()/enable() cycle must not restart the
+# sequence, or a journal/bundle spanning both cycles would join events
+# against the wrong spans. None means "no span"; next() is atomic under
+# the GIL. The full span id is the sequence NAMESPACED by (host, pid) —
+# ``process_namespace()`` — so artifacts merged across a pod
+# (``obs.disttrace.assemble_pod_trace``) can never collide.
 _SPAN_IDS = itertools.count(1)
+
+_NS_PID: int | None = None
+_NS: str = ""
+
+
+def process_namespace() -> str:
+    """``"<host>-<pid>"`` — the namespace every exported span id and
+    event-journal record id carries, so artifacts from different
+    processes (or hosts) stay joinable after a pod merge with zero
+    collisions. Re-derived when the pid changes (a fork after import
+    must not inherit the parent's namespace)."""
+    global _NS_PID, _NS
+    pid = os.getpid()
+    if pid != _NS_PID:
+        _NS = f"{socket.gethostname()}-{pid}"
+        _NS_PID = pid
+    return _NS
+
+
+def span_seq(span_id: str) -> int:
+    """The process-monotonic sequence part of a namespaced span id —
+    ordering WITHIN one process (cross-process ids are not ordered)."""
+    return int(str(span_id).rsplit(":", 1)[1])
+
+
+class TraceContext:
+    """Explicit causal context carried across thread and process
+    boundaries — the Dapper-style propagation token the data path
+    threads through WAL batches and retrain threads:
+
+    - ``trace_id`` names the TRACE the work belongs to. For stream data
+      it is derived deterministically from the record's durable identity
+      (``obs.disttrace.record_trace_id``): every process computes the
+      same id from (partition, offset) with no side channel — the WAL
+      offsets ARE the causal tokens that cross the process boundary.
+    - ``parent_span_id`` is the (namespaced) span to parent the next
+      TOP-LEVEL span under when the context is re-entered on another
+      thread (``Tracer.activate``) — how a background retrain's span
+      resolves to the batch span that triggered it.
+
+    Capture with ``Tracer.capture_context()``, re-enter with
+    ``Tracer.activate(ctx)``. While active, every span the thread opens
+    exports the context's ``trace_id`` in its args."""
+
+    __slots__ = ("trace_id", "parent_span_id")
+
+    def __init__(self, trace_id: str | None = None,
+                 parent_span_id: str | None = None):
+        self.trace_id = trace_id
+        self.parent_span_id = parent_span_id
+
+    def __repr__(self) -> str:  # artifacts/debugging
+        return (f"TraceContext(trace_id={self.trace_id!r}, "
+                f"parent_span_id={self.parent_span_id!r})")
+
+
+class _CtxScope:
+    """Context manager returned by ``Tracer.activate``: pushes one
+    ``TraceContext`` onto the calling thread's context stack."""
+
+    __slots__ = ("_tracer", "_ctx")
+
+    def __init__(self, tracer: "Tracer", ctx: TraceContext):
+        self._tracer = tracer
+        self._ctx = ctx
+
+    def __enter__(self) -> TraceContext:
+        self._tracer._ctxs().append(self._ctx)
+        return self._ctx
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        stack = self._tracer._ctxs()
+        if stack and stack[-1] is self._ctx:
+            stack.pop()
+
+
+class _NullScope:
+    """Shared no-op scope for ``activate(None)`` and the null tracer."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, exc_type, exc, tb):
+        pass
+
+
+NULL_SCOPE = _NullScope()
 
 
 def _block(x: Any) -> None:
@@ -69,18 +171,23 @@ def _block(x: Any) -> None:
 class Span:
     """One open span. Set ``out`` to the computation's result (array or
     pytree) to have the tracer sync on it before the clock stops; add
-    display attributes via ``args``. ``id`` is process-unique and lands
-    in the exported event's args — the correlation token
-    ``obs.events.EventJournal`` stamps onto events emitted while this
-    span is open. ``key`` is the compile key (or None): while the span
-    is open, ``obs.introspect`` attributes any XLA compile that fires
-    to it, which is how executables join the span family."""
+    display attributes via ``args``. ``id`` is a NAMESPACED
+    ``"<host>-<pid>:<seq>"`` string — globally unique, so pod-merged
+    artifacts can never collide — and lands in the exported event's
+    args: the correlation token ``obs.events.EventJournal`` stamps onto
+    events emitted while this span is open. ``key`` is the compile key
+    (or None): while the span is open, ``obs.introspect`` attributes
+    any XLA compile that fires to it, which is how executables join the
+    span family. The exported args additionally carry
+    ``parent_span_id`` (the enclosing span on this thread, or the
+    active ``TraceContext``'s parent for a top-level span — the
+    cross-thread causal link) and ``trace_id`` (the active context's)."""
 
     __slots__ = ("name", "cat", "t0", "args", "out", "id", "key",
-                 "_tracer")
+                 "parent_id", "trace_id", "_tracer")
 
     def __init__(self, tracer: "Tracer", name: str, cat: str, args: dict,
-                 span_id: int, key: Any = None):
+                 span_id: str, key: Any = None):
         self._tracer = tracer
         self.name = name
         self.cat = cat
@@ -88,10 +195,22 @@ class Span:
         self.out = None
         self.id = span_id
         self.key = key
+        self.parent_id = None
+        self.trace_id = None
         self.t0 = 0.0
 
     def __enter__(self) -> "Span":
         stack = self._tracer._stack()
+        ctx = self._tracer.current_context()
+        if ctx is not None:
+            self.trace_id = ctx.trace_id
+        if stack:
+            self.parent_id = stack[-1].id
+        elif ctx is not None:
+            # top-level span on this thread under an activated context:
+            # parent to the span that captured the context (the retrain
+            # lane's link back to its triggering batch)
+            self.parent_id = ctx.parent_span_id
         stack.append(self)
         self.t0 = time.perf_counter()
         return self
@@ -116,6 +235,8 @@ class _NullSpan:
     args: dict = {}
     id = None
     key = None
+    parent_id = None
+    trace_id = None
 
     # writes to .out on the shared singleton are dropped (it has no
     # per-instance storage), which is exactly the point
@@ -170,6 +291,42 @@ class Tracer:
             stack = self._local.stack = []
         return stack
 
+    def _ctxs(self) -> list:
+        stack = getattr(self._local, "ctxs", None)
+        if stack is None:
+            stack = self._local.ctxs = []
+        return stack
+
+    # -- cross-thread / cross-process context -------------------------------
+
+    def current_context(self) -> TraceContext | None:
+        """The innermost ``TraceContext`` activated on the calling
+        thread (``activate``), or None."""
+        stack = self._ctxs()
+        return stack[-1] if stack else None
+
+    def capture_context(self) -> TraceContext:
+        """Snapshot the calling thread's causal position: the active
+        context's ``trace_id`` (if any) plus the innermost OPEN span's
+        id as ``parent_span_id``. Hand the result to another thread and
+        ``activate`` it there — its top-level spans then parent back to
+        this thread's span in the exported trace (the retrain-lane
+        link)."""
+        ctx = self.current_context()
+        return TraceContext(
+            trace_id=None if ctx is None else ctx.trace_id,
+            parent_span_id=self.current_span_id())
+
+    def activate(self, ctx: TraceContext | None):
+        """Context manager entering ``ctx`` on the calling thread:
+        spans opened inside export the context's ``trace_id``, and
+        top-level spans parent to its ``parent_span_id``.
+        ``activate(None)`` is a shared no-op — callers pass a batch's
+        (possibly absent) context straight through."""
+        if ctx is None:
+            return NULL_SCOPE
+        return _CtxScope(self, ctx)
+
     # -- span API -----------------------------------------------------------
 
     def span(self, name: str, key: Any = None, **args) -> Span:
@@ -188,18 +345,19 @@ class Tracer:
                 else:
                     self._compile_keys.add(key)
                     cat = "compile"
-        return Span(self, name, cat, args, next(_SPAN_IDS), key)
+        return Span(self, name, cat, args,
+                    f"{process_namespace()}:{next(_SPAN_IDS)}", key)
 
     def depth(self) -> int:
         """Current nesting depth on the calling thread."""
         return len(self._stack())
 
-    def current_span_id(self) -> int | None:
-        """The id of the innermost OPEN span on the calling thread, or
-        ``None`` outside any span — the correlation token the event
-        journal stamps onto events (``span_id`` also lands in every
-        exported trace event's args, so event↔span joins work from the
-        artifacts alone)."""
+    def current_span_id(self) -> str | None:
+        """The (namespaced) id of the innermost OPEN span on the
+        calling thread, or ``None`` outside any span — the correlation
+        token the event journal stamps onto events (``span_id`` also
+        lands in every exported trace event's args, so event↔span joins
+        work from the artifacts alone, including pod-merged ones)."""
         stack = self._stack()
         return stack[-1].id if stack else None
 
@@ -258,6 +416,11 @@ class Tracer:
             if len(self._events) >= self.max_events:
                 self.dropped += 1
                 return
+            args = dict(span.args, span_id=span.id)
+            if span.parent_id is not None:
+                args["parent_span_id"] = span.parent_id
+            if span.trace_id is not None:
+                args["trace_id"] = span.trace_id
             self._events.append({
                 "name": span.name,
                 "cat": span.cat,
@@ -266,7 +429,7 @@ class Tracer:
                 "dur": (t1 - span.t0) * 1e6,
                 "pid": os.getpid(),
                 "tid": threading.get_ident(),
-                "args": dict(span.args, span_id=span.id),
+                "args": args,
             })
 
     def instant(self, name: str, **args) -> None:
@@ -275,10 +438,14 @@ class Tracer:
         span's id (or None), same correlation contract as complete
         events."""
         span_id = self.current_span_id()
+        ctx = self.current_context()
         with self._lock:
             if len(self._events) >= self.max_events:
                 self.dropped += 1
                 return
+            full_args = dict(args, span_id=span_id)
+            if ctx is not None and ctx.trace_id is not None:
+                full_args.setdefault("trace_id", ctx.trace_id)
             self._events.append({
                 "name": name,
                 "cat": "instant",
@@ -287,7 +454,7 @@ class Tracer:
                 "ts": (time.perf_counter() + self._origin) * 1e6,
                 "pid": os.getpid(),
                 "tid": threading.get_ident(),
-                "args": dict(args, span_id=span_id),
+                "args": full_args,
             })
 
     # -- JAX compile hook ----------------------------------------------------
@@ -365,8 +532,20 @@ class NullTracer(Tracer):
     def depth(self) -> int:
         return 0
 
-    def current_span_id(self) -> int | None:
+    def current_span_id(self) -> str | None:
         return None
+
+    def current_context(self) -> TraceContext | None:
+        return None
+
+    def capture_context(self) -> TraceContext | None:
+        # None, not an empty context: callers gate their activate()/
+        # thread handoff on one `is not None` test — no allocation on
+        # the disabled path
+        return None
+
+    def activate(self, ctx):
+        return NULL_SCOPE
 
     def current_compile_key(self) -> Any:
         return None
@@ -406,9 +585,14 @@ def validate_chrome_trace(doc: dict) -> list[dict]:
     - every complete event: string ``name``/``cat``, ``ph == "X"``,
       numeric ``ts``, non-negative ``dur``, int ``pid``/``tid``,
       dict ``args``
-    - events on one thread NEST: two complete events on the same tid
-      either don't overlap in time or one contains the other — partial
-      overlap means the span stack was corrupted
+    - metadata events (``ph == "M"``, e.g. the ``process_name`` rows a
+      pod merge injects) need only a string ``name`` and an int ``pid``
+    - events on one thread NEST: two complete events on the same
+      (pid, tid) either don't overlap in time or one contains the
+      other — partial overlap means the span stack was corrupted. The
+      group key is (pid, tid), not tid alone: a pod-merged trace
+      legitimately holds different processes' threads with colliding
+      OS thread ids.
 
     Returns the complete events; raises ``ValueError`` on violation."""
     if not isinstance(doc, dict) or "traceEvents" not in doc:
@@ -421,22 +605,25 @@ def validate_chrome_trace(doc: dict) -> list[dict]:
         if not isinstance(e, dict) or not isinstance(e.get("name"), str):
             raise ValueError(f"bad event (name): {e!r}")
         ph = e.get("ph")
-        if ph not in ("X", "i"):
+        if ph not in ("X", "i", "M"):
             raise ValueError(f"unexpected phase {ph!r} in {e.get('name')!r}")
+        if not isinstance(e.get("pid"), int):
+            raise ValueError(f"bad pid in {e['name']!r}")
+        if ph == "M":  # metadata: no timing fields
+            continue
         if not isinstance(e.get("ts"), (int, float)):
             raise ValueError(f"bad ts in {e['name']!r}")
-        if not isinstance(e.get("pid"), int) or not isinstance(
-                e.get("tid"), int):
-            raise ValueError(f"bad pid/tid in {e['name']!r}")
+        if not isinstance(e.get("tid"), int):
+            raise ValueError(f"bad tid in {e['name']!r}")
         if ph == "X":
             if not isinstance(e.get("dur"), (int, float)) or e["dur"] < 0:
                 raise ValueError(f"bad dur in {e['name']!r}")
             if not isinstance(e.get("args"), dict):
                 raise ValueError(f"bad args in {e['name']!r}")
             complete.append(e)
-    by_tid: dict[int, list[dict]] = {}
+    by_tid: dict[tuple[int, int], list[dict]] = {}
     for e in complete:
-        by_tid.setdefault(e["tid"], []).append(e)
+        by_tid.setdefault((e["pid"], e["tid"]), []).append(e)
     for tid, evs in by_tid.items():
         evs = sorted(evs, key=lambda e: (e["ts"], -e["dur"]))
         open_stack: list[tuple[float, str]] = []

@@ -68,6 +68,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from large_scale_recommendation_tpu.models.mf import MFModel, _assemble_topk
+from large_scale_recommendation_tpu.obs.disttrace import get_disttrace
 from large_scale_recommendation_tpu.obs.events import get_events
 from large_scale_recommendation_tpu.obs.lineage import get_lineage
 from large_scale_recommendation_tpu.obs.registry import get_registry
@@ -204,6 +205,11 @@ class ServingEngine:
         # per swap/flush. Bound BEFORE the constructor's refresh() so
         # the initial catalog build is stamped too.
         self._lineage = get_lineage()
+        # critical-path analyzer (obs.disttrace): every flush notes the
+        # served version (the first one prices the flush_wait stage) —
+        # one `is not None` test per flush, and the analyzer side is
+        # non-blocking, same rule as the lineage join below
+        self._disttrace = get_disttrace()
         self._m_qwait = obs.histogram("serving_queue_wait_s")
         self._m_assembly = obs.histogram("serving_batch_assembly_s")
         self._m_flush = obs.histogram("serving_flush_s")
@@ -570,14 +576,18 @@ class ServingEngine:
                 self._m_assembly.observe(time.perf_counter() - t0)
             if self._trace.enabled:
                 # compile-keyed: the first flush at a fresh catalog
-                # geometry carries the bucket family's XLA compiles
+                # geometry carries the bucket family's XLA compiles.
+                # catalog_version in the args is the serve-side join of
+                # the assembled record trace: swap watermark → version
+                # → the flush that made the record's trace servable.
                 geom = (self._catalog.rows_per_shard
                         if self._catalog is not None
                         else self._retriever.n_rows)
                 with self._trace.span(
                         "serving/flush",
                         key=("serving_flush", geom),
-                        rows=len(rows_all), requests=len(requests)):
+                        rows=len(rows_all), requests=len(requests),
+                        catalog_version=int(self.version)):
                     top_rows, top_scores = self._serve_rows(
                         rows_all, stage1_only=degraded)
             else:
@@ -631,6 +641,11 @@ class ServingEngine:
             # guarantee keeps a /lineagez scrape or bundle freeze from
             # adding tail latency to the SLO-measured serving path.
             self._lineage.observe_serve(version, requests=len(requests))
+        if self._disttrace is not None:
+            # the flush_wait completion of any critical-path sample
+            # awaiting this build — non-blocking on the analyzer lock,
+            # same rule as observe_serve above
+            self._disttrace.note_serve(version)
         return results
 
     def _serve_rows(self, user_rows: np.ndarray,

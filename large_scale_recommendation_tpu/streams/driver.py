@@ -56,6 +56,7 @@ from typing import Any, Callable
 
 import numpy as np
 
+from large_scale_recommendation_tpu.obs.disttrace import get_disttrace
 from large_scale_recommendation_tpu.obs.events import get_events
 from large_scale_recommendation_tpu.obs.lineage import get_lineage
 from large_scale_recommendation_tpu.obs.registry import get_registry
@@ -132,6 +133,10 @@ class StreamingDriver:
         self.inspector = inspector
         self.evaluator = evaluator
         self._lineage = get_lineage()
+        # critical-path analyzer (obs.disttrace, module default): the
+        # driver marks apply-start/applied/swap instants — one `is not
+        # None` test per site, bounded deque appends when installed
+        self._disttrace = get_disttrace()
         self._adaptive = isinstance(model, AdaptiveMF)
         self._online = model.online if self._adaptive else model
         # ids touched since the last serving refresh — the WAL batches
@@ -313,8 +318,29 @@ class StreamingDriver:
         return applied
 
     def _apply(self, batch: StreamBatch) -> None:
+        if self._trace.enabled:
+            # the batch's TraceContext (minted by the source from the
+            # batch's durable offsets) is ACTIVATED around the apply:
+            # every span opened inside — this ingest span, the nested
+            # online/partial_fit spans, a retrain the batch triggers —
+            # exports the record family's trace id, which is what the
+            # pod assembler joins the cross-process chain on
+            with self._trace.activate(batch.ctx), \
+                    self._trace.span("stream/ingest_batch",
+                                     partition=int(batch.partition),
+                                     start_offset=int(batch.start_offset),
+                                     end_offset=int(batch.end_offset)):
+                self._apply_batch(batch)
+        else:
+            self._apply_batch(batch)
+
+    def _apply_batch(self, batch: StreamBatch) -> None:
         offset = (batch.partition, batch.end_offset)
         ratings = batch.ratings
+        if self._disttrace is not None:
+            # apply START: the queue_wait → train_apply stage boundary
+            self._disttrace.note_dequeue(batch.end_offset,
+                                         partition=batch.partition)
         if self.inspector is not None:
             # observe-only: the gate makes rot visible, quarantine
             # stays the queue's job — the batch trains unmodified
@@ -330,18 +356,26 @@ class StreamingDriver:
             self.model.partial_fit(
                 ratings, offset=offset,
                 emit_updates=self.config.emit_updates)
-        if self._lineage is not None:
+        if self._lineage is not None or self._disttrace is not None:
             # the ingest half of the freshness join: this offset landed
             # (APPLIED — the model's own stamp is the proof, the same
             # gate the checkpoint path uses below; a batch buffered
             # during a background retrain is not applied yet, and its
             # covering mark lands with the first post-swap batch whose
-            # stamp advances past it) at this wall time
+            # stamp advances past it) at this wall time. ONE clock read
+            # shared by both planes, so the critical-path swap_lag
+            # stage reconciles exactly against the lineage histogram.
             applied = self._online.consumed_offsets.get(
                 batch.partition, 0)
             if applied >= batch.end_offset:
-                self._lineage.note_ingest(applied,
-                                          partition=batch.partition)
+                t_applied = time.time()
+                if self._lineage is not None:
+                    self._lineage.note_ingest(applied,
+                                              partition=batch.partition,
+                                              t=t_applied)
+                if self._disttrace is not None:
+                    self._disttrace.note_applied(
+                        applied, partition=batch.partition, t=t_applied)
         if self._engines:  # dirty-id tracking feeds delta refreshes
             ru, ri, _, rw = ratings.to_numpy()
             real = rw > 0
@@ -398,16 +432,38 @@ class StreamingDriver:
         engine.on_refresh = self.catalog_versions.append
         self.catalog_versions.append(engine.version)  # the bind itself
         self._engines.append(engine)
-        if self._lineage is not None:
-            # the engine stamped its own bind; enrich with what only
-            # this driver knows — which WAL offset the bound snapshot
-            # covers (the watermark every served result joins back to)
-            self._lineage.record_swap(
-                engine.version,
-                wal_offset_watermark=self.consumed_offset,
-                partition=self.partition,
-                train_step=int(self._online.step), source="engine_bind")
+        self._note_swap(engine.version, self.consumed_offset,
+                        source="engine_bind")
         return engine
+
+    def _note_swap(self, version: int, watermark: int,
+                   source: str) -> None:
+        """One swap's causal stamps, each plane behind its own gate:
+        the lineage record (enriched with the watermark only this
+        driver knows), the critical-path swap mark (re-using the
+        lineage record's own ``wall_time`` — the swap instant — so the
+        ``swap_lag`` stage reconciles exactly against the freshness
+        histogram), and a ``lineage/swap_watermark`` trace instant (the
+        version↔watermark join the assembled record trace pivots on)."""
+        if (self._lineage is None and self._disttrace is None
+                and not self._trace.enabled):
+            return
+        step = int(self._online.step)
+        t_swap = None
+        if self._lineage is not None:
+            rec = self._lineage.record_swap(
+                version, wal_offset_watermark=watermark,
+                partition=self.partition, train_step=step,
+                source=source)
+            t_swap = rec["wall_time"]
+        if self._disttrace is not None:
+            self._disttrace.note_swap(version, partition=self.partition,
+                                      watermark=watermark, t=t_swap)
+        if self._trace.enabled:
+            self._trace.instant("lineage/swap_watermark",
+                                version=int(version),
+                                partition=int(self.partition),
+                                watermark=int(watermark), source=source)
 
     def refresh_serving(self, delta: bool | None = None) -> None:
         """Push the live model's state into every attached engine — the
@@ -471,17 +527,15 @@ class StreamingDriver:
             snapshot = self.model.to_model()
             for engine in self._engines:
                 engine.refresh(snapshot)
-        if self._lineage is not None:
+        if (self._lineage is not None or self._disttrace is not None
+                or self._trace.enabled):
             # the swap provenance this refresh created: each engine's
             # new version now covers everything this driver has applied
             # — the consumed offset IS the servable watermark
             watermark = self.consumed_offset
-            step = int(self._online.step)
             for engine in self._engines:
-                self._lineage.record_swap(
-                    engine.version, wal_offset_watermark=watermark,
-                    partition=self.partition, train_step=step,
-                    source="stream_refresh")
+                self._note_swap(engine.version, watermark,
+                                source="stream_refresh")
 
     @staticmethod
     def _gather_rows(table_arr, rows: np.ndarray) -> np.ndarray:

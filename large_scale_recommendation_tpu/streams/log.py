@@ -402,6 +402,19 @@ class EventLog:
                        segment_records, fsync)
             for k in range(num_partitions)
         ]
+        # causal-plane hooks bind at construction (obs.disttrace):
+        # the tracer stamps a `wal/append` span per acked append (the
+        # trace id derives deterministically from the acked offsets, so
+        # a consumer PROCESS joins it with no side channel), the
+        # critical-path analyzer notes the append instant. Default-off:
+        # one `enabled` test / one `is not None` test per append.
+        from large_scale_recommendation_tpu.obs.disttrace import (
+            get_disttrace,
+        )
+        from large_scale_recommendation_tpu.obs.trace import get_tracer
+
+        self._trace = get_tracer()
+        self._disttrace = get_disttrace()
 
     # -- append -------------------------------------------------------------
 
@@ -413,13 +426,37 @@ class EventLog:
 
     def append_arrays(self, partition: int, users, items,
                       ratings) -> tuple[int, int]:
-        """Append raw triples; returns the acked [start, end) offsets."""
+        """Append raw triples; returns the acked [start, end) offsets.
+
+        With tracing enabled the durable write is wrapped in a
+        ``wal/append`` span carrying the acked offset range and the
+        deterministic record trace id (``obs.disttrace`` — this is the
+        WAL-append stamp every assembled record trace starts from); an
+        installed critical-path analyzer notes the append instant (the
+        start of the record's ``queue_wait`` stage)."""
         users = np.asarray(users)
         records = np.empty(len(users), RECORD_DTYPE)
         records["user"] = users.astype(np.int32)
         records["item"] = np.asarray(items, dtype=np.int32)
         records["rating"] = np.asarray(ratings, dtype=np.float32)
-        return self._part(partition).append(records)
+        if self._trace.enabled:
+            from large_scale_recommendation_tpu.obs.disttrace import (
+                record_trace_id,
+            )
+
+            with self._trace.span("wal/append",
+                                  partition=int(partition),
+                                  n=int(len(users))) as sp:
+                start, end = self._part(partition).append(records)
+                # args stamped before exit so they export with the span
+                sp.args["start_offset"] = int(start)
+                sp.args["end_offset"] = int(end)
+                sp.args["trace_id"] = record_trace_id(partition, start)
+        else:
+            start, end = self._part(partition).append(records)
+        if self._disttrace is not None:
+            self._disttrace.note_append(end, partition=partition)
+        return start, end
 
     def append(self, partition: int, batch: Ratings) -> tuple[int, int]:
         """Append a ``Ratings`` batch. Weight-0 entries are padding by

@@ -38,6 +38,11 @@ from typing import Iterator
 import numpy as np
 
 from large_scale_recommendation_tpu.core.types import Ratings
+from large_scale_recommendation_tpu.obs.disttrace import record_trace_id
+from large_scale_recommendation_tpu.obs.trace import (
+    TraceContext,
+    get_tracer,
+)
 from large_scale_recommendation_tpu.streams.log import EventLog
 from large_scale_recommendation_tpu.utils.metrics import IngestStats
 
@@ -47,12 +52,25 @@ class StreamBatch:
     """One offset-stamped micro-batch: ``ratings`` covers records
     ``[start_offset, end_offset)`` of ``partition``'s stream. The stamp
     is what makes consumption checkpointable — a consumer that persists
-    ``end_offset`` with its state can replay the tail after a crash."""
+    ``end_offset`` with its state can replay the tail after a crash.
+
+    ``ctx`` is the batch's ``obs.trace.TraceContext`` (None when
+    tracing is off — the zero-cost default): minted by the source from
+    the batch's durable identity (``record_trace_id`` of its FIRST
+    record — note the producer's ``wal/append`` stamp derives its id
+    from the APPEND range's first record, so the two ids only coincide
+    when batch and append boundaries align; the cross-process join is
+    by offset-RANGE coverage, which both sides always carry) and
+    activated around the apply by ``StreamingDriver``, which is how
+    every span the batch's processing opens joins the record's
+    distributed trace."""
 
     ratings: Ratings
     partition: int
     start_offset: int
     end_offset: int
+    ctx: TraceContext | None = dataclasses.field(
+        default=None, compare=False, repr=False)
 
     @property
     def n(self) -> int:
@@ -276,6 +294,9 @@ class LogTailSource:
         self.follow = follow
         self.poll_interval_s = poll_interval_s
         self._stop = threading.Event()
+        # trace-context mints gate on the construction-bound tracer:
+        # default-off tracer ⇒ ctx stays None, no allocation, no stamp
+        self._trace = get_tracer()
 
     def stop(self) -> None:
         self._stop.set()
@@ -289,8 +310,16 @@ class LogTailSource:
                     return
                 time.sleep(self.poll_interval_s)
                 continue
+            ctx = None
+            if self._trace.enabled:
+                # the batch's causal identity derives from its DURABLE
+                # offsets — the appender's wal/append stamp carries the
+                # same derivation, so the join needs no side channel
+                ctx = TraceContext(trace_id=record_trace_id(
+                    self.partition, self.offset))
             yield StreamBatch(ratings=batch, partition=self.partition,
-                              start_offset=self.offset, end_offset=nxt)
+                              start_offset=self.offset, end_offset=nxt,
+                              ctx=ctx)
             self.offset = nxt
 
     def __iter__(self) -> Iterator[StreamBatch]:
@@ -311,15 +340,19 @@ class GeneratorSource:
         self.num_batches = num_batches
         self.partition = partition
         self.offset = 0
+        self._trace = get_tracer()
 
     def batches(self) -> Iterator[StreamBatch]:
         produced = 0
         while self.num_batches is None or produced < self.num_batches:
             ratings = self.generator.generate(self.batch_records)
             n = int(np.sum(np.asarray(ratings.weights) > 0))
+            ctx = (TraceContext(trace_id=record_trace_id(
+                self.partition, self.offset))
+                if self._trace.enabled else None)
             yield StreamBatch(ratings=ratings, partition=self.partition,
                               start_offset=self.offset,
-                              end_offset=self.offset + n)
+                              end_offset=self.offset + n, ctx=ctx)
             self.offset += n
             produced += 1
 
@@ -337,6 +370,7 @@ class CSVSource:
         self.path = path
         self.batch_records = batch_records
         self.partition = partition
+        self._trace = get_tracer()
 
     def batches(self) -> Iterator[StreamBatch]:
         from large_scale_recommendation_tpu.data.movielens import (
@@ -348,10 +382,13 @@ class CSVSource:
         ru, ri, rv = ru[real], ri[real], rv[real]
         for b0 in range(0, len(ru), self.batch_records):
             b1 = min(b0 + self.batch_records, len(ru))
+            ctx = (TraceContext(trace_id=record_trace_id(
+                self.partition, b0)) if self._trace.enabled else None)
             yield StreamBatch(
                 ratings=Ratings.from_arrays(ru[b0:b1], ri[b0:b1],
                                             rv[b0:b1]),
-                partition=self.partition, start_offset=b0, end_offset=b1)
+                partition=self.partition, start_offset=b0,
+                end_offset=b1, ctx=ctx)
 
     def __iter__(self) -> Iterator[StreamBatch]:
         return self.batches()
@@ -435,7 +472,7 @@ class QueuedSource:
         return StreamBatch(
             ratings=Ratings.from_arrays(ru[keep], ri[keep], rv[keep]),
             partition=batch.partition, start_offset=batch.start_offset,
-            end_offset=batch.end_offset)
+            end_offset=batch.end_offset, ctx=batch.ctx)
 
     def _feed(self) -> None:
         try:

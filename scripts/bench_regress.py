@@ -158,11 +158,15 @@ DEFAULT_HIGHER = ("_ratings_per_s", "_rows_per_s", "_users_per_s",
                   "_ndcg", "_hr10", "_hr_at", "ndcg_at", "coverage")
 
 # keys where LOWER is better (walls, latencies, pad/layout overheads,
-# compile counts, eval error) when watched explicitly
+# compile counts, eval error, ingest→servable critical-path walls)
+# when watched explicitly. ``critical_path`` covers
+# critical_path_total_s and the per-stage critical_path_s keys
+# (ISSUE 12): a growing ingest→servable wall is a freshness regression
+# even when throughput noise hides it.
 DEFAULT_LOWER = ("_wall_s", "_ms_", "time_to_", "_s_p", "_pad_ratio",
                  "layout_mb", "layout_bytes", "p99_ms", "p50_ms",
                  "shed_frac", "compile_count", "_rmse", "eval_rmse",
-                 "rmse_final", "staleness_s")
+                 "rmse_final", "staleness_s", "critical_path")
 
 _NUM_PAIR = re.compile(
     r'"([A-Za-z_][A-Za-z0-9_]*)":\s*(-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)')

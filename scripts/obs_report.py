@@ -12,6 +12,8 @@ side::
     python scripts/obs_report.py --roofline roofline.json
     python scripts/obs_report.py --lineage http://127.0.0.1:8080/lineagez
     python scripts/obs_report.py --quality http://127.0.0.1:8080/seriesz
+    python scripts/obs_report.py --critical-path \
+        http://127.0.0.1:8080/criticalpathz
 
 ``--bundle <dir>`` renders a postmortem bundle (``obs.recorder``):
 validates it first (``validate_bundle`` — a torn bundle is an error,
@@ -35,6 +37,12 @@ every ``eval_*`` / ``dataq_*`` / ``lineage_*`` flight-recorder series
 from a ``/seriesz`` URL or dumped series JSON (``examples/obs_demo.py``
 writes one), or the frozen instrument values from a bundle
 ``lineage.json``.
+
+``--critical-path <src>`` renders the ingest→servable critical path
+(``obs.disttrace.CriticalPathAnalyzer``): the per-stage attribution
+summary (queue wait / train apply / swap lag / flush wait) and the
+newest completed samples. ``src`` is a ``/criticalpathz`` URL or a
+dumped snapshot JSON.
 
 Input is a single-snapshot JSON file, a JSONL metrics log
 (``MetricsRegistry.append_jsonl``), or — live mode — an HTTP URL to a
@@ -399,6 +407,41 @@ def render_lineage(doc: dict, tail: int = 30) -> str:
     return "\n".join(out)
 
 
+def render_critical_path(doc: dict, tail: int = 20) -> str:
+    """Render the ingest→servable critical path (a ``/criticalpathz``
+    body or dumped analyzer snapshot): the per-stage attribution
+    summary, then the newest completed samples — one row per sampled
+    record with its stage decomposition and total."""
+    stages = doc.get("stages", {})
+    samples = doc.get("samples", [])
+    out = [
+        "# ingest→servable critical path "
+        f"({doc.get('samples_total', '-')} samples)"
+        + (f"; note: {doc['note']}" if doc.get("note") else ""),
+        "",
+    ]
+    stage_rows = [(name, str(st.get("count", 0)), _fmt(st.get("mean_s")),
+                   _fmt(st.get("last_s")), _fmt(st.get("max_s")))
+                  for name, st in stages.items()]
+    if stage_rows:
+        out.extend(format_table(("stage", "n", "mean_s", "last_s",
+                                 "max_s"), stage_rows))
+        out.append("")
+    if not samples:
+        out.append("(no completed samples — arm obs.enable_disttrace() "
+                   "before building the log/driver/engine)")
+        return "\n".join(out)
+    rows = [(str(s.get("catalog_version")), str(s.get("partition")),
+             str(s.get("offset")), _fmt(s.get("queue_wait_s")),
+             _fmt(s.get("train_apply_s")), _fmt(s.get("swap_lag_s")),
+             _fmt(s.get("flush_wait_s")), _fmt(s.get("total_s")))
+            for s in samples[-tail:]]
+    out.extend(format_table(("version", "part", "offset", "queue_s",
+                             "train_s", "swap_s", "flush_s", "total_s"),
+                            rows))
+    return "\n".join(out)
+
+
 QUALITY_PREFIXES = ("eval_", "dataq_", "lineage_")
 
 
@@ -471,6 +514,11 @@ def main(argv=None) -> int:
                     help="render the eval_*/dataq_*/lineage_* series "
                          "from a /seriesz URL or dumped series JSON "
                          "(or a bundle lineage.json's frozen snapshot)")
+    ap.add_argument("--critical-path", default=None, metavar="SRC",
+                    dest="critical_path",
+                    help="render the ingest→servable critical-path "
+                         "stage table from a /criticalpathz URL or a "
+                         "dumped analyzer snapshot JSON")
     args = ap.parse_args(argv)
     if args.bundle is not None:
         print(render_bundle(args.bundle, args.name))
@@ -483,6 +531,9 @@ def main(argv=None) -> int:
         return 0
     if args.quality is not None:
         print(render_quality(fetch_snapshot(args.quality), args.name))
+        return 0
+    if args.critical_path is not None:
+        print(render_critical_path(fetch_snapshot(args.critical_path)))
         return 0
     if args.path is None:
         ap.error("path is required unless --bundle is given")

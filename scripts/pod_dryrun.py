@@ -30,7 +30,15 @@ Layers, all chip-free:
    aggregates them through ``obs.fleet`` over real sockets and asserts
    the merged pod ``/metrics`` parses with both hosts labeled and the
    pod ``/healthz`` is OK (the ``POD FLEET OK`` marker → ``fleet_ok``)
-   (examples/distributed_demo.py is the workload).
+   — AND distributed tracing (ISSUE 12): process 0 produces a WAL,
+   process 1 consumes it into an online model + serving engine, the
+   pod ``/podtracez`` merge is validated as one Chrome trace, and a
+   sampled record's id resolves to ONE assembled distributed trace
+   spanning WAL append → ingest → partial_fit → swap → flush ACROSS
+   the process boundary (the ``POD TRACE OK`` marker → ``trace_ok``;
+   the merged ``pod_trace.json`` is copied to ``LSR_POD_TRACE_OUT``
+   when set — the CI artifact) (examples/distributed_demo.py is the
+   workload).
 
 Prints ONE machine-readable JSON line LAST (stderr flushed first, so
 2>&1-merged wrappers always parse it) with pad-ratio, layout-bytes and
@@ -105,6 +113,14 @@ def run_two_process_pass(timeout_s: float = 420.0) -> dict:
             for p in procs:
                 p.kill()
         shard_files = os.listdir(ckdir)
+        # persist the merged pod trace before the tempdir dies — the
+        # Perfetto-loadable artifact CI uploads (LSR_POD_TRACE_OUT)
+        trace_src = os.path.join(obsdir, "pod_trace.json")
+        trace_out = os.environ.get("LSR_POD_TRACE_OUT")
+        if trace_out and os.path.exists(trace_src):
+            import shutil
+
+            shutil.copyfile(trace_src, trace_out)
     out["wall_s"] = round(time.perf_counter() - t0, 1)
     joined = "\n".join(outs)
     if "Multiprocess computations aren't implemented" in joined:
@@ -116,12 +132,14 @@ def run_two_process_pass(timeout_s: float = 420.0) -> dict:
                    reason="jaxlib lacks cross-process CPU collectives")
         return out
     out["fleet_ok"] = "POD FLEET OK" in joined
+    out["trace_ok"] = "POD TRACE OK" in joined
     out["ok"] = (
         all(p.returncode == 0 for p in procs)
         and "DISTRIBUTED DEMO PASS" in joined          # global-ring train
         and joined.count("SHARDED CKPT RESUME OK") == 2  # per-shard ckpt
         and joined.count("parity OK") == 2             # mesh ALS parity
         and "POD FLEET OK" in joined                   # pod /metrics+/healthz
+        and "POD TRACE OK" in joined                   # pod trace assembly
         and any(".shard0of2" in n for n in shard_files)
         and any(".shard1of2" in n for n in shard_files)
     )

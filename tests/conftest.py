@@ -52,6 +52,10 @@ if _OBS_OUT:
     # builds stamps its swaps, and sessionfinish freezes the journal +
     # the quality-plane series into tier1_quality.json
     _OBS_LINEAGE = _obs.enable_lineage()
+    # critical-path attribution for the whole session: drivers/engines
+    # the suite builds stamp their ingest→servable stages
+    # (critical_path_s{stage} gauges ride the same recorder)
+    _OBS_DISTTRACE = _obs.enable_disttrace()
     _OBS_MONITOR = _health.HealthMonitor()
 
     def _session_check():
@@ -80,6 +84,10 @@ def null_obs():
     shared by every obs test file: the restore invariant is non-trivial
     and must not drift between copies."""
     from large_scale_recommendation_tpu import obs
+    from large_scale_recommendation_tpu.obs.disttrace import (
+        get_disttrace,
+        set_disttrace,
+    )
     from large_scale_recommendation_tpu.obs.events import (
         get_events,
         set_events,
@@ -108,6 +116,7 @@ def null_obs():
     prev_r, prev_t = get_registry(), get_tracer()
     prev_j, prev_rec = get_events(), get_recorder()
     prev_ins, prev_lin = get_introspector(), get_lineage()
+    prev_dt = get_disttrace()
     was_running = prev_rec is not None and prev_rec.running
     ins_was_running = prev_ins is not None and prev_ins.running
     obs.disable()  # closes the introspector too: compile funnel unpatched
@@ -117,6 +126,7 @@ def null_obs():
     set_events(prev_j)
     set_recorder(prev_rec)
     set_lineage(prev_lin)
+    set_disttrace(prev_dt)
     set_introspector(prev_ins)
     if prev_ins is not None:  # an OBS_OUT session runs one suite-wide
         prev_ins.install()

@@ -1,0 +1,660 @@
+"""Distributed tracing (``obs.disttrace`` + the ``obs.trace`` context
+plane, ISSUE 12): namespaced span/event ids (two real processes'
+exports merge with zero collisions), cross-thread ``TraceContext``
+propagation (the retrain lane parents back to its triggering batch),
+pod trace assembly + the ``/podtracez`` route, record-id resolution to
+one assembled distributed trace on a real ``StreamingDriver`` run, and
+the critical-path analyzer — hand-pinned stage math, exact
+reconciliation against the ``lineage_ingest_to_servable_s`` histogram
+(including across a kill/restart resume), and the ``/criticalpathz``
+route over a real socket.
+"""
+
+import json
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from large_scale_recommendation_tpu import obs
+from large_scale_recommendation_tpu.obs.disttrace import (
+    STAGES,
+    CriticalPathAnalyzer,
+    assemble_pod_trace,
+    get_disttrace,
+    record_trace_id,
+    resolve_record_trace,
+    set_disttrace,
+)
+from large_scale_recommendation_tpu.obs.events import (
+    EventJournal,
+    get_events,
+    set_events,
+)
+from large_scale_recommendation_tpu.obs.lineage import (
+    get_lineage,
+    set_lineage,
+)
+from large_scale_recommendation_tpu.obs.recorder import (
+    get_recorder,
+    set_recorder,
+)
+from large_scale_recommendation_tpu.obs.registry import (
+    get_registry,
+    set_registry,
+)
+from large_scale_recommendation_tpu.obs.trace import (
+    TraceContext,
+    Tracer,
+    get_tracer,
+    process_namespace,
+    set_tracer,
+    validate_chrome_trace,
+)
+
+
+@pytest.fixture
+def causal_obs():
+    """Live registry/tracer + lineage + critical-path analyzer, the
+    previous layer restored after (an OBS_OUT session runs its own
+    suite-wide instances)."""
+    prev = (get_registry(), get_tracer(), get_events(), get_recorder(),
+            get_lineage(), get_disttrace())
+    reg, tracer = obs.enable()
+    obs.enable_lineage(capacity=64)
+    analyzer = obs.enable_disttrace(capacity=32)
+    yield reg, tracer, analyzer
+    set_registry(prev[0])
+    set_tracer(prev[1])
+    set_events(prev[2])
+    set_recorder(prev[3])
+    set_lineage(prev[4])
+    set_disttrace(prev[5])
+
+
+def _fill_log(log, n_batches=3, n=500, partition=0, seed=0):
+    rng = np.random.default_rng(seed)
+    for _ in range(n_batches):
+        log.append_arrays(partition, rng.integers(0, 100, n),
+                          rng.integers(0, 50, n),
+                          rng.random(n).astype(np.float32))
+
+
+def _driver(tmp_path, log, sub="ckpt", **cfg):
+    from large_scale_recommendation_tpu.models.online import (
+        OnlineMF,
+        OnlineMFConfig,
+    )
+    from large_scale_recommendation_tpu.streams.driver import (
+        StreamingDriver,
+        StreamingDriverConfig,
+    )
+
+    model = OnlineMF(OnlineMFConfig(num_factors=4, minibatch_size=128))
+    return StreamingDriver(
+        model, log, str(tmp_path / sub),
+        config=StreamingDriverConfig(batch_records=400, **cfg))
+
+
+# --------------------------------------------------------------------------
+# Trace identity: namespaced ids, deterministic record trace ids
+# --------------------------------------------------------------------------
+
+
+class TestTraceIdentity:
+    def test_record_trace_id_is_deterministic(self):
+        """The cross-process propagation mechanism: the id is a pure
+        function of the record's durable identity — any process
+        derives it with no side channel."""
+        assert record_trace_id(0, 42) == "wal-p0-o42"
+        assert record_trace_id(3, 7) == record_trace_id(3, 7)
+        assert record_trace_id(0, 1) != record_trace_id(1, 1)
+
+    def test_span_and_event_ids_are_namespaced(self, causal_obs):
+        _, tracer, _ = causal_obs
+        ns = process_namespace()
+        journal = EventJournal(capacity=8)
+        with tracer.span("work") as sp:
+            ev = journal.emit("thing")
+        assert sp.id.startswith(ns + ":")
+        assert ev["id"].startswith(ns + ":")
+        assert ev["id"].rsplit(":", 1)[1] == str(ev["seq"])
+        # the event's span correlation token is the namespaced span id
+        assert ev["span_id"] == sp.id
+
+    def test_two_real_processes_merge_with_zero_collisions(
+            self, causal_obs, tmp_path):
+        """The satellite pin: a SECOND real process's exports (spans
+        AND event records) merge with this process's with zero id
+        collisions, and the merged trace validates."""
+        _, tracer, _ = causal_obs
+        with tracer.span("local/outer"):
+            with tracer.span("local/inner"):
+                pass
+        journal = EventJournal(capacity=8)
+        journal.emit("local.event")
+
+        script = r"""
+import json, sys
+from large_scale_recommendation_tpu import obs
+reg, tracer = obs.enable()
+from large_scale_recommendation_tpu.obs.events import EventJournal
+journal = EventJournal(capacity=8)
+with tracer.span("remote/outer"):
+    with tracer.span("remote/inner"):
+        journal.emit("remote.event")
+print(json.dumps({"trace": tracer.chrome_trace(),
+                  "events": journal.events()}))
+"""
+        out = subprocess.run([sys.executable, "-c", script],
+                             capture_output=True, text=True, timeout=120,
+                             env=None)
+        assert out.returncode == 0, out.stderr[-2000:]
+        remote = json.loads(out.stdout.strip().splitlines()[-1])
+
+        local_doc = tracer.chrome_trace()
+        local_ids = {e["args"]["span_id"]
+                     for e in local_doc["traceEvents"]}
+        remote_ids = {e["args"]["span_id"]
+                      for e in remote["trace"]["traceEvents"]}
+        assert local_ids and remote_ids
+        assert not (local_ids & remote_ids)  # zero span-id collisions
+        local_ev = {e["id"] for e in journal.events()}
+        remote_ev = {e["id"] for e in remote["events"]}
+        assert local_ev and remote_ev
+        assert not (local_ev & remote_ev)  # zero event-id collisions
+        merged = assemble_pod_trace([("local", local_doc),
+                                     ("remote", remote["trace"])])
+        validate_chrome_trace(merged)
+        names = {e["name"] for e in merged["traceEvents"]}
+        assert {"local/outer", "remote/outer",
+                "process_name"} <= names
+
+
+# --------------------------------------------------------------------------
+# TraceContext propagation
+# --------------------------------------------------------------------------
+
+
+class TestTraceContext:
+    def test_capture_and_reenter_on_another_thread(self, causal_obs):
+        """The retrain-lane contract in miniature: a context captured
+        inside a span, re-entered on another thread, parents that
+        thread's top-level span back to the capturing span and carries
+        the trace id."""
+        _, tracer, _ = causal_obs
+        done = threading.Event()
+
+        def work(ctx):
+            with tracer.activate(ctx):
+                with tracer.span("thread/work"):
+                    pass
+            done.set()
+
+        with tracer.activate(TraceContext(trace_id="trace-1")):
+            with tracer.span("main/batch") as batch:
+                t = threading.Thread(
+                    target=work, args=(tracer.capture_context(),))
+                t.start()
+                t.join()
+        assert done.wait(1)
+        by_name = {e["name"]: e for e in tracer.events()}
+        worked = by_name["thread/work"]
+        assert worked["args"]["parent_span_id"] == batch.id
+        assert worked["args"]["trace_id"] == "trace-1"
+        assert worked["tid"] != by_name["main/batch"]["tid"]
+
+    def test_activate_none_is_noop(self, causal_obs):
+        _, tracer, _ = causal_obs
+        with tracer.activate(None):
+            with tracer.span("plain"):
+                pass
+        (ev,) = [e for e in tracer.events() if e["name"] == "plain"]
+        assert "trace_id" not in ev["args"]
+        assert "parent_span_id" not in ev["args"]
+
+    def test_null_tracer_context_surface(self):
+        from large_scale_recommendation_tpu.obs.trace import NULL_TRACER
+
+        assert NULL_TRACER.capture_context() is None
+        assert NULL_TRACER.current_context() is None
+        with NULL_TRACER.activate(TraceContext(trace_id="x")) as got:
+            assert got is None
+
+    def test_instant_carries_active_trace_id(self, causal_obs):
+        _, tracer, _ = causal_obs
+        with tracer.activate(TraceContext(trace_id="t-9")):
+            tracer.instant("mark", note=1)
+        (ev,) = [e for e in tracer.events() if e["name"] == "mark"]
+        assert ev["args"]["trace_id"] == "t-9"
+
+    def test_retrain_thread_parents_to_triggering_batch(
+            self, causal_obs):
+        """The satellite pin: an ``AdaptiveMF`` background retrain's
+        span resolves to the triggering batch's span in the EXPORTED
+        trace (before this PR the retrain lane parented to nothing)."""
+        from large_scale_recommendation_tpu.core.generators import (
+            SyntheticMFGenerator,
+        )
+        from large_scale_recommendation_tpu.models.adaptive import (
+            AdaptiveMF,
+            AdaptiveMFConfig,
+        )
+
+        _, tracer, _ = causal_obs
+        adaptive = AdaptiveMF(AdaptiveMFConfig(
+            num_factors=4, offline_every=2, offline_iterations=1,
+            background=True))
+        gen = SyntheticMFGenerator(num_users=60, num_items=30, rank=2,
+                                   noise=0.1, seed=0)
+        batch_span_id = None
+        with tracer.span("stream/ingest_batch", partition=0) as sp:
+            adaptive.process(gen.generate(256))
+            adaptive.process(gen.generate(256))  # triggers the retrain
+            batch_span_id = sp.id
+        adaptive.flush()
+        retrains = [e for e in tracer.events()
+                    if e["name"] == "adaptive/retrain"]
+        assert retrains, [e["name"] for e in tracer.events()]
+        assert retrains[-1]["args"]["parent_span_id"] == batch_span_id
+
+
+# --------------------------------------------------------------------------
+# Pod assembly + the validator's merged-trace semantics
+# --------------------------------------------------------------------------
+
+
+class TestAssembly:
+    def _doc(self, pid, tid, ts, name="w", span_id="x:1"):
+        return {"traceEvents": [
+            {"name": name, "cat": "span", "ph": "X", "ts": ts,
+             "dur": 10.0, "pid": pid, "tid": tid,
+             "args": {"span_id": span_id}}]}
+
+    def test_pid_remap_and_metadata(self):
+        merged = assemble_pod_trace([
+            ("host-a", self._doc(7, 1, 0.0, span_id="a:1")),
+            ("host-b", self._doc(7, 1, 5.0, span_id="b:1")),
+        ])
+        events = merged["traceEvents"]
+        meta = [e for e in events if e["ph"] == "M"]
+        assert [m["args"]["name"] for m in meta] == ["host-a", "host-b"]
+        pids = {e["pid"] for e in events if e["ph"] == "X"}
+        assert pids == {0, 1}  # synthetic, collision-free
+        assert merged["podSources"] == ["host-a", "host-b"]
+
+    def test_merged_colliding_pids_validate(self):
+        """Two processes with the SAME os pid/tid and OVERLAPPING
+        (non-nesting) intervals: unmergeable before the (pid, tid)
+        nesting fix — now each source is its own group."""
+        merged = assemble_pod_trace([
+            ("a", self._doc(7, 1, 0.0)),
+            ("b", self._doc(7, 1, 5.0)),  # overlaps, doesn't nest
+        ])
+        validate_chrome_trace(merged)  # must not raise
+
+    def test_partial_overlap_on_one_thread_still_rejected(self):
+        doc = {"traceEvents": [
+            {"name": "a", "cat": "s", "ph": "X", "ts": 0.0, "dur": 10.0,
+             "pid": 1, "tid": 1, "args": {}},
+            {"name": "b", "cat": "s", "ph": "X", "ts": 5.0, "dur": 10.0,
+             "pid": 1, "tid": 1, "args": {}},
+        ]}
+        with pytest.raises(ValueError, match="overlap"):
+            validate_chrome_trace(doc)
+
+    def test_resolution_pins_swap_and_flush_to_the_applying_process(
+            self):
+        """Catalog versions are a PER-PROCESS counter: two consumer
+        processes both mint version 3. Resolution must join the swap
+        to the INGESTING process and the flush to the SWAPPING one —
+        without the pid constraint, process A's record chained through
+        process B's unrelated same-numbered flush (review-caught)."""
+        def ev(name, pid, ts, dur=None, **args):
+            e = {"name": name, "cat": "s", "ph": "X" if dur is not None
+                 else "i", "ts": ts, "pid": pid, "tid": 1, "args": args}
+            if dur is not None:
+                e["dur"] = dur
+            return e
+
+        doc = {"traceEvents": [
+            ev("wal/append", 0, 0.0, 5.0, partition=0, start_offset=0,
+               end_offset=100),
+            ev("stream/ingest_batch", 1, 10.0, 5.0, partition=0,
+               start_offset=0, end_offset=100),
+            ev("online/partial_fit", 1, 11.0, 2.0),
+            # the DECOY: another process's same-numbered, EARLIER swap
+            ev("lineage/swap_watermark", 2, 12.0, None, partition=0,
+               watermark=500, version=3),
+            ev("serving/flush", 2, 13.0, 1.0, catalog_version=3),
+            # the real chain on the ingesting process
+            ev("lineage/swap_watermark", 1, 20.0, None, partition=0,
+               watermark=100, version=3),
+            ev("serving/flush", 1, 21.0, 1.0, catalog_version=3),
+        ]}
+        chain = resolve_record_trace(doc, 0, 50)
+        assert chain["complete"], chain
+        hops = {h["hop"]: h for h in chain["hops"]}
+        assert hops["catalog_swap"]["pid"] == 1
+        assert hops["servable_flush"]["pid"] == 1
+
+    def test_metadata_phase_validates(self):
+        doc = {"traceEvents": [
+            {"name": "process_name", "ph": "M", "pid": 0, "tid": 0,
+             "args": {"name": "p0"}}]}
+        assert validate_chrome_trace(doc) == []
+
+
+# --------------------------------------------------------------------------
+# Critical-path analyzer: hand-pinned stage math
+# --------------------------------------------------------------------------
+
+
+class TestCriticalPathAnalyzer:
+    def test_stage_decomposition_hand_pinned(self, causal_obs):
+        reg, _, _ = causal_obs
+        ana = CriticalPathAnalyzer(registry=reg)
+        ana.note_append(400, partition=0, t=100.0)
+        ana.note_dequeue(400, partition=0, t=101.5)
+        ana.note_applied(400, partition=0, t=101.75)
+        sample = ana.note_swap(9, partition=0, watermark=400, t=102.0)
+        assert sample["offset"] == 399
+        assert sample["queue_wait_s"] == pytest.approx(1.5)
+        assert sample["train_apply_s"] == pytest.approx(0.25)
+        assert sample["swap_lag_s"] == pytest.approx(0.25)
+        # the stage sum IS the total by construction
+        assert sample["total_s"] == pytest.approx(2.0)
+        assert sample["flush_wait_s"] is None
+        ana.note_serve(9, t=102.5)
+        (done,) = ana.samples()
+        assert done["flush_wait_s"] == pytest.approx(0.5)
+        # gauges published for the recorder to keep history of
+        names = {(m["name"], tuple(sorted(m["labels"].items())))
+                 for m in reg.snapshot()["metrics"]}
+        for stage in STAGES:
+            assert ("critical_path_s", (("stage", stage),)) in names
+        assert ("critical_path_total_s", ()) in names
+
+    def test_one_sample_per_version_partition(self, causal_obs):
+        reg, _, _ = causal_obs
+        ana = CriticalPathAnalyzer(registry=reg)
+        ana.note_applied(100, t=10.0)
+        assert ana.note_swap(1, watermark=100, t=11.0) is not None
+        assert ana.note_swap(1, watermark=100, t=12.0) is None  # dup
+        assert ana.note_swap(2, watermark=100, t=12.0) is not None
+        assert ana.samples_total == 2
+
+    def test_no_covered_mark_no_sample(self, causal_obs):
+        reg, _, _ = causal_obs
+        ana = CriticalPathAnalyzer(registry=reg)
+        assert ana.note_swap(1, watermark=50, t=1.0) is None  # no marks
+        ana.note_applied(100, t=10.0)
+        assert ana.note_swap(2, watermark=50, t=11.0) is None  # behind
+        assert ana.note_swap(3, watermark=None) is None
+
+    def test_missing_append_mark_degrades_gracefully(self, causal_obs):
+        """A cross-process producer without an in-process append mark:
+        queue_wait unknown (None), total measured from apply start."""
+        reg, _, _ = causal_obs
+        ana = CriticalPathAnalyzer(registry=reg)
+        ana.note_dequeue(200, t=50.0)
+        ana.note_applied(200, t=50.5)
+        s = ana.note_swap(4, watermark=200, t=51.0)
+        assert s["queue_wait_s"] is None
+        assert s["train_apply_s"] == pytest.approx(0.5)
+        assert s["total_s"] == pytest.approx(1.0)
+
+    def test_capacity_bound_holds(self, causal_obs):
+        reg, _, _ = causal_obs
+        ana = CriticalPathAnalyzer(capacity=4, registry=reg)
+        ana.note_applied(10, t=1.0)
+        for v in range(10):
+            ana.note_swap(v, watermark=10, t=2.0)
+        assert len(ana) == 4
+        assert ana.samples_total == 10
+        snap = ana.snapshot()
+        assert snap["stages"]["swap_lag"]["count"] == 4
+
+    def test_snapshot_shape(self, causal_obs):
+        _, _, ana = causal_obs
+        snap = ana.snapshot()
+        assert set(snap) >= {"time", "stages", "samples",
+                             "samples_total", "capacity", "marks"}
+        assert set(snap["stages"]) == set(STAGES) | {"total"}
+
+
+# --------------------------------------------------------------------------
+# The acceptance paths: real driver run, reconciliation, resume
+# --------------------------------------------------------------------------
+
+
+class TestDriverAcceptance:
+    def _hist(self, reg):
+        for m in reg.snapshot()["metrics"]:
+            if m["name"] == "lineage_ingest_to_servable_s":
+                return m
+        return None
+
+    def test_record_resolves_to_one_assembled_trace(self, causal_obs,
+                                                    tmp_path):
+        """The first acceptance half: on a real driver run, a sampled
+        rating's record id resolves to ONE assembled distributed trace
+        spanning WAL append → ingest batch → partial_fit → catalog
+        swap → first servable flush."""
+        from large_scale_recommendation_tpu.streams.log import EventLog
+
+        _, tracer, _ = causal_obs
+        log = EventLog(str(tmp_path / "log"), fsync=False)
+        _fill_log(log)
+        driver = _driver(tmp_path, log)
+        engine = driver.serving_engine(k=3, max_batch=32)
+        driver.run()
+        driver.refresh_serving()
+        engine.recommend(np.arange(5, dtype=np.int64))
+
+        doc = assemble_pod_trace([("p0", tracer.chrome_trace())])
+        validate_chrome_trace(doc)
+        chain = resolve_record_trace(doc, 0, driver.consumed_offset - 1)
+        assert chain["complete"], chain
+        assert chain["found"] == ["wal_append", "ingest_batch",
+                                  "partial_fit", "catalog_swap",
+                                  "servable_flush"]
+        # every hop is joinable by its namespaced span id (instants
+        # outside spans carry None — the swap marker is one)
+        ingest = [h for h in chain["hops"]
+                  if h["hop"] == "ingest_batch"][0]
+        assert str(ingest["span_id"]).startswith(process_namespace())
+        # the trace-side decomposition covers every stage
+        assert set(chain["stages"]) == set(STAGES)
+        assert all(v >= 0 for v in chain["stages"].values())
+
+    def test_critical_path_reconciles_with_lineage_histogram(
+            self, causal_obs, tmp_path):
+        """The satellite-3 pin: per-stage sums behave (total == stage
+        sum) and the ``swap_lag`` stage reconciles against the
+        ``lineage_ingest_to_servable_s`` sample — EXACTLY, because the
+        two planes share their clock reads — including across a
+        kill/restart resume."""
+        from large_scale_recommendation_tpu.streams.log import EventLog
+
+        reg, _, analyzer = causal_obs
+        log = EventLog(str(tmp_path / "log"), fsync=False)
+        _fill_log(log, n_batches=3)
+        driver = _driver(tmp_path, log)
+        engine = driver.serving_engine(k=3, max_batch=32)
+        driver.run()
+        driver.refresh_serving()
+        engine.recommend(np.arange(4, dtype=np.int64))
+
+        def check():
+            samples = analyzer.samples()
+            assert samples
+            hist = self._hist(reg)
+            assert hist is not None
+            # one histogram observation per completed sample
+            assert hist["count"] == len(samples)
+            lags = [s["swap_lag_s"] for s in samples]
+            assert np.mean(lags) == pytest.approx(hist["mean"],
+                                                  rel=1e-6, abs=1e-6)
+            for s in samples:
+                parts = [v for v in (s["queue_wait_s"],
+                                     s["train_apply_s"],
+                                     s["swap_lag_s"]) if v is not None]
+                assert sum(parts) == pytest.approx(s["total_s"],
+                                                   abs=1e-9)
+            # the builds that actually served priced their flush_wait
+            # (a bind build superseded by a refresh before ever serving
+            # legitimately never completes the stage)
+            assert any(s["flush_wait_s"] is not None for s in samples)
+
+        check()
+        n_before = len(analyzer.samples())
+
+        # kill/restart: a fresh driver + model resumes from the
+        # checkpoint, ingests more, refreshes — the new samples must
+        # keep reconciling
+        _fill_log(log, n_batches=2, seed=1)
+        driver2 = _driver(tmp_path, log)
+        assert driver2.resume()
+        engine2 = driver2.serving_engine(k=3, max_batch=32)
+        driver2.run()
+        driver2.refresh_serving()
+        engine2.recommend(np.arange(4, dtype=np.int64))
+        assert len(analyzer.samples()) > n_before
+        check()
+
+
+# --------------------------------------------------------------------------
+# Endpoints: /criticalpathz and the pod /podtracez over real sockets
+# --------------------------------------------------------------------------
+
+
+class TestEndpoints:
+    def test_criticalpathz_route(self, causal_obs):
+        from large_scale_recommendation_tpu.obs.server import (
+            ObsServer,
+            http_get,
+        )
+
+        _, _, ana = causal_obs
+        ana.note_applied(10, t=1.0)
+        ana.note_swap(1, watermark=10, t=1.5)
+        with ObsServer() as server:
+            code, body = http_get(server.url + "/criticalpathz")
+            assert code == 200
+            doc = json.loads(body)
+            assert doc["samples_total"] == 1
+            assert doc["stages"]["swap_lag"]["count"] == 1
+            code, body = http_get(server.url + "/")
+            assert "/criticalpathz" in json.loads(body)["routes"]
+
+    def test_criticalpathz_without_analyzer(self, null_obs):
+        from large_scale_recommendation_tpu.obs.server import ObsServer
+
+        doc = ObsServer().criticalpathz()
+        assert "note" in doc and doc["samples"] == []
+
+    def test_tracez_limit_param(self, causal_obs):
+        from large_scale_recommendation_tpu.obs.server import (
+            ObsServer,
+            http_get,
+        )
+
+        _, tracer, _ = causal_obs
+        for i in range(5):
+            with tracer.span(f"s{i}"):
+                pass
+        with ObsServer(tracez_limit=2) as server:
+            code, body = http_get(server.url + "/tracez")
+            assert len(json.loads(body)["recent"]) == 2
+            code, body = http_get(server.url + "/tracez?limit=0")
+            assert len(json.loads(body)["recent"]) == 5
+            code, _ = http_get(server.url + "/tracez?limit=junk")
+            assert code == 400
+            # a negative limit is a client error, NOT a request for
+            # the whole 200k-event buffer
+            code, _ = http_get(server.url + "/tracez?limit=-1")
+            assert code == 400
+
+    def test_podtracez_merges_two_live_servers(self, causal_obs):
+        """The pod route over REAL sockets: two ObsServers with
+        separate tracers (standing in for two processes) merge into
+        one validated timeline with both sources present."""
+        from large_scale_recommendation_tpu.obs.fleet import (
+            FleetAggregator,
+            FleetServer,
+        )
+        from large_scale_recommendation_tpu.obs.server import (
+            ObsServer,
+            http_get,
+        )
+
+        t1, t2 = Tracer(), Tracer()
+        with t1.span("proc1/work"):
+            pass
+        with t2.span("proc2/work"):
+            pass
+        s1 = ObsServer(tracer=t1).start()
+        s2 = ObsServer(tracer=t2).start()
+        try:
+            fleet = FleetServer(
+                FleetAggregator([s1.url, s2.url])).start()
+            try:
+                code, body = http_get(fleet.url + "/podtracez")
+                assert code == 200
+                doc = json.loads(body)
+                validate_chrome_trace(doc)
+                names = {e["name"] for e in doc["traceEvents"]}
+                assert {"proc1/work", "proc2/work"} <= names
+                assert len(doc["podSources"]) == 2
+                assert doc["unreachable"] == []
+                code, body = http_get(fleet.url + "/")
+                assert "/podtracez" in json.loads(body)["routes"]
+            finally:
+                fleet.stop()
+        finally:
+            s1.stop()
+            s2.stop()
+
+    def test_podtracez_skips_unreachable_target(self, causal_obs):
+        from large_scale_recommendation_tpu.obs.fleet import (
+            FleetAggregator,
+        )
+        from large_scale_recommendation_tpu.obs.server import ObsServer
+
+        t1 = Tracer()
+        with t1.span("alive/work"):
+            pass
+        s1 = ObsServer(tracer=t1).start()
+        try:
+            agg = FleetAggregator(
+                [s1.url, "http://127.0.0.1:9"], timeout_s=2.0)
+            doc = agg.pod_trace()
+            assert len(doc["podSources"]) == 1
+            assert len(doc["unreachable"]) == 1
+        finally:
+            s1.stop()
+
+    def test_report_renders_critical_path(self, causal_obs, capsys):
+        sys.path.insert(0, "scripts")
+        from obs_report import main as report_main
+
+        _, _, ana = causal_obs
+        ana.note_append(10, t=1.0)
+        ana.note_dequeue(10, t=2.0)
+        ana.note_applied(10, t=2.5)
+        ana.note_swap(1, watermark=10, t=3.0)
+        ana.note_serve(1, t=3.25)
+        import json as _json
+        import tempfile
+
+        with tempfile.NamedTemporaryFile("w", suffix=".json",
+                                         delete=False) as f:
+            _json.dump(ana.snapshot(), f)
+            path = f.name
+        assert report_main(["--critical-path", path]) == 0
+        out = capsys.readouterr().out
+        assert "queue_wait" in out and "flush_wait" in out
+        assert "total" in out

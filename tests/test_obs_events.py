@@ -153,14 +153,19 @@ class TestEventJournal:
         """An enable/disable/enable cycle installs a fresh Tracer; its
         span ids must CONTINUE the sequence, or a journal/bundle
         spanning both cycles joins events against the wrong spans."""
-        from large_scale_recommendation_tpu.obs.trace import Tracer
+        from large_scale_recommendation_tpu.obs.trace import (
+            Tracer,
+            span_seq,
+        )
 
         _, tracer, _, _ = flight_obs
         with tracer.span("a") as a:
             pass
         with Tracer().span("b") as b:  # a "re-enabled" tracer
             pass
-        assert b.id > a.id
+        # ids are namespaced strings; the process-monotonic SEQUENCE
+        # part must continue across tracers
+        assert span_seq(b.id) > span_seq(a.id)
 
     def test_eventz_endpoint(self, flight_obs):
         from large_scale_recommendation_tpu.obs.server import (

@@ -310,6 +310,59 @@ class TestNullPathZeroWork:
         finally:
             set_lineage(prev)
 
+    def test_disttrace_default_off_everywhere(self, null_obs, tmp_path):
+        """The ISSUE-12 extension of the zero-cost pin: with nothing
+        enabled, get_disttrace() is None and every stamping site binds
+        that None — the WAL append, the driver marks, the engine
+        serve-note, the adaptive swap-note — and the default-off
+        tracer means NO context stamps anywhere: batches carry
+        ctx=None, capture_context() is None, and no wal/ingest spans,
+        clock reads or registry names appear."""
+        from large_scale_recommendation_tpu.models.adaptive import (
+            AdaptiveMF,
+            AdaptiveMFConfig,
+        )
+        from large_scale_recommendation_tpu.obs.disttrace import (
+            get_disttrace,
+            set_disttrace,
+        )
+        from large_scale_recommendation_tpu.obs.trace import get_tracer
+        from large_scale_recommendation_tpu.serving.engine import (
+            ServingEngine,
+        )
+        from large_scale_recommendation_tpu.streams.sources import (
+            LogTailSource,
+        )
+
+        prev = get_disttrace()
+        set_disttrace(None)  # an OBS_OUT session runs one suite-wide
+        try:
+            assert get_disttrace() is None
+            assert get_tracer().capture_context() is None
+            log = EventLog(str(tmp_path / "log"))
+            assert log._disttrace is None
+            _fill_log(log, n_batches=1)
+            # default-off tracer ⇒ no per-batch context mints
+            for batch in LogTailSource(log, batch_records=128):
+                assert batch.ctx is None
+                break
+            engine = ServingEngine(_tiny_model(), k=3, max_batch=32)
+            assert engine._disttrace is None
+            model = OnlineMF(OnlineMFConfig(num_factors=4,
+                                            minibatch_size=64))
+            driver = StreamingDriver(model, log, str(tmp_path / "ckpt"))
+            assert driver._disttrace is None
+            assert AdaptiveMF(
+                AdaptiveMFConfig(num_factors=4))._disttrace is None
+            # the whole null stream path still runs clean end to end
+            eng = driver.serving_engine(k=3, max_batch=32)
+            driver.run()
+            driver.refresh_serving()
+            eng.recommend(np.arange(3, dtype=np.int64))
+            assert null_obs.names() == set()
+        finally:
+            set_disttrace(prev)
+
     def test_introspection_default_off_and_funnel_unpatched(
             self, null_obs):
         """The ISSUE-9 extension of the zero-cost pin: with nothing

@@ -553,3 +553,33 @@ class TestQualityFamily:
         assert prefix == "BENCH"
         assert keys is not FAMILIES["bench"][1]
         assert not any(k.startswith("eval_") for k in QUALITY_KEYS)
+
+
+class TestCriticalPathDirection:
+    """ISSUE 12: the ingest→servable critical-path keys gate
+    LOWER-is-better — the PR 7/8-pattern direction/watch-set unit
+    twins for ``critical_path_total_s`` and the per-stage keys."""
+
+    def test_critical_path_keys_lower_is_better(self):
+        from scripts.bench_regress import is_lower_better
+
+        for key in ("critical_path_total_s", "critical_path_s",
+                    "critical_path_swap_lag_s"):
+            assert is_lower_better(key, set()), key
+        rows = compare({"critical_path_total_s": 1.0},
+                       {"critical_path_total_s": 2.0},
+                       {"critical_path_total_s": 30.0})
+        assert rows[0]["verdict"] == "REGRESSION"
+        rows = compare({"critical_path_total_s": 1.0},
+                       {"critical_path_total_s": 0.5},
+                       {"critical_path_total_s": 30.0})
+        assert rows[0]["verdict"] == "ok"
+
+    def test_no_higher_pattern_collision(self):
+        """A critical-path wall must never match a higher-is-better
+        pattern (DEFAULT_HIGHER wins over DEFAULT_LOWER, so a
+        collision would silently flip the gate's direction)."""
+        from scripts.bench_regress import DEFAULT_HIGHER
+
+        for key in ("critical_path_total_s", "critical_path_s"):
+            assert not any(pat in key for pat in DEFAULT_HIGHER), key

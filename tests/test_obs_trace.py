@@ -47,7 +47,20 @@ class TestSpans:
         assert outer["args"] == {"kind": "a", "span_id": outer["args"]
                                  ["span_id"]}
         ids = {e["args"]["span_id"] for e in events}
-        assert len(ids) == 3 and all(isinstance(i, int) for i in ids)
+        # span ids are (host, pid)-NAMESPACED strings — pod-merged
+        # artifacts can never collide (ISSUE 12)
+        from large_scale_recommendation_tpu.obs.trace import (
+            process_namespace,
+        )
+
+        assert len(ids) == 3
+        assert all(isinstance(i, str)
+                   and i.startswith(process_namespace() + ":")
+                   for i in ids)
+        # the nested span exports its parent's id — the causal link
+        # the distributed assembler walks
+        assert inner["args"]["parent_span_id"] == outer["args"]["span_id"]
+        assert "parent_span_id" not in outer["args"]  # top-level span
         assert outer["tid"] == inner["tid"]
 
     def test_threads_get_independent_stacks(self, tracer):

@@ -64,3 +64,7 @@ class TestPodShapedMesh:
             # both processes' /metrics+/healthz through obs.fleet over
             # real sockets and the aggregate passed its asserts
             assert two.get("fleet_ok"), two
+            # the distributed-tracing half (ISSUE 12): the merged pod
+            # trace validated and a sampled record resolved to one
+            # assembled trace across the process boundary
+            assert two.get("trace_ok"), two
