@@ -144,6 +144,16 @@ class AdaptiveMF:
 
             self._manager = CheckpointManager(cfg.checkpoint_dir)
         self._batches_since_ckpt = 0
+        # parallel-ingest mode (streams/parallel.py): N per-partition
+        # consumers feed process() from N threads. The adaptive layer's
+        # state machine (history union, Batch-state buffer, retrain
+        # trigger counter) is inherently ORDERED, so concurrency here
+        # serializes the apply itself on one lock — the WAL tail, the
+        # quarantine/queue work and the host batch prep still overlap
+        # across consumers. OFF by default: the single-driver path
+        # never acquires it.
+        self._serialize_process = False
+        self.apply_lock = threading.RLock()
 
     # -- state -------------------------------------------------------------
 
@@ -166,6 +176,25 @@ class AdaptiveMF:
 
     # -- ingest ------------------------------------------------------------
 
+    def enable_concurrent_applies(self, enabled: bool = True) -> None:
+        """Arm multi-consumer ingest (``ParallelIngestRunner``): each
+        ``process`` call serializes on ``apply_lock``. Unlike the pure
+        ``OnlineMF`` row-disjoint concurrent path, the adaptive combo
+        cannot commute applies — history order, the retrain trigger
+        counter and the Batch-state buffer are one shared sequence — so
+        the parallelism N consumers buy here is the ingest pipeline
+        AROUND the apply (per-partition WAL tails, quarantine, batch
+        prep), not the apply itself. The frozen-offset-stamp contract
+        is unchanged: batches buffered during a background retrain keep
+        per-partition stamps frozen, and the runner's cross-partition
+        checkpoint barrier holds until every partition's stamp catches
+        its applied frontier."""
+        self._serialize_process = bool(enabled)
+
+    @property
+    def concurrent_applies(self) -> bool:
+        return self._serialize_process
+
     def process(self, batch: Ratings,
                 offset: tuple[int, int] | None = None) -> BatchUpdates:
         """One micro-batch through the adaptive pipeline.
@@ -178,6 +207,13 @@ class AdaptiveMF:
         retrain keep their stamps and apply them in replay order, so the
         checkpointed offset never claims a buffered-but-unapplied batch.
         """
+        if self._serialize_process:
+            with self.apply_lock:
+                return self._process(batch, offset)
+        return self._process(batch, offset)
+
+    def _process(self, batch: Ratings,
+                 offset: tuple[int, int] | None = None) -> BatchUpdates:
         cfg = self.config
         self._append_history(batch)
 

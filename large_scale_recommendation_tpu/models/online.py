@@ -31,9 +31,11 @@ exactly the user/item vectors touched by the batch (≙ emitting
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from typing import Any, Iterable, Iterator
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -54,6 +56,17 @@ from large_scale_recommendation_tpu.obs.registry import get_registry
 from large_scale_recommendation_tpu.obs.trace import get_tracer
 from large_scale_recommendation_tpu.ops import sgd as sgd_ops
 from large_scale_recommendation_tpu.utils.shapes import pow2_pad
+
+
+@jax.jit
+def _commit_rows(cur: jax.Array, src: jax.Array,
+                 idx: jax.Array) -> jax.Array:
+    """Concurrent-apply commit: install ``src``'s rows ``idx`` into the
+    live table ``cur`` — one fused gather+scatter executable instead of
+    two eager dispatches under the apply lock. Compiles once per
+    (capacity, pow2-padded-index) pair, the same bounded shape family
+    as every other table op."""
+    return cur.at[idx].set(src[idx])
 
 
 @dataclasses.dataclass(frozen=True)
@@ -180,9 +193,32 @@ class OnlineMF:
         # ``utils.checkpoint.save_online_state`` — the pair is what
         # makes a restart replay exactly the unconsumed log tail.
         self.consumed_offsets: dict[int, int] = {}
-        # reusable padding buffers keyed by padded length (bounded: padded
-        # lengths are pow2 buckets of the minibatch)
-        self._pad_buffers: dict[int, tuple] = {}
+        # concurrent-apply mode (streams/parallel.py, ISSUE 13): OFF by
+        # default — the serial path below is byte-for-byte the
+        # historical one, no lock acquisitions on its hot path. When
+        # enabled, partial_fit routes through _partial_fit_concurrent:
+        # table mutation (ensure/snapshot/commit) serializes on
+        # apply_lock while the jitted update computes OUTSIDE it, and
+        # the commit scatters only the batch's TOUCHED rows into the
+        # live tables — exact under the row-disjointness the caller's
+        # RowConflictGate enforces (two concurrent applies never share
+        # a user or item row between snapshot and commit).
+        self._concurrent = False
+        self.apply_lock = threading.RLock()
+        # optional RowConflictGate (streams.parallel): when set, the
+        # concurrent path holds a claim on the batch's user+item ids
+        # for the whole snapshot→commit window — genuinely colliding
+        # batches serialize against each other, disjoint ones overlap
+        self.apply_gate = None
+        # NOTE: partial_fit deliberately does NOT reuse padding staging
+        # buffers across calls. jnp.asarray zero-copy ALIASES aligned
+        # numpy buffers on the CPU backend, and dispatch is async — a
+        # reused buffer's next fill is a write racing the previous
+        # batch's in-flight kernel read. Measured: whole-partition
+        # factor divergence under the N-consumer runner (ISSUE 13);
+        # the single-thread window is narrower but just as real.
+        # Fresh arrays per batch cost ~µs of alloc and are kept alive
+        # by the aliasing device array itself.
         # divergence guard (obs.health.TrainingWatchdog) — attach one to
         # get NaN/Inf scans on each batch's touched rows, tripped BEFORE
         # the WAL offset stamp so a halted/rolled-back batch can never
@@ -202,6 +238,21 @@ class OnlineMF:
         self._m_ratings = obs.counter("online_ratings_total")
 
     # -- training ----------------------------------------------------------
+
+    def enable_concurrent_applies(self, enabled: bool = True) -> None:
+        """Route ``partial_fit`` through the snapshot/commit concurrent
+        path (``streams.parallel.ParallelIngestRunner`` arms this for
+        N > 1 consumers). The CALLER owns conflict-freedom: two applies
+        may run concurrently only when their (user, item) row sets are
+        disjoint — ``streams.parallel.RowConflictGate`` is the guard —
+        because each commit writes back only its own touched rows.
+        Disjoint-row applies commute bit-exactly (the Gemulla stratum
+        argument), so any interleaving equals some serial order."""
+        self._concurrent = bool(enabled)
+
+    @property
+    def concurrent_applies(self) -> bool:
+        return self._concurrent
 
     def partial_fit(self, batch: Ratings,
                     iterations: int | None = None,
@@ -226,6 +277,10 @@ class OnlineMF:
         even for an all-padding batch: the stream position advanced
         regardless of how many real ratings the slice held.
         """
+        if self._concurrent:
+            return self._partial_fit_concurrent(
+                batch, iterations=iterations, emit_updates=emit_updates,
+                offset=offset)
         cfg = self.config
         ru, ri, rv, rw = batch.to_numpy()
         real = rw > 0
@@ -254,7 +309,6 @@ class OnlineMF:
 
         ur, ir, vals, w = sgd_ops.pad_minibatches(
             u_rows, i_rows, rv, cfg.minibatch_size,
-            buffers=self._pad_buffers,
         )
 
         # compile-keyed span: each pow2-padded batch length compiles its
@@ -317,6 +371,146 @@ class OnlineMF:
         return BatchUpdates(
             user_arrays=(uniq_u.astype(np.int64), u_vecs),
             item_arrays=(uniq_i.astype(np.int64), i_vecs),
+        )
+
+    def _partial_fit_concurrent(self, batch: Ratings,
+                                iterations: int | None = None,
+                                emit_updates: bool = True,
+                                offset: tuple[int, int] | None = None,
+                                ) -> BatchUpdates | None:
+        """The concurrent-apply twin of ``partial_fit``: table mutation
+        serializes on ``apply_lock``, the jitted update computes on a
+        SNAPSHOT outside it, and the commit scatters only this batch's
+        touched rows back into the live tables. Correct iff no
+        concurrent apply shares a row between snapshot and commit — the
+        row-disjointness ``RowConflictGate`` enforces. A snapshot's
+        untouched rows may go stale underneath (another consumer's
+        commit, a table growth); neither matters: our touched rows are
+        claimed, and growth preserves row indices. The watchdog (when
+        attached) scans BEFORE the commit, so a tripped batch never
+        reaches the live tables at all — strictly earlier than the
+        serial path's post-install scan."""
+        cfg = self.config
+        ru, ri, rv, rw = batch.to_numpy()
+        real = rw > 0
+        ru, ri, rv = ru[real], ri[real], rv[real]
+        if len(ru) == 0:
+            if offset is not None:
+                with self.apply_lock:
+                    self.consumed_offsets[int(offset[0])] = int(offset[1])
+            return (BatchUpdates([], [], rank=cfg.num_factors)
+                    if emit_updates else None)
+
+        token = None
+        if self.apply_gate is not None:
+            # claim the batch's id sets for the snapshot→commit window:
+            # row-disjoint batches are granted concurrently, a genuine
+            # collision waits for exactly the colliding apply — never
+            # the whole stream
+            token = self.apply_gate.acquire(np.unique(ru), np.unique(ri))
+        try:
+            return self._apply_concurrent(
+                ru, ri, rv, iterations=iterations,
+                emit_updates=emit_updates, offset=offset)
+        finally:
+            if token is not None:
+                self.apply_gate.release(token)
+
+    def _apply_concurrent(self, ru, ri, rv, iterations=None,
+                          emit_updates=True, offset=None):
+        cfg = self.config
+        t0 = time.perf_counter() if self._obs_on else 0.0
+        ev = self._events
+        with self.apply_lock:
+            if ev is not None:
+                cap_u = self.users.capacity
+                cap_i = self.items.capacity
+            u_rows = self.users.ensure(ru)
+            i_rows = self.items.ensure(ri)
+            grew = ev is not None and (self.users.capacity != cap_u
+                                       or self.items.capacity != cap_i)
+            U0 = self.users.array  # immutable jax arrays: the snapshot
+            V0 = self.items.array  # is two refs, zero copies
+        if grew:
+            ev.emit("online.table_growth", step=self.step,
+                    users_capacity=int(self.users.capacity),
+                    items_capacity=int(self.items.capacity))
+
+        ur, ir, vals, w = sgd_ops.pad_minibatches(
+            u_rows, i_rows, rv, cfg.minibatch_size)
+
+        with self._trace.span("online/partial_fit",
+                              key=("online_train", len(ur)),
+                              records=len(ru)) as sp:
+            U, V = sgd_ops.online_train(
+                U0, V0,
+                jnp.asarray(ur), jnp.asarray(ir),
+                jnp.asarray(vals), jnp.asarray(w),
+                updater=self.updater,
+                minibatch=cfg.minibatch_size,
+                iterations=(iterations if iterations is not None
+                            else cfg.iterations_per_batch),
+                collision=cfg.collision_mode,
+            )
+            sp.out = U
+        if self.watchdog is not None:
+            # BEFORE the commit and the offset stamp: a tripped batch
+            # never touches the live tables and can never checkpoint
+            self.watchdog.after_batch(self, U, V, u_rows, i_rows)
+
+        uniq_u = np.unique(u_rows)
+        uniq_i = np.unique(i_rows)
+
+        def touched_idx(rows_uniq: np.ndarray):
+            # pow2-padded with a REPEATED OWN row (never row 0: that
+            # row may belong to another consumer's in-flight claim, and
+            # a duplicate-index scatter of a foreign row's stale value
+            # would corrupt it — duplicates of our own row write our
+            # own value, idempotent)
+            n = len(rows_uniq)
+            idx = np.full(pow2_pad(n), rows_uniq[0], np.int64)
+            idx[:n] = rows_uniq
+            return jnp.asarray(idx)
+
+        ju = touched_idx(uniq_u)
+        ji = touched_idx(uniq_i)
+        with self.apply_lock:
+            # fused gather+scatter of OUR rows into the LIVE tables
+            # (maybe grown / maybe carrying other consumers' disjoint
+            # commits since our snapshot) — one executable per table,
+            # dispatched under the lock, drained outside it
+            self.users.array = _commit_rows(self.users.array, U, ju)
+            self.items.array = _commit_rows(self.items.array, V, ji)
+            self.step += 1
+            if offset is not None:
+                # stamped only with the update COMMITTED — the same
+                # invariant the serial path keeps, same checkpoint
+                # contract on top
+                self.consumed_offsets[int(offset[0])] = int(offset[1])
+            committed = self.users.array
+        if self._obs_on:
+            committed.block_until_ready()  # outside the lock: blocking
+            # under apply_lock would serialize the overlap this mode
+            # exists to provide
+            self._m_batch_s.observe(time.perf_counter() - t0)
+            self._m_batches.inc()
+            self._m_ratings.inc(len(ru))
+        if not emit_updates:
+            return None
+
+        def updates_for(ids, rows, rows_uniq, src, jidx):
+            # id-aligned updates: rows are first-seen-ordered, not
+            # id-ordered, so map each sorted-unique id's row to its
+            # position in the sorted-unique ROW gather of the computed
+            # table (== the values the commit above installed)
+            vals = np.asarray(src[jidx])
+            uniq_ids, first = np.unique(ids, return_index=True)
+            pos = np.searchsorted(rows_uniq, rows[first])
+            return uniq_ids.astype(np.int64), vals[pos]
+
+        return BatchUpdates(
+            user_arrays=updates_for(ru, u_rows, uniq_u, U, ju),
+            item_arrays=updates_for(ri, i_rows, uniq_i, V, ji),
         )
 
     def run(

@@ -338,7 +338,13 @@ def pad_minibatches(
 
     The pow2 bucket bounds the jitted kernel to O(log n) compiled shape
     variants on variable-size batches. ``buffers`` (optional dict keyed by
-    padded length) reuses the four numpy staging arrays across calls.
+    padded length) reuses the four numpy staging arrays across calls —
+    ONLY safe when the caller guarantees the previous dispatch that
+    consumed them has completed: ``jnp.asarray`` zero-copy ALIASES
+    aligned numpy buffers on the CPU backend, so refilling a reused
+    buffer races an in-flight async kernel's read of it (measured as
+    factor divergence under concurrent consumers, ISSUE 13 — the
+    streaming ``partial_fit`` paths therefore allocate fresh).
     Returns ``(ur, ir, vals, w)`` int32/int32/float32/float32 of the padded
     length.
     """

@@ -186,7 +186,14 @@ class ServingEngine:
         self._lock = threading.RLock()
         self.stats = {"requests": 0, "rows": 0, "microbatches": 0,
                       "flushes": 0, "refreshes": 0, "delta_swaps": 0,
+                      "deferred_delta_rows": 0, "delta_flushes": 0,
                       "buckets": {}}
+        # swap-coalescing buffers (apply_delta(defer=True)): row →
+        # newest full-precision vector, installed as ONE swap by
+        # flush_deltas() — how N ingest consumers ship deltas without
+        # N version bumps thrashing the catalog (ISSUE 13)
+        self._pending_items: dict[int, np.ndarray] = {}
+        self._pending_users: dict[int, np.ndarray] = {}
         self.meter = ThroughputMeter()
         # observability binds at CONSTRUCTION: with the default null
         # registry the handles below are shared no-op singletons and
@@ -284,6 +291,12 @@ class ServingEngine:
         if model is not None:
             self.model = model
         model = self.model
+        # a full rebuild supersedes anything still deferred: the new
+        # snapshot already carries every row's current value, and a
+        # later flush_deltas() scattering stale pre-refresh vectors
+        # over it would silently revert rows
+        self._pending_items.clear()
+        self._pending_users.clear()
         self._item_ids_of_row = np.asarray(model.items.ids)
         item_mask = self._item_ids_of_row >= 0
         if self._retrieval_cfg is not None:
@@ -326,7 +339,8 @@ class ServingEngine:
         return self.version
 
     def apply_delta(self, item_rows=None, V_rows=None,
-                    user_rows=None, U_rows=None) -> int:
+                    user_rows=None, U_rows=None,
+                    defer: bool = False) -> int:
         """Install ONLY the touched factor rows — the streaming
         ingest→serve handoff without a whole-table rebuild. ``*_rows``
         are indices into the bound model's row space (geometry must be
@@ -338,7 +352,44 @@ class ServingEngine:
         fast path re-quantizes exactly the dirty int8 rows. Zero
         recompiles — executables are keyed on shapes, and a delta
         never changes one. Returns the new catalog version (reported
-        to ``on_refresh``, same as a full refresh)."""
+        to ``on_refresh``, same as a full refresh).
+
+        ``defer=True`` is the swap-COALESCING form: the rows buffer
+        (newest value per row wins) instead of installing, and the next
+        ``flush_deltas()`` installs everything pending as ONE swap —
+        one scatter per table, one version bump, one lineage stamp —
+        however many consumers shipped deltas in between. Deferred rows
+        are invisible to serving until that flush (the freshness the
+        coalescing window trades for not thrashing catalog versions);
+        the flushed state is bit-equal to applying each delta eagerly
+        in arrival order. Returns the (unchanged) current version."""
+        if defer:
+            with self._lock:
+                sides = []
+                for rows, vals, bound, pending, what in (
+                        (item_rows, V_rows, int(self.model.V.shape[0]),
+                         self._pending_items, "catalog"),
+                        (user_rows, U_rows, int(self.model.U.shape[0]),
+                         self._pending_users, "table")):
+                    if rows is None or not len(rows):
+                        continue
+                    rows = np.asarray(rows)
+                    if rows.max() >= bound:
+                        # the loud vocab-growth error must fire at
+                        # defer time, not surface later from an
+                        # unrelated flush — and BEFORE either side
+                        # buffers, so a rejected delta never leaves a
+                        # torn half pending
+                        raise ValueError(
+                            f"delta row {int(rows.max())} outside "
+                            f"{what} of {bound} rows — vocab grew; "
+                            f"use refresh()")
+                    sides.append((rows, np.asarray(vals), pending))
+                for rows, vals, pending in sides:
+                    for j, r in enumerate(rows.tolist()):
+                        pending[int(r)] = vals[j]
+                    self.stats["deferred_delta_rows"] += len(rows)
+                return self.version
         swap_detail = None
         with self._lock:
             model = self.model
@@ -396,6 +447,46 @@ class ServingEngine:
             # journaled OUTSIDE the engine lock, same rule as refresh()
             self._events.emit("serving.catalog_delta", **swap_detail)
         return version
+
+    def flush_deltas(self) -> int:
+        """Install every ``apply_delta(defer=True)`` row pending as ONE
+        swap (no-op when nothing is pending). Deltas deferred AFTER the
+        pending set is taken ride the next flush — never lost. Returns
+        the catalog version serving now runs on.
+
+        The (re-entrant) engine lock is held across take AND install:
+        releasing between them would let a full ``refresh()`` land in
+        the gap and then be overwritten by the already-taken stale rows
+        — the silent row reversion the refresh-clears-pending rule
+        exists to prevent. The one cost is that the install's journal
+        emit runs under the lock on THIS (rare, coalescing) path; the
+        common direct ``apply_delta``/``refresh`` paths keep the
+        emit-outside-lock discipline."""
+        with self._lock:
+            items, self._pending_items = self._pending_items, {}
+            users, self._pending_users = self._pending_users, {}
+            if not items and not users:
+                return self.version
+            self.stats["delta_flushes"] += 1
+
+            def pack(pending):
+                if not pending:
+                    return None, None
+                rows = np.fromiter(pending.keys(), np.int64,
+                                   len(pending))
+                return rows, np.stack([pending[int(r)] for r in rows])
+
+            i_rows, i_vals = pack(items)
+            u_rows, u_vals = pack(users)
+            return self.apply_delta(item_rows=i_rows, V_rows=i_vals,
+                                    user_rows=u_rows, U_rows=u_vals)
+
+    @property
+    def pending_delta_rows(self) -> int:
+        """Rows buffered by ``apply_delta(defer=True)`` awaiting the
+        next ``flush_deltas()``."""
+        with self._lock:
+            return len(self._pending_items) + len(self._pending_users)
 
     @property
     def version(self) -> int:

@@ -12,11 +12,21 @@ TPU port was missing (docs/STREAMING.md is the narrative):
     driver   StreamingDriver: log → OnlineMF/AdaptiveMF micro-batches →
              ServingEngine catalog swaps, with the consumed WAL offset
              checkpointed atomically alongside (U, V, step)
+    parallel ParallelIngestRunner: N per-partition consumers over one
+             shared model — row-disjoint concurrent applies
+             (RowConflictGate), a cross-partition checkpoint barrier,
+             coalesced delta shipping into serving
 """
 
 from large_scale_recommendation_tpu.streams.driver import (
     StreamingDriver,
     StreamingDriverConfig,
+)
+from large_scale_recommendation_tpu.streams.parallel import (
+    ParallelIngestRunner,
+    RowConflictGate,
+    append_routed,
+    route_partition,
 )
 from large_scale_recommendation_tpu.streams.log import (
     EventLog,
@@ -42,10 +52,14 @@ __all__ = [
     "IngestQueue",
     "LogTailSource",
     "LogTruncatedError",
+    "ParallelIngestRunner",
     "QueuedSource",
+    "RowConflictGate",
     "StreamBatch",
     "StreamingDriver",
     "StreamingDriverConfig",
+    "append_routed",
     "pump_to_log",
+    "route_partition",
     "split_poison",
 ]

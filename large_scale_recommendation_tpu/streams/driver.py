@@ -81,14 +81,18 @@ class StreamingDriverConfig:
     ``checkpoint_every`` is the duplication bound: a crash replays at
     most that many micro-batches (default 1 → ≤ one duplicated
     micro-batch; raise it to trade recovery duplication for checkpoint
-    I/O on very fast streams). ``truncate_log`` opts into retention:
-    after each checkpoint the log retires segments wholly below the
-    checkpointed offset — never beyond it, so the replay tail always
-    exists.
+    I/O on very fast streams). ``None`` hands checkpointing to an
+    EXTERNAL coordinator: the driver never snapshots on its own — the
+    ``streams.parallel.ParallelIngestRunner`` barrier owns the atomic
+    cross-partition ``{partition: offset}`` + (U, V, step) commit, and
+    N drivers each writing their own snapshot would race it.
+    ``truncate_log`` opts into retention: after each checkpoint the log
+    retires segments wholly below the checkpointed offset — never
+    beyond it, so the replay tail always exists.
     """
 
     batch_records: int = 4096
-    checkpoint_every: int = 1
+    checkpoint_every: int | None = 1
     checkpoint_keep: int = 3
     queue_capacity: int = 16
     queue_policy: str = "block"
@@ -281,7 +285,15 @@ class StreamingDriver:
         at all on restart.
         """
         cfg = self.config
-        self._stop.clear()
+        if self._stop.is_set():
+            # a stop delivered BEFORE the loop started (the parallel
+            # runner's stop() racing a consumer thread that hasn't
+            # entered run() yet) must win: clearing it unconditionally
+            # erased the request and a follow-mode loop ran forever.
+            # The pending stop is consumed — the run after this one
+            # starts fresh.
+            self._stop.clear()
+            return 0
         tail = LogTailSource(
             self.log, self.partition, start_offset=self.consumed_offset,
             batch_records=cfg.batch_records, follow=follow,
@@ -313,8 +325,11 @@ class StreamingDriver:
         # re-raise inside batches() — and it must land BEFORE the final
         # checkpoint, same as any other runtime fault
         self._source.finish()
-        if self._since_checkpoint:
+        if self._since_checkpoint and self.config.checkpoint_every is not None:
             self.checkpoint()
+        # a stop consumed by THIS run must not leak into the next one
+        # (the entry check above would silently no-op it)
+        self._stop.clear()
         return applied
 
     def _apply(self, batch: StreamBatch) -> None:
@@ -403,7 +418,8 @@ class StreamingDriver:
             # the first post-swap batch (stamp advanced past it) writes
             # one checkpoint covering everything replayed.
             return
-        if self._since_checkpoint >= self.config.checkpoint_every:
+        if (self.config.checkpoint_every is not None
+                and self._since_checkpoint >= self.config.checkpoint_every):
             self.checkpoint()
 
     def stop(self) -> None:

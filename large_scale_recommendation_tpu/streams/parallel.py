@@ -1,0 +1,729 @@
+"""Parallel ingest: N per-partition consumers feeding sharded training.
+
+The WAL has been partitioned since the durable ingest tier landed, yet
+``StreamingDriver`` drains exactly one partition through one consumer
+loop — the last serial stage between heavy producer traffic and the
+training kernels. This module is the N-consumer runtime on top of the
+SAME durable pieces:
+
+- **one consumer per partition** — ``ParallelIngestRunner`` composes N
+  ``StreamingDriver``s (one per WAL partition), each tailing its own
+  ``EventLog`` partition through its own ``QueuedSource``/``IngestQueue``
+  on its own thread, all feeding ONE shared model. Every per-batch
+  plane the single driver already carries rides along unchanged:
+  ``TraceContext`` activation, per-partition ``LineageJournal`` ingest
+  watermarks, ``CriticalPathAnalyzer`` marks, the (shared)
+  ``DataQualityInspector``/``OnlineEvaluator`` chain, per-partition
+  ``streams_*`` gauges.
+- **conflict-free concurrent applies** — Gemulla's stratum-independence
+  argument (the DSGD foundation): SGD updates touching disjoint user
+  AND item rows commute exactly, so row-disjoint micro-batches may
+  apply concurrently in any order. Producers make disjointness the
+  common case by ROUTING records to partitions by user block
+  (``route_partition``); the ``RowConflictGate`` is the fallback that
+  makes it safe regardless — a batch claims its (user, item) id sets
+  for the snapshot→commit window and only a GENUINELY colliding batch
+  waits (for exactly the colliding apply, never the whole stream).
+  ``OnlineMF.enable_concurrent_applies`` provides the snapshot/commit
+  apply this rests on; an ``AdaptiveMF`` serializes the apply itself
+  (history/retrain order is one shared sequence) and parallelizes the
+  pipeline around it.
+- **cross-partition checkpoint barrier** — the PR 2 durability contract
+  at N consumers: one atomic snapshot commits ``{partition: offset}``
+  for ALL partitions together with (U, V, step), captured under the
+  model's ``apply_lock`` (``snapshot_online_state``) so no commit can
+  interleave between the tables and the offsets that claim them. The
+  barrier fires when any partition accumulates ``checkpoint_every``
+  applied batches since the last one, so kill/restart replays each
+  partition's tail independently with zero loss and a per-partition
+  duplicate window ≤ ``checkpoint_every`` batches. While a background
+  retrain freezes the offset stamps, the barrier HOLDS (it could only
+  re-persist pre-retrain offsets) and the first post-swap batch whose
+  stamps catch their frontiers writes one covering snapshot — the
+  single-driver rule, generalized to all partitions at once.
+- **delta shipping with swap coalescing** — ``refresh_serving`` takes
+  every consumer's dirty ids, ships each partition's rows into the
+  engines as DEFERRED deltas (``ServingEngine.apply_delta(defer=True)``)
+  and flushes once: one scatter per table, ONE catalog version bump per
+  engine per refresh, however many consumers contributed — N consumers
+  cannot thrash catalog versions. Concurrent refresh requests coalesce
+  too (an in-flight refresh absorbs them and re-runs once). Every
+  refresh stamps per-partition watermarks into the lineage journal and
+  the critical-path analyzer through each driver's ``_note_swap``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Iterable
+
+import numpy as np
+
+from large_scale_recommendation_tpu.streams.driver import (
+    StreamingDriver,
+    StreamingDriverConfig,
+)
+from large_scale_recommendation_tpu.streams.log import EventLog
+from large_scale_recommendation_tpu.streams.sources import StreamBatch
+from large_scale_recommendation_tpu.utils.checkpoint import (
+    CheckpointManager,
+    restore_online_state,
+    snapshot_online_state,
+)
+
+
+def route_partition(user_ids, num_partitions: int) -> np.ndarray:
+    """Partition of each record under user-block routing: all of one
+    user's ratings land in one partition, so two partitions' batches
+    never share a USER row — half of the stratum-disjointness the
+    concurrent applies want (item disjointness depends on the catalog
+    interaction structure; the ``RowConflictGate`` covers the rest)."""
+    if num_partitions < 1:
+        raise ValueError(f"num_partitions must be >= 1, "
+                         f"got {num_partitions}")
+    return np.asarray(user_ids, dtype=np.int64) % num_partitions
+
+
+def append_routed(log: EventLog, users, items, ratings) -> int:
+    """Append one producer batch routed across the log's partitions by
+    user block (``route_partition``). Returns the records appended —
+    the producer half of the N-consumer topology."""
+    users = np.asarray(users)
+    items = np.asarray(items)
+    ratings = np.asarray(ratings)
+    parts = route_partition(users, log.num_partitions)
+    total = 0
+    for p in range(log.num_partitions):
+        sel = parts == p
+        if not sel.any():
+            continue
+        start, end = log.append_arrays(p, users[sel], items[sel],
+                                       ratings[sel])
+        total += end - start
+    return total
+
+
+class RowConflictGate:
+    """Admission gate for concurrent row-disjoint applies.
+
+    ``acquire(user_ids, item_ids)`` blocks until the claimed id sets
+    are disjoint from every in-flight claim, then holds them until
+    ``release``. Disjoint batches are granted immediately and overlap;
+    only a batch that GENUINELY collides (shares a user or item id with
+    an in-flight apply) waits — and it waits for that apply, not for
+    the stream. One condition variable, both sets claimed atomically:
+    no partial holds, no lock ordering, no deadlock. A waiter may be
+    bypassed by newer disjoint batches (admission is not FIFO); every
+    grant is finite, so it is eventually admitted.
+
+    ``grants``/``waits`` count admissions and blocked attempts — the
+    telemetry that says whether a workload's routing actually delivers
+    disjointness or the gate is serializing it.
+    """
+
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._users: set[int] = set()
+        self._items: set[int] = set()
+        self.grants = 0
+        self.waits = 0
+
+    def acquire(self, user_ids, item_ids) -> tuple[set, set]:
+        # tolist() then set(): both C-speed — a Python comprehension
+        # over tens of thousands of ids holds the GIL for milliseconds
+        # PER BATCH, which is pure serial time stolen from every other
+        # consumer thread
+        u = set(np.asarray(user_ids).ravel().tolist())
+        i = set(np.asarray(item_ids).ravel().tolist())
+        with self._cv:
+            waited = False
+            while not (u.isdisjoint(self._users)
+                       and i.isdisjoint(self._items)):
+                if not waited:
+                    self.waits += 1
+                    waited = True
+                self._cv.wait()
+            self._users |= u
+            self._items |= i
+            self.grants += 1
+        return u, i
+
+    def release(self, token: tuple[set, set]) -> None:
+        u, i = token
+        with self._cv:
+            self._users -= u
+            self._items -= i
+            self._cv.notify_all()
+
+    def in_flight(self) -> tuple[int, int]:
+        with self._cv:
+            return len(self._users), len(self._items)
+
+
+class ParallelIngestRunner:
+    """N per-partition consumers over one shared model.
+
+    ``partitions`` defaults to every partition of ``log``. With more
+    than one consumer the runner arms the model's concurrent-apply mode
+    (``OnlineMF``: row-disjoint snapshot/commit applies behind a shared
+    ``RowConflictGate``; ``AdaptiveMF``: serialized applies, parallel
+    pipeline) and takes ownership of checkpointing: every member driver
+    runs with ``checkpoint_every=None`` and the runner's barrier writes
+    the one atomic all-partition snapshot (``checkpoint_every`` batches
+    of ANY partition between barriers; per-partition duplicate window
+    after a kill ≤ that many batches). ``inspector``/``evaluator`` are
+    SHARED across consumers — the arrival-skew gauge needs one
+    inspector seeing all N partitions' feeds (a starved partition is
+    invisible to a per-consumer inspector).
+    """
+
+    def __init__(self, model: Any, log: EventLog, checkpoint_dir: str,
+                 partitions: Iterable[int] | None = None,
+                 config: StreamingDriverConfig | None = None,
+                 checkpoint_every: int | None = None,
+                 on_batch: Callable[[StreamBatch], None] | None = None,
+                 inspector: Any = None, evaluator: Any = None):
+        from large_scale_recommendation_tpu.models.adaptive import (
+            AdaptiveMF,
+        )
+
+        self.model = model
+        self.log = log
+        self.config = cfg = config or StreamingDriverConfig()
+        # the barrier cadence: defaults to the member config's own
+        # checkpoint_every (the single-driver duplication bound,
+        # reinterpreted per partition)
+        self.checkpoint_every = (cfg.checkpoint_every if checkpoint_every
+                                 is None else checkpoint_every)
+        if self.checkpoint_every is None:
+            self.checkpoint_every = 1
+        self.partitions = (list(range(log.num_partitions))
+                           if partitions is None else
+                           [int(p) for p in partitions])
+        if len(set(self.partitions)) != len(self.partitions):
+            raise ValueError(f"duplicate partitions: {self.partitions}")
+        self._adaptive = isinstance(model, AdaptiveMF)
+        self._online = model.online if self._adaptive else model
+        # the lock that excludes in-flight applies while a consistent
+        # snapshot is captured: the ADAPTIVE apply lock when the model
+        # is adaptive (its serialized process() holds it around the
+        # whole apply — the online model's serial partial_fit inside
+        # never takes the online lock), the online commit lock for the
+        # pure concurrent path
+        self._apply_lock = (model.apply_lock if self._adaptive
+                            else self._online.apply_lock)
+        self.on_batch = on_batch
+        self.manager = CheckpointManager(checkpoint_dir,
+                                         keep=cfg.checkpoint_keep)
+        self.gate: RowConflictGate | None = None
+        if len(self.partitions) > 1:
+            if self._adaptive:
+                model.enable_concurrent_applies()
+            else:
+                self.gate = RowConflictGate()
+                model.apply_gate = self.gate
+                model.enable_concurrent_applies()
+        # member drivers NEVER checkpoint on their own
+        # (checkpoint_every=None) — the barrier below owns the atomic
+        # cross-partition commit
+        member_cfg = dataclasses.replace(cfg, checkpoint_every=None)
+        self.drivers = {
+            p: StreamingDriver(model, log, checkpoint_dir, partition=p,
+                               config=member_cfg,
+                               on_batch=self._hook_for(p),
+                               inspector=inspector, evaluator=evaluator)
+            for p in self.partitions
+        }
+        self.inspector = inspector
+        self.evaluator = evaluator
+        # barrier state: applied frontier + batches-since-barrier per
+        # partition; one lock for the trigger accounting (held briefly
+        # per batch — the snapshot itself is taken under the MODEL's
+        # apply_lock, and the npz write happens outside both)
+        self._barrier_lock = threading.Lock()
+        # serializes the (slow) snapshot WRITES: captures overlap with
+        # applies by design, but two in-flight npz writes would race
+        # the manager's retention sweep
+        self._write_lock = threading.Lock()
+        self._frontier: dict[int, int] = {}
+        self._since_barrier: dict[int, int] = {p: 0
+                                               for p in self.partitions}
+        self.checkpoints_written = 0
+        self.barriers_held = 0  # frozen-stamp holds (background retrain)
+        # serving: the runner owns the engine list; each member driver
+        # carries the engines too (for per-batch dirty-id tracking and
+        # per-partition swap stamps), but ONLY the runner swaps them
+        self._engines: list = []
+        self.catalog_versions: list[int] = []
+        self._refresh_lock = threading.Lock()
+        self._refreshing = False
+        # None = nothing pending; (delta,) = a coalesced request (the
+        # 1-tuple keeps delta=None distinguishable from "no request")
+        self._refresh_pending: tuple | None = None
+        self.refreshes_coalesced = 0
+        self._threads: list[threading.Thread] = []
+        self._error: BaseException | None = None
+        from large_scale_recommendation_tpu.obs.events import get_events
+        from large_scale_recommendation_tpu.obs.registry import (
+            get_registry,
+        )
+
+        obs = get_registry()
+        self._obs = obs
+        self._obs_on = obs.enabled
+        self._events = get_events()
+        self._m_barriers = obs.counter("streams_barrier_checkpoints_total")
+        self._m_ckpt = obs.histogram("streams_checkpoint_s",
+                                     partition="all")
+
+    # -- recovery ------------------------------------------------------------
+
+    def resume(self) -> bool:
+        """Restore the latest all-partition (factors, step,
+        ``{partition: offset}``) snapshot. Each partition's next run
+        re-tails from ITS restored offset — replay is per partition,
+        loss is zero, duplication is bounded per partition by the
+        barrier cadence. Rebuilds an ``AdaptiveMF``'s host-memory
+        retrain history from every partition's retained tail below its
+        restored offset (one clear, N refills — the per-driver refill
+        would clear its siblings' rows)."""
+        if self.manager.latest_step() is None:
+            return False
+        restore_online_state(self.manager, self._online)
+        with self._barrier_lock:
+            for p in self.partitions:
+                off = self._online.consumed_offsets.get(p)
+                if off is not None:
+                    self._frontier[p] = off
+        if self._adaptive:
+            self._rebuild_history()
+        return True
+
+    def _rebuild_history(self) -> None:
+        self.model.clear_history()
+        limit = self.model.config.history_limit
+        for p in self.partitions:
+            consumed = self._online.consumed_offsets.get(p)
+            if consumed is None:
+                continue
+            start = self.log.start_offset(p)
+            if limit is not None:
+                start = max(start, consumed - limit)
+            offset = start
+            while offset < consumed:
+                batch, nxt = self.log.read(
+                    p, offset,
+                    min(self.config.batch_records, consumed - offset))
+                if nxt == offset:
+                    break
+                self.model.preload_history(batch)
+                offset = nxt
+
+    # -- the cross-partition checkpoint barrier ------------------------------
+
+    def _hook_for(self, partition: int):
+        def hook(batch: StreamBatch) -> None:
+            # accounting FIRST: the batch is already applied by here,
+            # so the frontier must cover it even if the user callback
+            # below raises (the duplicate-window math counts applied-
+            # but-uncheckpointed batches). The barrier itself stays
+            # LAST — a raising callback crashes the consumer without
+            # checkpointing, the driver discipline
+            with self._barrier_lock:
+                prev = self._frontier.get(partition, 0)
+                self._frontier[partition] = max(prev, batch.end_offset)
+                self._since_barrier[partition] += 1
+                due = (self._since_barrier[partition]
+                       >= self.checkpoint_every)
+            if self.on_batch is not None:
+                self.on_batch(batch)
+            if due:
+                self.maybe_checkpoint()
+
+        return hook
+
+    def applied_frontier(self) -> dict[int, int]:
+        """Per-partition highest APPLIED end offset this run has seen —
+        what a kill loses back to the last barrier (the duplicate
+        window the recovery bench measures)."""
+        with self._barrier_lock:
+            return dict(self._frontier)
+
+    def _stamps_caught_up(self) -> bool:
+        offsets = self._online.consumed_offsets
+        for p, frontier in self._frontier.items():
+            if offsets.get(p, 0) < frontier:
+                return False  # frozen stamp: a background retrain is
+                # buffering this partition's batches — a barrier now
+                # would just re-persist the pre-retrain offsets
+        return True
+
+    def maybe_checkpoint(self) -> bool:
+        """Write the barrier snapshot if progress is pending and every
+        partition's offset stamp covers its applied frontier; hold
+        otherwise (the frozen-stamp window — the first post-swap batch
+        retries and writes one covering snapshot). Concurrent triggers
+        collapse: the first to capture the snapshot resets the pending
+        counts, the rest see nothing pending."""
+        with self._barrier_lock:
+            if not any(self._since_barrier.values()):
+                return False
+            if not self._stamps_caught_up():
+                self.barriers_held += 1
+                return False
+            arrays, meta = self._capture_locked()
+        self._write_snapshot(arrays, meta)
+        return True
+
+    def checkpoint(self) -> str:
+        """Write one atomic all-partition snapshot NOW (unconditional
+        barrier)."""
+        with self._barrier_lock:
+            arrays, meta = self._capture_locked()
+        return self._write_snapshot(arrays, meta)
+
+    def _capture_locked(self) -> tuple[dict, dict]:
+        """Capture the consistent snapshot and reset the window counts,
+        all under ``_barrier_lock`` (held by the caller's ``with``) with
+        the model's ``apply_lock`` nested for the capture itself. The
+        ordering is the duplicate-window bound: every applied batch is
+        either IN this capture (its commit preceded it) or counted in
+        the new window (its accounting hook serializes on the barrier
+        lock behind this capture) — so a partition can never accumulate
+        more than ``checkpoint_every`` uncheckpointed batches before
+        triggering the next barrier. Only refs and small id copies are
+        taken here; the device→host pull and npz write happen outside
+        both locks (``_write_snapshot``)."""
+        with self._apply_lock:
+            arrays, meta = snapshot_online_state(self._online)
+        for p in self._since_barrier:
+            self._since_barrier[p] = 0
+        return arrays, meta
+
+    def _write_snapshot(self, arrays: dict, meta: dict) -> str:
+        t0 = time.perf_counter() if self._obs_on else 0.0
+        with self._write_lock:
+            path = self.manager.save(int(meta["step"]), arrays, meta)
+        if self._obs_on:
+            self._m_ckpt.observe(time.perf_counter() - t0)
+            self._m_barriers.inc()
+        self.checkpoints_written += 1
+        offsets = {int(k): int(v)
+                   for k, v in meta["offsets"].items()}
+        if self._events is not None:
+            self._events.emit("stream.checkpoint",
+                              partitions=sorted(offsets),
+                              offsets={str(k): v
+                                       for k, v in offsets.items()},
+                              step=int(meta["step"]), path=path,
+                              barrier=True)
+        if self.config.truncate_log:
+            for p, off in offsets.items():
+                self.log.truncate_before(p, off)
+        return path
+
+    # -- consume loops -------------------------------------------------------
+
+    def run(self, max_batches: int | None = None,
+            follow: bool = False) -> int:
+        """Drain every partition on its own consumer thread until
+        caught up (``follow=False``), ``max_batches`` applied per
+        consumer, or ``stop()``. Returns total batches applied. A
+        consumer fault stops the others and re-raises here — and, like
+        the single driver, a crashed run writes NO final barrier (the
+        failed batch's offsets may be stamped; persisting them is the
+        job of the next healthy barrier, after replay). A clean exit
+        flushes one final covering barrier."""
+        self._error = None
+        # a fresh run means GO: clear any stop left behind by a prior
+        # fault's stop-all sweep (driver.run consumes a pending stop by
+        # returning 0 — a retry after a caught fault would otherwise
+        # silently apply nothing on every partition)
+        for d in self.drivers.values():
+            d._stop.clear()
+        applied = {p: 0 for p in self.partitions}
+
+        def consume(p: int, driver: StreamingDriver) -> None:
+            try:
+                applied[p] = driver.run(max_batches=max_batches,
+                                        follow=follow)
+            except BaseException as exc:
+                if self._error is None:
+                    self._error = exc
+                self.stop()
+
+        self._threads = [
+            threading.Thread(target=consume, args=(p, d), daemon=True,
+                             name=f"ingest-p{p}")
+            for p, d in self.drivers.items()
+        ]
+        for t in self._threads:
+            t.start()
+        for t in self._threads:
+            t.join()
+        self._threads = []
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+        self.maybe_checkpoint()
+        return sum(applied.values())
+
+    def start(self, follow: bool = True) -> "ParallelIngestRunner":
+        """Non-blocking form: start the N consumer threads (usually
+        ``follow=True``) and return; ``stop()`` + ``join()`` (or
+        ``run()`` next time) wind them down."""
+        if self._threads:
+            return self
+        self._error = None
+        for d in self.drivers.values():  # fresh start means GO (see
+            d._stop.clear()              # run())
+
+        def consume(driver: StreamingDriver) -> None:
+            try:
+                driver.run(follow=follow)
+            except BaseException as exc:
+                if self._error is None:
+                    self._error = exc
+                self.stop()
+
+        self._threads = [
+            threading.Thread(target=consume, args=(d,), daemon=True,
+                             name=f"ingest-p{p}")
+            for p, d in self.drivers.items()
+        ]
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self) -> None:
+        for d in self.drivers.values():
+            d.stop()
+
+    def join(self) -> None:
+        """Wait for started consumers, surface any fault, flush the
+        final barrier (clean exits only — same rule as ``run``)."""
+        threads, self._threads = self._threads, []
+        for t in threads:
+            t.join()
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+        self.maybe_checkpoint()
+
+    # -- serving -------------------------------------------------------------
+
+    def serving_engine(self, k: int = 10, **kwargs):
+        """One ``ServingEngine`` over the shared model, registered with
+        EVERY member driver (per-batch dirty-id tracking + per-partition
+        swap provenance) but swapped only by the runner's coalesced
+        ``refresh_serving``. Adaptive retrain swaps still auto-refresh
+        it through the model's own registry."""
+        if self._adaptive:
+            with self._apply_lock:
+                # same consistent-bind rule as the branch below: the
+                # serialized process() holds this lock mid-apply, and a
+                # bind snapshot taken without it could pair post-batch
+                # U with pre-batch V (lock order apply_lock →
+                # _engines_lock matches _install's — no inversion)
+                engine = self.model.serving_engine(k=k, **kwargs)
+        else:
+            from large_scale_recommendation_tpu.serving.engine import (
+                ServingEngine,
+            )
+
+            with self._apply_lock:
+                # a consistent bind snapshot: no half-committed batch
+                # (users table post-commit, items pre-commit) can leak
+                # into the engine's first catalog
+                snapshot = self.model.to_model()
+            engine = ServingEngine(snapshot, k=k, **kwargs)
+        engine.on_refresh = self.catalog_versions.append
+        self.catalog_versions.append(engine.version)
+        self._engines.append(engine)
+        for d in self.drivers.values():
+            d._engines.append(engine)
+            d._note_swap(engine.version, d.consumed_offset,
+                         source="engine_bind")
+        return engine
+
+    def refresh_serving(self, delta: bool | None = None) -> None:
+        """Ship every consumer's dirty rows into every engine as ONE
+        coalesced swap per engine. Per partition the dirty ids map to
+        engine rows and defer (``apply_delta(defer=True)``); one
+        ``flush_deltas`` installs them all — one scatter per table, one
+        version bump, one lineage stamp, however many consumers
+        contributed. Geometry drift (vocab grew past an engine's
+        snapshot) falls back to a full refresh, ``delta=True`` asserts
+        it didn't, ``delta=False`` forces it — the single-driver
+        semantics, aggregated. Requests landing while a refresh is in
+        flight COALESCE: the running refresh re-runs once to cover
+        them (``refreshes_coalesced`` counts the absorbed calls)."""
+        with self._refresh_lock:
+            if self._refreshing:
+                # absorb into the in-flight refresh: it re-runs once to
+                # cover every coalesced request (the newest delta arg
+                # wins — a raising delta=True assertion doesn't survive
+                # coalescing; True is a testing knob)
+                self._refresh_pending = (delta,)
+                self.refreshes_coalesced += 1
+                return
+            self._refreshing = True
+        try:
+            while True:
+                self._do_refresh(delta)
+                with self._refresh_lock:
+                    if self._refresh_pending is None:
+                        self._refreshing = False
+                        return
+                    (delta,) = self._refresh_pending
+                    self._refresh_pending = None
+        except BaseException:
+            with self._refresh_lock:
+                self._refreshing = False
+                self._refresh_pending = None
+            raise
+
+    def _take_dirty(self) -> dict[int, tuple[set, set]]:
+        out = {}
+        for p, d in self.drivers.items():
+            with d._dirty_lock:
+                du, d._dirty_users = d._dirty_users, set()
+                di, d._dirty_items = d._dirty_items, set()
+            if du or di:
+                out[p] = (du, di)
+        return out
+
+    def _do_refresh(self, delta: bool | None) -> None:
+        if not self._engines:
+            self._take_dirty()
+            return
+        online = self._online
+
+        def geometry_matches(engine) -> bool:
+            m = engine.model
+            return (int(m.U.shape[0]) == online.users.num_rows
+                    and int(m.V.shape[0]) == online.items.num_rows)
+
+        with self._apply_lock:
+            can_delta = all(geometry_matches(e) for e in self._engines)
+        if delta is True and not can_delta:
+            raise ValueError(
+                "delta refresh requested but an engine's geometry no "
+                "longer matches the live tables (vocab grew) — use "
+                "delta=None/False")
+        dirty = self._take_dirty()
+        full_refresh = delta is False or not can_delta
+        if not full_refresh:
+            # ADAPTIVE models: hold the apply lock across the whole
+            # gather→defer→flush ship. A background retrain's install
+            # (which runs under this lock and full-refreshes every
+            # engine) landing between our gather and our flush would be
+            # silently overwritten by the pre-retrain rows we gathered
+            # — the row-reversion hazard, one level above the engine's
+            # own refresh-clears-pending guard. The pure OnlineMF path
+            # has no competing full-refresh writer (the runner's own
+            # refreshes serialize on _refreshing), so it keeps the
+            # finer per-partition locking.
+            guard = (self._apply_lock if self._adaptive
+                     else contextlib.nullcontext())
+            try:
+                with guard:
+                    self._ship_deltas(online, dirty)
+            except ValueError:
+                # the geometry check above is a snapshot: a concurrent
+                # apply can grow the vocab between it and the ship, and
+                # the engine's loud bound check fires mid-delta. The
+                # documented delta=None contract is FALLBACK, not crash
+                # — the full rebuild below covers every row, including
+                # any half-deferred ones (refresh clears pending).
+                # delta=True keeps the assertion semantics and raises.
+                if delta is True:
+                    raise
+                full_refresh = True
+        if full_refresh:
+            with self._apply_lock:
+                snapshot = self.model.to_model()
+            for engine in self._engines:
+                engine.refresh(snapshot)
+        # per-partition swap provenance: each driver stamps ITS
+        # partition's watermark onto every engine's fresh version — the
+        # lineage journal keeps watermarks per partition, the
+        # critical-path analyzer completes one sample per (version,
+        # partition)
+        for engine in self._engines:
+            for d in self.drivers.values():
+                d._note_swap(engine.version, d.consumed_offset,
+                             source="stream_refresh")
+
+    def _ship_deltas(self, online, dirty: dict) -> None:
+        """Gather each partition's dirty rows and install them into
+        every engine as one coalesced swap (defer per partition, one
+        flush per engine). Raises ``ValueError`` when the vocab grew
+        under the geometry snapshot — the caller decides fallback vs
+        assert."""
+        for p, (du, di) in sorted(dirty.items()):
+            ua = (np.fromiter(du, np.int64, len(du)) if du
+                  else np.zeros(0, np.int64))
+            ia = (np.fromiter(di, np.int64, len(di)) if di
+                  else np.zeros(0, np.int64))
+            with self._apply_lock:
+                # id→row mapping AND table refs under the model lock:
+                # rows_for reads the sorted-index cache a concurrent
+                # ensure() rebuilds, and the row values gathered must
+                # be the rows the mapping named
+                u_rows, _ = online.users.rows_for(ua)
+                i_rows, _ = online.items.rows_for(ia)
+                U_arr = online.users.array
+                V_arr = online.items.array
+            U_vals = StreamingDriver._gather_rows(U_arr, u_rows)
+            V_vals = StreamingDriver._gather_rows(V_arr, i_rows)
+            for engine in self._engines:
+                engine.apply_delta(item_rows=i_rows, V_rows=V_vals,
+                                   user_rows=u_rows, U_rows=U_vals,
+                                   defer=True)
+        for engine in self._engines:
+            engine.flush_deltas()
+
+    # -- telemetry -----------------------------------------------------------
+
+    def start_telemetry_export(self, interval_s: float = 5.0) -> None:
+        """Per-partition timed telemetry for every member driver — this
+        is what keeps ``streams_lag_records{partition=p}`` fresh for
+        ALL N partitions (a single driver only ever publishes its
+        own)."""
+        for d in self.drivers.values():
+            d.start_telemetry_export(interval_s)
+
+    def stop_telemetry_export(self) -> None:
+        for d in self.drivers.values():
+            d.stop_telemetry_export()
+
+    def telemetry(self) -> dict:
+        """Aggregate + per-partition snapshot. Calling this publishes
+        every partition's lag/queue gauges (each member driver's
+        ``telemetry()`` does its own)."""
+        per_part = {p: d.telemetry() for p, d in self.drivers.items()}
+        out = {
+            "partitions": sorted(self.partitions),
+            "consumers": len(self.drivers),
+            "batches_processed": sum(t["batches_processed"]
+                                     for t in per_part.values()),
+            "records_processed": sum(t["records_processed"]
+                                     for t in per_part.values()),
+            "lag_records": {p: t["lag_records"]
+                            for p, t in per_part.items()},
+            "consumed_offsets": {p: t["consumed_offset"]
+                                 for p, t in per_part.items()},
+            "checkpoints_written": self.checkpoints_written,
+            "barriers_held": self.barriers_held,
+            "refreshes_coalesced": self.refreshes_coalesced,
+            "catalog_versions": list(self.catalog_versions),
+            "per_partition": per_part,
+        }
+        if self.gate is not None:
+            out["gate"] = {"grants": self.gate.grants,
+                           "waits": self.gate.waits}
+        return out

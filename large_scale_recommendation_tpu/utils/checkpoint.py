@@ -123,7 +123,13 @@ class CheckpointManager:
     def _retain(self) -> None:
         steps = self.steps()
         for s in steps[: max(0, len(steps) - self.keep)]:
-            os.unlink(os.path.join(self.directory, f"ckpt_{s}.npz"))
+            try:
+                os.unlink(os.path.join(self.directory, f"ckpt_{s}.npz"))
+            except FileNotFoundError:
+                # a concurrent writer's retention sweep (two barrier
+                # snapshots draining back-to-back) already retired it —
+                # the goal state is "file gone", which it is
+                pass
 
     # -- read ----------------------------------------------------------------
 
@@ -560,6 +566,33 @@ def restore_mf_model(manager: CheckpointManager, step: int | None = None):
     return model, ck
 
 
+def snapshot_online_state(online) -> tuple[dict, dict]:
+    """Capture one CONSISTENT ``(arrays, meta)`` view of an
+    ``OnlineMF``: id layouts (host copies), factor-table refs (jax
+    arrays are immutable — holding the refs pins this instant's values
+    with zero copies), step, and the per-partition consumed WAL
+    offsets. This is the capture half of ``save_online_state``, split
+    out so a multi-consumer checkpoint BARRIER
+    (``streams.parallel.ParallelIngestRunner``) can take the snapshot
+    under the model's ``apply_lock`` — no commit can interleave between
+    reading the tables and reading the offsets they correspond to — and
+    pay the (device→host + npz) write OUTSIDE the lock."""
+    u_ids = np.asarray(online.users.id_array(), dtype=np.int64)
+    i_ids = np.asarray(online.items.id_array(), dtype=np.int64)
+    meta = {"kind": "online_state", "step": int(online.step),
+            "offsets": {str(k): int(v)
+                        for k, v in online.consumed_offsets.items()}}
+    arrays = {
+        "user_ids": u_ids,
+        "item_ids": i_ids,
+        # refs, sliced lazily at write time (np.asarray in
+        # manager.save): immutable device arrays can't tear
+        "U": online.users.array[: len(u_ids)],
+        "V": online.items.array[: len(i_ids)],
+    }
+    return arrays, meta
+
+
 def save_online_state(manager: CheckpointManager, online, step: int,
                       extra_meta: dict | None = None) -> str:
     """Snapshot an ``OnlineMF``'s growable tables (ids + factors) —
@@ -574,18 +607,9 @@ def save_online_state(manager: CheckpointManager, online, step: int,
     tail (docs/STREAMING.md). JSON round-trips dict keys as strings;
     restore converts back.
     """
-    u_ids = np.asarray(online.users.ids(), dtype=np.int64)
-    i_ids = np.asarray(online.items.ids(), dtype=np.int64)
-    meta = {"kind": "online_state", "step": online.step,
-            "offsets": {str(k): int(v)
-                        for k, v in online.consumed_offsets.items()}}
+    arrays, meta = snapshot_online_state(online)
     meta.update(extra_meta or {})
-    return manager.save(step, {
-        "user_ids": u_ids,
-        "item_ids": i_ids,
-        "U": np.asarray(online.users.array)[: len(u_ids)],
-        "V": np.asarray(online.items.array)[: len(i_ids)],
-    }, meta)
+    return manager.save(step, arrays, meta)
 
 
 def restore_online_state(manager: CheckpointManager, online,

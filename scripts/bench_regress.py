@@ -22,6 +22,11 @@ silently as long as tier-1 stays green. This gate closes that gap::
                                       # ndcg/hr10/coverage + the eval_*
                                       # family higher-is-better,
                                       # eval_rmse lower (ISSUE 10)
+    python scripts/bench_regress.py --family ingest   # parallel-ingest
+                                      # rounds (INGEST_r*.json: rates and
+                                      # scaling efficiency higher-is-
+                                      # better; recovery wall + duplicate
+                                      # window lower, ISSUE 13)
 
 It loads both rounds, compares the watched keys (higher-is-better rates
 by default; ``--lower`` flags wall-clock-style keys), prints a table,
@@ -134,6 +139,24 @@ QUALITY_KEYS: dict[str, float] = {
     "rmse_final": 30.0,
 }
 
+# watched keys for the INGEST_r*.json trajectory (the streams_bench
+# N_CONSUMERS rounds, ISSUE 13): aggregate/per-N ingest rates and the
+# scaling efficiency (rate_N / (N·rate_1)) are higher-is-better;
+# recovery-after-kill wall and the per-partition duplicate window are
+# LOWER-is-better — a growing replay window is a barrier-cadence
+# regression even when throughput noise hides it. Rates loose (shared
+# machines, and the curve is thread-scheduling sensitive); the
+# duplicate window is near-deterministic (the barrier cadence bounds
+# it), so tight.
+INGEST_KEYS: dict[str, float] = {
+    "value": 30.0,  # max-N aggregate ratings/s headline
+    "ingest_n1_ratings_per_s": 30.0,
+    "ingest_n4_ratings_per_s": 30.0,
+    "scaling_eff_n4": 30.0,
+    "recovery_s": 50.0,
+    "duplicate_window_batches_max": 10.0,
+}
+
 # per-family round-file prefix + default watch set. The quality family
 # reads the BENCH rounds — quality keys ride inside the bench extras,
 # they just gate under their own watch set (and direction rules).
@@ -142,6 +165,7 @@ FAMILIES = {
     "multichip": ("MULTICHIP", MULTICHIP_KEYS),
     "serving": ("SERVING", SERVING_KEYS),
     "quality": ("BENCH", QUALITY_KEYS),
+    "ingest": ("INGEST", INGEST_KEYS),
 }
 
 # keys where HIGHER is explicitly better (throughputs, achieved
@@ -155,7 +179,10 @@ DEFAULT_HIGHER = ("_ratings_per_s", "_rows_per_s", "_users_per_s",
                   "recall_at", "_vs_exact",
                   # quality family (ISSUE 10): ranking metrics and
                   # catalog coverage regress when they DROP
-                  "_ndcg", "_hr10", "_hr_at", "ndcg_at", "coverage")
+                  "_ndcg", "_hr10", "_hr_at", "ndcg_at", "coverage",
+                  # ingest family (ISSUE 13): the N-consumer scaling
+                  # efficiency regresses when it drops
+                  "scaling_eff")
 
 # keys where LOWER is better (walls, latencies, pad/layout overheads,
 # compile counts, eval error, ingest→servable critical-path walls)
@@ -166,7 +193,10 @@ DEFAULT_HIGHER = ("_ratings_per_s", "_rows_per_s", "_users_per_s",
 DEFAULT_LOWER = ("_wall_s", "_ms_", "time_to_", "_s_p", "_pad_ratio",
                  "layout_mb", "layout_bytes", "p99_ms", "p50_ms",
                  "shed_frac", "compile_count", "_rmse", "eval_rmse",
-                 "rmse_final", "staleness_s", "critical_path")
+                 "rmse_final", "staleness_s", "critical_path",
+                 # ingest family (ISSUE 13): recovery-after-kill wall
+                 # and the per-partition replay window regress UP
+                 "recovery_s", "duplicate_window")
 
 _NUM_PAIR = re.compile(
     r'"([A-Za-z_][A-Za-z0-9_]*)":\s*(-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)')
@@ -296,7 +326,10 @@ def main(argv=None) -> int:
                          "recall higher-is-better) or 'quality' (the "
                          "model-quality keys inside the BENCH rounds — "
                          "ranking/coverage higher-is-better, eval_rmse "
-                         "lower)")
+                         "lower) or 'ingest' (INGEST_r*.json parallel-"
+                         "ingest rounds — rates/scaling-efficiency "
+                         "higher-is-better, recovery wall and duplicate "
+                         "window lower-is-better)")
     ap.add_argument("--current", default=None,
                     help="current round file (default: newest round of "
                          "the family)")

@@ -260,6 +260,60 @@ def test_streams_bench_emits_contract_json():
     assert d["vs_baseline"] > 0
 
 
+def test_streams_bench_parallel_contract_on_merged_stream():
+    """The N_CONSUMERS mode's contract (ISSUE 13): with
+    STREAMS_CONSUMERS set, streams_bench emits the parallel-ingest
+    round as ONE final JSON line on a 2>&1-MERGED stream (the
+    stderr-flush-before-final-JSON hardening — progress lines go to
+    stderr mid-run), carrying the scaling-curve, recovery and
+    freshness-SLO evidence keys the ``--family ingest`` gate watches."""
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "STREAMS_CONSUMERS": "1,2",
+        "STREAMS_USERS": "800",
+        "STREAMS_ITEMS": "300",
+        "STREAMS_RANK": "8",
+        "STREAMS_BATCHES": "4",
+        "STREAMS_BATCH": "3000",
+        "STREAMS_CHECKPOINT_EVERY": "2",
+        "STREAMS_FRESHNESS_S": "1",
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "streams_bench.py")],
+        env=env, text=True, timeout=600, cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT,  # 2>&1 merge
+    )
+    assert proc.returncode == 0, proc.stdout[-2000:]
+    lines = [ln for ln in proc.stdout.splitlines() if ln.strip()]
+    d = json.loads(lines[-1])  # the merged-stream emit contract
+    for key in ("metric", "value", "unit", "vs_baseline"):
+        assert key in d, f"missing {key}"
+    assert d["unit"] == "ratings/s"
+    assert d["value"] > 0
+    e = d["extra"]
+    for key in ("cpu_count", "curve",
+                "ingest_n1_ratings_per_s", "ingest_n2_ratings_per_s",
+                "scaling_eff_n2", "checkpoints_n1", "checkpoints_n2",
+                "recovery_s", "recovery_replayed_records",
+                "duplicate_window_batches_max", "duplicate_window_bound",
+                "freshness_slo_held", "critical_path_partitions",
+                "critical_path_samples"):
+        assert key in e, f"missing extra.{key}"
+    assert e["curve"] == [1, 2]
+    # the recovery pass accounted a bounded per-partition replay and
+    # the sustained pass held the freshness SLO with samples resolving
+    # for BOTH partitions
+    assert e["duplicate_window_batches_max"] <= e["duplicate_window_bound"]
+    assert e["freshness_slo_held"] == 1
+    assert e["critical_path_partitions"] == 2
+    # cores < N must surface the honest caveat; enough cores must not
+    if e["cpu_count"] < 2:
+        assert "error" in d and "core" in d["error"]
+    else:
+        assert "error" not in d
+
+
 @pytest.mark.slow
 def test_bench_kernel_knob_routes_pallas():
     """BENCH_KERNEL=pallas drives the headline through the model layer's

@@ -583,3 +583,89 @@ class TestCriticalPathDirection:
 
         for key in ("critical_path_total_s", "critical_path_s"):
             assert not any(pat in key for pat in DEFAULT_HIGHER), key
+
+
+class TestIngestFamily:
+    """``--family ingest`` (ISSUE 13): INGEST_r*.json parallel-ingest
+    rounds gate with rates and scaling efficiency higher-is-better and
+    recovery wall / duplicate window LOWER-is-better — the PR 7/8
+    pattern direction/no-collision unit twins."""
+
+    BASE = {"ingest_n1_ratings_per_s": 1_000_000.0,
+            "ingest_n4_ratings_per_s": 3_000_000.0,
+            "scaling_eff_n4": 0.75,
+            "recovery_s": 2.0,
+            "duplicate_window_batches_max": 4.0}
+
+    def _round(self, tmp_path, name, **over):
+        extra = dict(self.BASE, **over)
+        value = extra.pop("value", extra["ingest_n4_ratings_per_s"])
+        p = tmp_path / name
+        p.write_text(json.dumps(  # the real streams_bench line shape
+            {"metric": "parallel ingest ratings/s", "value": value,
+             "unit": "ratings/s", "vs_baseline": 3.0, "extra": extra}))
+        return str(p)
+
+    def test_scaling_efficiency_drop_trips(self, tmp_path, capsys):
+        b = self._round(tmp_path, "INGEST_r01.json")
+        c = self._round(tmp_path, "INGEST_r02.json", scaling_eff_n4=0.3)
+        rc = regress_main(["--family", "ingest",
+                           "--baseline", b, "--current", c])
+        assert rc == 1
+        assert "scaling_eff_n4" in capsys.readouterr().out
+
+    def test_recovery_blowup_trips(self, tmp_path):
+        b = self._round(tmp_path, "INGEST_r01.json")
+        c = self._round(tmp_path, "INGEST_r02.json", recovery_s=10.0)
+        assert regress_main(["--family", "ingest",
+                             "--baseline", b, "--current", c]) == 1
+
+    def test_duplicate_window_growth_trips_tight(self, tmp_path):
+        """The duplicate window is bounded by the barrier cadence —
+        near-deterministic, so its threshold is tight: +1 batch on a
+        4-batch window is a 25% regression."""
+        b = self._round(tmp_path, "INGEST_r01.json")
+        c = self._round(tmp_path, "INGEST_r02.json",
+                        duplicate_window_batches_max=5.0)
+        assert regress_main(["--family", "ingest",
+                             "--baseline", b, "--current", c]) == 1
+
+    def test_throughput_collapse_trips(self, tmp_path):
+        b = self._round(tmp_path, "INGEST_r01.json")
+        c = self._round(tmp_path, "INGEST_r02.json",
+                        ingest_n4_ratings_per_s=1_000_000.0,
+                        value=1_000_000.0)
+        assert regress_main(["--family", "ingest",
+                             "--baseline", b, "--current", c]) == 1
+
+    def test_across_the_board_improvement_never_trips(self, tmp_path):
+        b = self._round(tmp_path, "INGEST_r01.json")
+        c = self._round(tmp_path, "INGEST_r02.json",
+                        ingest_n1_ratings_per_s=1_500_000.0,
+                        ingest_n4_ratings_per_s=5_000_000.0,
+                        value=5_000_000.0, scaling_eff_n4=0.85,
+                        recovery_s=0.5,
+                        duplicate_window_batches_max=1.0)
+        assert regress_main(["--family", "ingest",
+                             "--baseline", b, "--current", c]) == 0
+
+    def test_ingest_direction_rules(self):
+        from scripts.bench_regress import INGEST_KEYS, is_lower_better
+
+        for key in ("recovery_s", "duplicate_window_batches_max"):
+            assert is_lower_better(key, set()), key
+        for key in ("ingest_n1_ratings_per_s", "ingest_n4_ratings_per_s",
+                    "scaling_eff_n4", "scaling_eff_n2"):
+            assert not is_lower_better(key, set()), key
+        assert set(self.BASE) | {"value"} == set(INGEST_KEYS)
+
+    def test_no_higher_pattern_collision(self):
+        """The lower-is-better ingest keys must never match a
+        higher-is-better pattern (DEFAULT_HIGHER wins, so a collision
+        would silently flip the gate's direction) — and vice versa."""
+        from scripts.bench_regress import DEFAULT_HIGHER, DEFAULT_LOWER
+
+        for key in ("recovery_s", "duplicate_window_batches_max"):
+            assert not any(pat in key for pat in DEFAULT_HIGHER), key
+        for key in ("scaling_eff_n4", "ingest_n4_ratings_per_s"):
+            assert not any(pat in key for pat in DEFAULT_LOWER), key

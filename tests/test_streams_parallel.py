@@ -1,0 +1,847 @@
+"""Parallel ingest (``streams/parallel.py``, ISSUE 13): the row-conflict
+gate, concurrent-apply bit-parity with the serial path, the
+cross-partition checkpoint barrier, multi-consumer kill/restart recovery
+with per-partition zero-loss/bounded-duplication and lineage +
+critical-path reconciliation at N > 1, the N=4 starved-feed arrival-skew
+pin, per-partition lag gauges for ALL N partitions, and delta-swap
+coalescing (engine defer/flush parity, one version bump per refresh).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from large_scale_recommendation_tpu import obs
+from large_scale_recommendation_tpu.models.online import (
+    OnlineMF,
+    OnlineMFConfig,
+)
+from large_scale_recommendation_tpu.streams import (
+    EventLog,
+    ParallelIngestRunner,
+    RowConflictGate,
+    StreamingDriverConfig,
+    append_routed,
+    route_partition,
+)
+from large_scale_recommendation_tpu.utils.checkpoint import (
+    CheckpointManager,
+)
+
+
+def _online(rank=4, minibatch=64):
+    return OnlineMF(OnlineMFConfig(num_factors=rank,
+                                   minibatch_size=minibatch))
+
+
+def _fill_strata(log, n, n_batches, batch=300, seed=0, users=30,
+                 items=12, per_partition=None):
+    """Stratum-routed fill: partition p's users ≡ p (mod n) and its
+    items live in block p — fully row-disjoint streams."""
+    rng = np.random.default_rng(seed)
+    for p in range(n):
+        b = n_batches if per_partition is None else per_partition[p]
+        for _ in range(b):
+            u = rng.integers(0, users, batch) * n + p
+            i = rng.integers(0, items, batch) + p * items
+            log.append_arrays(p, u, i, rng.random(batch).astype(np.float32))
+
+
+def _runner(tmp_path, log, model=None, sub="ckpt", **cfg):
+    model = model or _online()
+    return model, ParallelIngestRunner(
+        model, log, str(tmp_path / sub),
+        config=StreamingDriverConfig(batch_records=300, **cfg))
+
+
+# --------------------------------------------------------------------------
+# Routing + gate
+# --------------------------------------------------------------------------
+
+
+class TestRouting:
+    def test_route_partition_is_user_stable(self):
+        parts = route_partition([0, 1, 5, 9, 1, 5], 4)
+        assert parts.tolist() == [0, 1, 1, 1, 1, 1]
+        # same user always lands in the same partition
+        assert route_partition([7], 4) == route_partition([7], 4)
+
+    def test_append_routed_splits_by_user(self, tmp_path):
+        log = EventLog(str(tmp_path / "log"), num_partitions=3,
+                       fsync=False)
+        users = np.arange(12)
+        n = append_routed(log, users, users, np.ones(12, np.float32))
+        assert n == 12
+        for p in range(3):
+            batch, _ = log.read(p, 0, 100)
+            ru = batch.to_numpy()[0]
+            assert (route_partition(ru, 3) == p).all()
+
+
+class TestRowConflictGate:
+    def test_disjoint_grants_overlap(self):
+        gate = RowConflictGate()
+        t1 = gate.acquire([1, 2], [10])
+        t2 = gate.acquire([3], [11, 12])  # disjoint: no wait
+        assert gate.grants == 2 and gate.waits == 0
+        assert gate.in_flight() == (3, 3)
+        gate.release(t1)
+        gate.release(t2)
+        assert gate.in_flight() == (0, 0)
+
+    def test_collision_blocks_until_release(self):
+        gate = RowConflictGate()
+        t1 = gate.acquire([1], [10])
+        order = []
+
+        def contender():
+            t = gate.acquire([2], [10])  # shares item 10 → must wait
+            order.append("acquired")
+            gate.release(t)
+
+        th = threading.Thread(target=contender)
+        th.start()
+        time.sleep(0.05)
+        assert order == []  # still blocked on the in-flight claim
+        order.append("releasing")
+        gate.release(t1)
+        th.join(timeout=5)
+        assert order == ["releasing", "acquired"]
+        assert gate.waits == 1
+
+    def test_user_collision_also_blocks(self):
+        gate = RowConflictGate()
+        t1 = gate.acquire([5], [1])
+        done = threading.Event()
+
+        def contender():
+            gate.release(gate.acquire([5], [2]))
+            done.set()
+
+        th = threading.Thread(target=contender)
+        th.start()
+        assert not done.wait(0.05)
+        gate.release(t1)
+        th.join(timeout=5)
+        assert done.is_set()
+
+
+# --------------------------------------------------------------------------
+# Concurrent applies: bit-parity with the serial path
+# --------------------------------------------------------------------------
+
+
+class TestConcurrentApply:
+    def _batches(self, n_parts=4, n_batches=3, batch=200, seed=0):
+        """Row-disjoint batch streams, one per 'consumer'."""
+        from large_scale_recommendation_tpu.core.types import Ratings
+
+        rng = np.random.default_rng(seed)
+        streams = []
+        for p in range(n_parts):
+            bs = []
+            for _ in range(n_batches):
+                u = rng.integers(0, 20, batch) * n_parts + p
+                i = rng.integers(0, 10, batch) + p * 10
+                bs.append(Ratings.from_arrays(
+                    u, i, rng.random(batch).astype(np.float32)))
+            streams.append(bs)
+        return streams
+
+    def test_disjoint_threads_match_serial_bitexact(self):
+        """The Gemulla pin: row-disjoint applies commute, so N threads
+        interleaving them must produce EXACTLY the serial tables."""
+        streams = self._batches()
+
+        serial = _online()
+        for bs in streams:
+            for b in bs:
+                serial.partial_fit(b, emit_updates=False)
+
+        conc = _online()
+        conc.enable_concurrent_applies()
+        conc.apply_gate = RowConflictGate()
+        errs = []
+
+        def consume(bs):
+            try:
+                for b in bs:
+                    conc.partial_fit(b, emit_updates=False)
+            except BaseException as e:  # surfaced below
+                errs.append(e)
+
+        threads = [threading.Thread(target=consume, args=(bs,))
+                   for bs in streams]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs
+        assert conc.step == serial.step
+        # align rows by id (registration order differs across
+        # interleavings) and compare factors exactly
+        for side in ("users", "items"):
+            st = getattr(serial, side)
+            ct = getattr(conc, side)
+            ids = np.sort(st.id_array())
+            np.testing.assert_array_equal(ids, np.sort(ct.id_array()))
+            np.testing.assert_array_equal(st.lookup(ids), ct.lookup(ids))
+
+    def test_emit_updates_id_alignment(self):
+        """Concurrent-path updates-only output pairs each id with ITS
+        vector (rows are first-seen ordered, ids sorted — the mapping
+        must re-align them)."""
+        from large_scale_recommendation_tpu.core.types import Ratings
+
+        m = _online()
+        m.enable_concurrent_applies()
+        # register ids out of sorted order so row order != id order
+        b = Ratings.from_arrays([9, 3, 7], [20, 5, 11],
+                                [1.0, 2.0, 3.0])
+        out = m.partial_fit(b)
+        ids, vecs = out.user_arrays
+        assert ids.tolist() == [3, 7, 9]
+        for ident, vec in zip(ids.tolist(), vecs):
+            np.testing.assert_array_equal(vec, m.users.lookup([ident])[0])
+        ids_i, vecs_i = out.item_arrays
+        assert ids_i.tolist() == [5, 11, 20]
+        for ident, vec in zip(ids_i.tolist(), vecs_i):
+            np.testing.assert_array_equal(vec, m.items.lookup([ident])[0])
+
+    def test_colliding_batches_serialize_and_stay_finite(self):
+        """Two batches sharing an item id: the gate serializes them
+        (waits > 0) and both apply."""
+        from large_scale_recommendation_tpu.core.types import Ratings
+
+        m = _online()
+        m.enable_concurrent_applies()
+        m.apply_gate = RowConflictGate()
+        b1 = Ratings.from_arrays([1], [7], [1.0])
+        b2 = Ratings.from_arrays([2], [7], [2.0])  # same item row
+
+        barrier = threading.Barrier(2)
+
+        def apply(b):
+            barrier.wait()
+            m.partial_fit(b, emit_updates=False)
+
+        ts = [threading.Thread(target=apply, args=(b,))
+              for b in (b1, b2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert m.step == 2
+        assert np.isfinite(m.items.lookup([7])).all()
+
+    def test_offset_stamp_only_after_commit(self):
+        from large_scale_recommendation_tpu.core.types import Ratings
+
+        m = _online()
+        m.enable_concurrent_applies()
+        m.partial_fit(Ratings.from_arrays([1], [2], [1.0]),
+                      offset=(3, 17), emit_updates=False)
+        assert m.consumed_offsets == {3: 17}
+        # empty batch still advances the stream position
+        m.partial_fit(Ratings.from_arrays([0], [0], [1.0],
+                                          weights=[0.0]),
+                      offset=(3, 20), emit_updates=False)
+        assert m.consumed_offsets == {3: 20}
+
+
+# --------------------------------------------------------------------------
+# Runner: catch-up, barrier, resume
+# --------------------------------------------------------------------------
+
+
+class TestRunnerCatchUp:
+    def test_drains_all_partitions_with_barrier(self, tmp_path):
+        log = EventLog(str(tmp_path / "log"), num_partitions=4,
+                       fsync=False)
+        _fill_strata(log, 4, 3)
+        model, runner = _runner(tmp_path, log, checkpoint_every=2)
+        assert not runner.resume()
+        applied = runner.run()
+        assert applied == 12
+        tele = runner.telemetry()
+        assert all(v == 0 for v in tele["lag_records"].values())
+        assert model.consumed_offsets == {p: 900 for p in range(4)}
+        assert runner.checkpoints_written >= 1
+        # ONE atomic snapshot carries every partition's offset
+        ck = CheckpointManager(str(tmp_path / "ckpt")).restore()
+        assert ck.meta["offsets"] == {str(p): 900 for p in range(4)}
+
+    def test_resume_restores_every_partition(self, tmp_path):
+        log = EventLog(str(tmp_path / "log"), num_partitions=3,
+                       fsync=False)
+        _fill_strata(log, 3, 2)
+        _, r1 = _runner(tmp_path, log)
+        r1.run()
+        _fill_strata(log, 3, 1, seed=9)
+        m2, r2 = _runner(tmp_path, log)
+        assert r2.resume()
+        assert m2.consumed_offsets == {p: 600 for p in range(3)}
+        assert r2.run() == 3  # only the new tail replays
+        assert m2.consumed_offsets == {p: 900 for p in range(3)}
+
+    def test_single_partition_runner_stays_serial(self, tmp_path):
+        log = EventLog(str(tmp_path / "log"), fsync=False)
+        _fill_strata(log, 1, 3)
+        model, runner = _runner(tmp_path, log)
+        assert runner.gate is None
+        assert not model.concurrent_applies  # N=1: the plain hot path
+        assert runner.run() == 3
+
+    def test_consumer_fault_stops_all_and_raises(self, tmp_path):
+        log = EventLog(str(tmp_path / "log"), num_partitions=2,
+                       fsync=False)
+        _fill_strata(log, 2, 50)
+
+        class Boom(RuntimeError):
+            pass
+
+        def explode(batch):
+            if batch.partition == 1:
+                raise Boom()
+
+        model, runner = _runner(tmp_path, log)
+        runner.on_batch = explode
+        with pytest.raises(Boom):
+            runner.run()
+        # no final barrier on a crashed run beyond what cadence wrote
+        assert model.consumed_offsets.get(0, 0) < 50 * 300
+
+    def test_barrier_holds_while_stamps_frozen(self, tmp_path):
+        """The frozen-offset interaction: while a (simulated) background
+        retrain buffers batches without advancing the stamps, the
+        barrier must hold — and one covering snapshot lands once the
+        stamps catch up."""
+        log = EventLog(str(tmp_path / "log"), num_partitions=2,
+                       fsync=False)
+        _fill_strata(log, 2, 3)
+        model, runner = _runner(tmp_path, log, checkpoint_every=1)
+        real_fit = model.partial_fit
+        # deterministic freeze: partition 0's first two batches apply
+        # WITHOUT advancing their stamp (the buffered-during-retrain
+        # shape), its third batch stamps and unblocks the barrier
+        frozen_p0 = [2]
+        lock = threading.Lock()
+
+        def fit(batch, offset=None, emit_updates=False, **kw):
+            with lock:
+                if (offset is not None and offset[0] == 0
+                        and frozen_p0[0] > 0):
+                    frozen_p0[0] -= 1
+                    offset = None
+            return real_fit(batch, offset=offset,
+                            emit_updates=emit_updates, **kw)
+
+        model.partial_fit = fit
+        runner.run()
+        assert runner.barriers_held >= 1
+        assert runner.checkpoints_written >= 1
+        # the final snapshot covers everything both partitions applied
+        ck = CheckpointManager(str(tmp_path / "ckpt")).restore()
+        assert ck.meta["offsets"] == {"0": 900, "1": 900}
+
+
+class TestAdaptiveParallel:
+    def test_background_retrain_holds_barrier_then_covers(self,
+                                                          tmp_path):
+        """AdaptiveMF at N consumers: applies serialize on the model's
+        lock, a background retrain freezes the stamps (the barrier
+        HOLDS), the retrain swap reaches serving, and the final barrier
+        snapshot covers every partition — from which a fresh adaptive
+        model rebuilds its full multi-partition history."""
+        from large_scale_recommendation_tpu.models.adaptive import (
+            AdaptiveMF,
+            AdaptiveMFConfig,
+        )
+
+        def adaptive():
+            return AdaptiveMF(AdaptiveMFConfig(
+                num_factors=4, minibatch_size=64, offline_every=5,
+                offline_iterations=2, background=True))
+
+        n = 2
+        log = EventLog(str(tmp_path / "log"), num_partitions=n,
+                       fsync=False)
+        _fill_strata(log, n, 6)
+        model = adaptive()
+        runner = ParallelIngestRunner(
+            model, log, str(tmp_path / "ckpt"),
+            config=StreamingDriverConfig(batch_records=300,
+                                         checkpoint_every=2))
+        assert model.concurrent_applies  # serialized-process mode armed
+        engine = runner.serving_engine(k=3, max_batch=32)
+        v0 = engine.version
+        applied = runner.run()
+        model.flush()  # absorb any in-flight background retrain
+        runner.maybe_checkpoint()
+        assert applied == 12
+        assert model.retrain_count >= 1
+        assert engine.version != v0, "retrain swap never reached serving"
+        ck = CheckpointManager(str(tmp_path / "ckpt")).restore()
+        assert set(ck.meta["offsets"]) == {"0", "1"}
+        m2 = adaptive()
+        r2 = ParallelIngestRunner(
+            m2, log, str(tmp_path / "ckpt"),
+            config=StreamingDriverConfig(batch_records=300))
+        assert r2.resume()
+        assert m2._history_rows == sum(
+            int(v) for v in ck.meta["offsets"].values())
+
+
+# --------------------------------------------------------------------------
+# Kill/restart at N>1: per-partition zero loss, bounded duplication,
+# lineage + critical-path reconciliation (extends the PR 12 pin)
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture
+def causal_obs():
+    from large_scale_recommendation_tpu.obs.disttrace import (
+        get_disttrace,
+        set_disttrace,
+    )
+    from large_scale_recommendation_tpu.obs.events import (
+        get_events,
+        set_events,
+    )
+    from large_scale_recommendation_tpu.obs.lineage import (
+        get_lineage,
+        set_lineage,
+    )
+    from large_scale_recommendation_tpu.obs.recorder import (
+        get_recorder,
+        set_recorder,
+    )
+    from large_scale_recommendation_tpu.obs.registry import (
+        get_registry,
+        set_registry,
+    )
+    from large_scale_recommendation_tpu.obs.trace import (
+        get_tracer,
+        set_tracer,
+    )
+
+    prev = (get_registry(), get_tracer(), get_events(), get_recorder(),
+            get_lineage(), get_disttrace())
+    reg, tracer = obs.enable()
+    obs.enable_lineage(capacity=64)
+    analyzer = obs.enable_disttrace(capacity=64)
+    yield reg, tracer, analyzer
+    set_registry(prev[0])
+    set_tracer(prev[1])
+    set_events(prev[2])
+    set_recorder(prev[3])
+    set_lineage(prev[4])
+    set_disttrace(prev[5])
+
+
+class _Crash(RuntimeError):
+    pass
+
+
+class TestKillRestartMultiConsumer:
+    N = 3
+
+    def test_per_partition_zero_loss_bounded_duplication(
+            self, tmp_path, causal_obs):
+        """The satellite-4 pin: kill mid-stream with partitions at
+        DIFFERENT offsets, restart, and account for every partition's
+        records exactly — zero loss, per-partition duplicate window ≤
+        checkpoint_every batches — then reconcile the post-resume
+        lineage watermarks and critical-path samples (the PR 12
+        reconciliation, now at N > 1)."""
+        reg, _, analyzer = causal_obs
+        n, batch, ck_every = self.N, 300, 2
+        per_partition = [4 + p for p in range(n)]  # uneven offsets
+        log = EventLog(str(tmp_path / "log"), num_partitions=n,
+                       fsync=False)
+        _fill_strata(log, n, 0, batch=batch,
+                     per_partition=per_partition)
+        applied: list[tuple[int, int, int]] = []
+        lock = threading.Lock()
+
+        def record_and_crash(b):
+            with lock:
+                applied.append((b.partition, b.start_offset,
+                                b.end_offset))
+                if len(applied) == 6:
+                    raise _Crash()
+
+        m1, r1 = _runner(tmp_path, log, checkpoint_every=ck_every)
+        r1.on_batch = record_and_crash
+        with pytest.raises(_Crash):
+            r1.run()
+        frontier = r1.applied_frontier()
+
+        m2, r2 = _runner(tmp_path, log, checkpoint_every=ck_every)
+        r2.on_batch = lambda b: applied.append(
+            (b.partition, b.start_offset, b.end_offset))
+        assert r2.resume()
+        restored = dict(m2.consumed_offsets)
+        # the duplicate window at the kill instant, per partition
+        for p in range(n):
+            dup = frontier.get(p, 0) - restored.get(p, 0)
+            assert 0 <= dup <= ck_every * batch, (p, dup)
+        engine = r2.serving_engine(k=3, max_batch=32)
+        r2.run()
+        r2.refresh_serving()
+        engine.recommend(np.arange(4, dtype=np.int64))
+
+        # per-partition zero loss + bounded duplication
+        for p in range(n):
+            total = per_partition[p] * batch
+            covered = np.zeros(total, np.int32)
+            for part, lo, hi in applied:
+                if part == p:
+                    covered[lo:hi] += 1
+            assert (covered >= 1).all(), f"lost records in p{p}"
+            assert (covered > 1).sum() <= ck_every * batch, \
+                f"p{p} replayed more than the barrier window"
+            assert m2.consumed_offsets[p] == total
+
+        # post-resume lineage watermarks: every partition's servable
+        # frontier reached its consumed offset
+        fresh = obs.get_lineage().freshness()
+        for p in range(n):
+            assert fresh["partitions"][p]["servable_watermark"] == \
+                m2.consumed_offsets[p]
+            assert not fresh["partitions"][p]["ingest_ahead"]
+
+        # critical-path samples resolve PER PARTITION and reconcile
+        # exactly against the lineage freshness histogram (the PR 12
+        # contract, now with N partitions contributing samples)
+        samples = analyzer.samples()
+        assert {s["partition"] for s in samples} == set(range(n))
+        hist = next(m for m in reg.snapshot()["metrics"]
+                    if m["name"] == "lineage_ingest_to_servable_s")
+        assert hist["count"] == len(samples)
+        lags = [s["swap_lag_s"] for s in samples]
+        assert np.mean(lags) == pytest.approx(hist["mean"], rel=1e-6,
+                                              abs=1e-6)
+        for s in samples:
+            parts = [v for v in (s["queue_wait_s"], s["train_apply_s"],
+                                 s["swap_lag_s"]) if v is not None]
+            assert sum(parts) == pytest.approx(s["total_s"], abs=1e-9)
+        assert any(s["flush_wait_s"] is not None for s in samples)
+
+
+# --------------------------------------------------------------------------
+# The N=4 starved-feed skew pin + per-partition gauges
+# --------------------------------------------------------------------------
+
+
+class TestParallelObservability:
+    def test_starved_partition_flips_skew_at_n4(self, tmp_path,
+                                                causal_obs):
+        """The satellite-3 pin: ONE inspector shared across N=4
+        consumers sees all partitions' arrival rates, and a partition
+        trickling at ~1/20 of its peers flips the skew check to
+        DEGRADED. (A per-consumer inspector would read skew 1.0
+        forever — it never sees the starving sibling.)"""
+        from large_scale_recommendation_tpu.obs.dataquality import (
+            DataQualityInspector,
+        )
+        from large_scale_recommendation_tpu.obs.health import DEGRADED
+
+        reg, _, _ = causal_obs
+        n = 4
+        log = EventLog(str(tmp_path / "log"), num_partitions=n,
+                       fsync=False)
+        rng = np.random.default_rng(0)
+        for p in range(n):
+            per = 15 if p == 2 else 300  # partition 2 starves
+            for _ in range(3):
+                u = rng.integers(0, 30, per) * n + p
+                i = rng.integers(0, 12, per) + p * 12
+                log.append_arrays(p, u, i,
+                                  rng.random(per).astype(np.float32))
+        # duplicates priced at the workload's baseline (dense
+        # small-vocab synthetic stream runs ~30% NATURAL duplicate
+        # keys — the PR 10 class_policy lesson): the verdict this test
+        # pins must come from the SKEW, not the duplicate class
+        inspector = DataQualityInspector(
+            skew_threshold=10.0,
+            class_policy={"duplicate_key": (0.9, 1.0)},
+            registry=reg)
+        model = _online()
+        runner = ParallelIngestRunner(
+            model, log, str(tmp_path / "ckpt"),
+            config=StreamingDriverConfig(batch_records=300),
+            inspector=inspector)
+        runner.run()
+        assert inspector.last_skew >= 10.0
+        status, detail = inspector.status()
+        assert status == DEGRADED
+        assert detail.get("skewed") is True
+
+    def test_lag_gauges_published_for_all_partitions(self, tmp_path,
+                                                     causal_obs):
+        """The satellite-3 fix: a single driver only publishes its own
+        partition's ``streams_lag_records``; the runner's telemetry
+        publishes ALL N."""
+        reg, _, _ = causal_obs
+        n = 4
+        log = EventLog(str(tmp_path / "log"), num_partitions=n,
+                       fsync=False)
+        _fill_strata(log, n, 2)
+        model = _online()
+        runner = ParallelIngestRunner(
+            model, log, str(tmp_path / "ckpt"),
+            config=StreamingDriverConfig(batch_records=300))
+        runner.run()
+        runner.telemetry()
+        lag_labels = {
+            m["labels"].get("partition")
+            for m in reg.snapshot()["metrics"]
+            if m["name"] == "streams_lag_records"
+        }
+        assert lag_labels >= {str(p) for p in range(n)}
+
+
+# --------------------------------------------------------------------------
+# Delta-swap coalescing
+# --------------------------------------------------------------------------
+
+
+class TestSwapCoalescing:
+    def _engine(self, n_users=40, n_items=30, rank=4):
+        import jax.numpy as jnp
+
+        from large_scale_recommendation_tpu.data.blocking import (
+            flat_index,
+        )
+        from large_scale_recommendation_tpu.models.mf import MFModel
+        from large_scale_recommendation_tpu.serving.engine import (
+            ServingEngine,
+        )
+
+        rng = np.random.default_rng(0)
+        model = MFModel(
+            U=jnp.asarray(rng.normal(size=(n_users, rank))
+                          .astype(np.float32)),
+            V=jnp.asarray(rng.normal(size=(n_items, rank))
+                          .astype(np.float32)),
+            users=flat_index(np.arange(n_users, dtype=np.int64)),
+            items=flat_index(np.arange(n_items, dtype=np.int64)),
+        )
+        return ServingEngine(model, k=3, max_batch=32)
+
+    def test_deferred_flush_equals_eager_bitexact(self):
+        """N deferred deltas + one flush ≡ the same deltas applied
+        eagerly, bit-for-bit — with exactly ONE version bump."""
+        rng = np.random.default_rng(1)
+        a, b = self._engine(), self._engine()
+        deltas = []
+        for start in (0, 10, 20):
+            rows = np.arange(start, start + 5, dtype=np.int64)
+            vals = rng.normal(size=(5, 4)).astype(np.float32)
+            deltas.append((rows, vals))
+
+        for rows, vals in deltas:  # eager: one swap per delta
+            a.apply_delta(item_rows=rows, V_rows=vals)
+        v_before = b.version
+        versions_seen = []
+        b.on_refresh = versions_seen.append
+        for rows, vals in deltas:  # deferred: buffered, no swap
+            b.apply_delta(item_rows=rows, V_rows=vals, defer=True)
+            assert b.version == v_before
+        assert b.pending_delta_rows == 15
+        b.flush_deltas()
+        assert b.pending_delta_rows == 0
+        assert len(versions_seen) == 1  # ONE bump for three deltas
+        assert b.stats["delta_flushes"] == 1
+        np.testing.assert_array_equal(np.asarray(a.model.V),
+                                      np.asarray(b.model.V))
+        ids_a, sc_a = a.recommend(np.arange(6, dtype=np.int64))
+        ids_b, sc_b = b.recommend(np.arange(6, dtype=np.int64))
+        np.testing.assert_array_equal(ids_a, ids_b)
+        np.testing.assert_array_equal(sc_a, sc_b)
+
+    def test_newest_deferred_value_wins_per_row(self):
+        eager, deferred = self._engine(), self._engine()
+        rows = np.asarray([3], dtype=np.int64)
+        v1 = np.ones((1, 4), np.float32)
+        v2 = np.full((1, 4), 2.0, np.float32)
+        eager.apply_delta(item_rows=rows, V_rows=v1)
+        eager.apply_delta(item_rows=rows, V_rows=v2)
+        deferred.apply_delta(item_rows=rows, V_rows=v1, defer=True)
+        deferred.apply_delta(item_rows=rows, V_rows=v2, defer=True)
+        assert deferred.pending_delta_rows == 1  # newest value wins
+        deferred.flush_deltas()
+        np.testing.assert_array_equal(np.asarray(eager.model.V),
+                                      np.asarray(deferred.model.V))
+
+    def test_defer_vocab_growth_raises_at_defer_time(self):
+        e = self._engine(n_items=30)
+        with pytest.raises(ValueError, match="vocab grew"):
+            e.apply_delta(item_rows=np.asarray([30]),
+                          V_rows=np.zeros((1, 4), np.float32),
+                          defer=True)
+
+    def test_rejected_defer_leaves_nothing_pending(self):
+        """A defer with a valid item side but an out-of-bound user side
+        must buffer NEITHER half — a torn half-delta flushed later
+        would break the eager-equivalence contract."""
+        e = self._engine(n_users=40, n_items=30)
+        with pytest.raises(ValueError, match="vocab grew"):
+            e.apply_delta(item_rows=np.asarray([3]),
+                          V_rows=np.ones((1, 4), np.float32),
+                          user_rows=np.asarray([40]),
+                          U_rows=np.ones((1, 4), np.float32),
+                          defer=True)
+        assert e.pending_delta_rows == 0
+        assert e.stats["deferred_delta_rows"] == 0
+
+    def test_full_refresh_supersedes_pending_deltas(self):
+        """A full refresh() clears anything still deferred: a later
+        flush must NOT scatter stale pre-refresh vectors over the
+        fresher catalog."""
+        e = self._engine()
+        rows = np.asarray([3], dtype=np.int64)
+        e.apply_delta(item_rows=rows,
+                      V_rows=np.full((1, 4), 9.0, np.float32),
+                      defer=True)
+        assert e.pending_delta_rows == 1
+        e.refresh()  # rebuild from the bound model's CURRENT state
+        assert e.pending_delta_rows == 0
+        fresh_row = np.asarray(e.model.V)[3].copy()
+        e.flush_deltas()  # no-op: nothing pending survives the rebuild
+        np.testing.assert_array_equal(np.asarray(e.model.V)[3],
+                                      fresh_row)
+
+    def test_flush_with_nothing_pending_is_a_noop(self):
+        e = self._engine()
+        v = e.version
+        assert e.flush_deltas() == v
+        assert e.stats["delta_flushes"] == 0
+
+    def test_runner_refresh_is_one_swap_for_n_consumers(self, tmp_path):
+        """N consumers' dirty rows ship as ONE catalog version bump per
+        refresh — the anti-thrash pin."""
+        n = 3
+        log = EventLog(str(tmp_path / "log"), num_partitions=n,
+                       fsync=False)
+        _fill_strata(log, n, 2)
+        model, runner = _runner(tmp_path, log)
+        runner.run()
+        engine = runner.serving_engine(k=3, max_batch=32)
+        versions_at_bind = len(runner.catalog_versions)
+        _fill_strata(log, n, 2, seed=7)
+        runner.run()
+        runner.refresh_serving()
+        # one refresh = one new version, though all N partitions
+        # contributed dirty rows
+        assert len(runner.catalog_versions) == versions_at_bind + 1
+        assert engine.stats["delta_flushes"] == 1
+        assert engine.stats["delta_swaps"] == 1
+
+    def test_concurrent_refresh_requests_coalesce(self, tmp_path):
+        n = 2
+        log = EventLog(str(tmp_path / "log"), num_partitions=n,
+                       fsync=False)
+        _fill_strata(log, n, 2)
+        model, runner = _runner(tmp_path, log)
+        runner.run()
+        runner.serving_engine(k=3, max_batch=32)
+        # hold the refresh mid-flight and fire more requests at it
+        release = threading.Event()
+        real = runner._do_refresh
+
+        def slow(delta):
+            release.wait(5)
+            real(delta)
+
+        runner._do_refresh = slow
+        t = threading.Thread(target=runner.refresh_serving)
+        t.start()
+        time.sleep(0.05)
+        for _ in range(3):
+            runner.refresh_serving()  # absorbed, returns immediately
+        assert runner.refreshes_coalesced == 3
+        release.set()
+        t.join(timeout=10)
+        assert not runner._refreshing
+
+    def test_midship_vocab_growth_falls_back_to_full_refresh(
+            self, tmp_path):
+        """The delta=None TOCTOU: the geometry check passes, then a
+        concurrent apply grows the vocab before the delta ships — the
+        engine's bound check fires mid-delta and delta=None must FALL
+        BACK to a full rebuild, not crash the refreshing thread.
+        delta=True keeps the assertion semantics."""
+        n = 2
+        log = EventLog(str(tmp_path / "log"), num_partitions=n,
+                       fsync=False)
+        _fill_strata(log, n, 2)
+        model, runner = _runner(tmp_path, log)
+        runner.run()
+        engine = runner.serving_engine(k=3, max_batch=32)
+        _fill_strata(log, n, 1, seed=5)
+        runner.run()
+        real = engine.apply_delta
+        calls = [0]
+
+        def grown_midship(*a, **kw):
+            calls[0] += 1
+            raise ValueError("delta row 999 outside catalog of 10 rows "
+                             "— vocab grew; use refresh()")
+
+        engine.apply_delta = grown_midship
+        refreshes_before = engine.stats["refreshes"]
+        runner.refresh_serving(delta=None)  # falls back, no raise
+        assert calls[0] >= 1
+        assert engine.stats["refreshes"] == refreshes_before + 1
+        # delta=True asserts instead of falling back
+        _fill_strata(log, n, 1, seed=6)
+        runner.run()
+        with pytest.raises(ValueError, match="vocab grew"):
+            runner.refresh_serving(delta=True)
+        engine.apply_delta = real
+
+    def test_stop_before_run_wins(self, tmp_path):
+        """A stop delivered before the consume loop starts must make
+        the next run exit immediately (the runner's stop() racing a
+        consumer thread that hadn't entered run() yet used to be
+        erased by run()'s unconditional clear — a follow-mode loop then
+        tailed forever). The consumed stop does not leak: the run
+        after it drains normally."""
+        from large_scale_recommendation_tpu.streams import (
+            StreamingDriver,
+        )
+
+        log = EventLog(str(tmp_path / "log"), fsync=False)
+        _fill_strata(log, 1, 3)
+        drv = StreamingDriver(
+            _online(), log, str(tmp_path / "ckpt"),
+            config=StreamingDriverConfig(batch_records=300))
+        drv.stop()
+        assert drv.run(follow=True) == 0  # would hang before the fix
+        assert drv.run() == 3  # pending stop consumed, next run drains
+
+    def test_delta_matches_full_refresh(self, tmp_path):
+        """Runner delta shipping ≡ full rebuild, bit-for-bit on the
+        same engine: a full refresh immediately after a delta refresh
+        must change NOTHING (the delta missed no dirty row)."""
+        n = 2
+        log = EventLog(str(tmp_path / "log"), num_partitions=n,
+                       fsync=False)
+        _fill_strata(log, n, 2)
+        model, runner = _runner(tmp_path, log)
+        runner.run()
+        engine = runner.serving_engine(k=3, max_batch=32)
+        _fill_strata(log, n, 1, seed=5)
+        runner.run()
+        runner.refresh_serving(delta=True)
+        V_delta = np.asarray(engine.model.V).copy()
+        U_delta = np.asarray(engine.model.U).copy()
+        runner.refresh_serving(delta=False)  # authoritative rebuild
+        np.testing.assert_array_equal(V_delta,
+                                      np.asarray(engine.model.V))
+        np.testing.assert_array_equal(U_delta,
+                                      np.asarray(engine.model.U))
