@@ -56,6 +56,7 @@ from large_scale_recommendation_tpu.models.online import (
     OnlineMF,
     OnlineMFConfig,
 )
+from large_scale_recommendation_tpu.obs.contention import named_rlock
 from large_scale_recommendation_tpu.obs.disttrace import get_disttrace
 from large_scale_recommendation_tpu.obs.events import get_events
 from large_scale_recommendation_tpu.obs.lineage import get_lineage
@@ -153,7 +154,10 @@ class AdaptiveMF:
         # across consumers. OFF by default: the single-driver path
         # never acquires it.
         self._serialize_process = False
-        self.apply_lock = threading.RLock()
+        # named_rlock: raw unless the contention plane is armed, in
+        # which case the serialized-apply lock publishes as
+        # lock_*{lock="adaptive.apply_lock"}
+        self.apply_lock = named_rlock("adaptive.apply_lock")
 
     # -- state -------------------------------------------------------------
 
@@ -292,7 +296,7 @@ class AdaptiveMF:
                    if self._trace.enabled else None)
             self._thread = threading.Thread(
                 target=self._retrain_into_slot, args=(history, ctx),
-                daemon=True
+                daemon=True, name="adaptive-retrain"
             )
             self._thread.start()
         else:

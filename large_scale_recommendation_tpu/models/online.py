@@ -51,6 +51,7 @@ from large_scale_recommendation_tpu.core.types import (
 )
 from large_scale_recommendation_tpu.core.updaters import SGDUpdater
 from large_scale_recommendation_tpu.data.tables import GrowableFactorTable
+from large_scale_recommendation_tpu.obs.contention import named_rlock
 from large_scale_recommendation_tpu.obs.events import get_events
 from large_scale_recommendation_tpu.obs.registry import get_registry
 from large_scale_recommendation_tpu.obs.trace import get_tracer
@@ -204,7 +205,12 @@ class OnlineMF:
         # RowConflictGate enforces (two concurrent applies never share
         # a user or item row between snapshot and commit).
         self._concurrent = False
-        self.apply_lock = threading.RLock()
+        # named_rlock: a RAW threading.RLock unless the contention
+        # plane is armed (obs.enable_contention), in which case waits/
+        # holds on the concurrent-apply lock publish as
+        # lock_*{lock="online.apply_lock"} — binds at construction,
+        # like every obs hook
+        self.apply_lock = named_rlock("online.apply_lock")
         # optional RowConflictGate (streams.parallel): when set, the
         # concurrent path holds a claim on the batch's user+item ids
         # for the whole snapshot→commit window — genuinely colliding

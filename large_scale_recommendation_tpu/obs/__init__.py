@@ -47,6 +47,18 @@ package is that instrumentation layer, shared by every runtime tier:
   ``RecResult.catalog_version`` into staleness/freshness telemetry and
   an ingest→serve ``FreshnessCheck`` SLO (``/lineagez``).
 
+- ``obs.contention`` — the CONCURRENCY plane: instrumented
+  ``Lock``/``RLock``/``Condition`` wrappers over the named hot locks
+  (``lock_wait_s{lock=}``/``lock_hold_s{lock=}`` histograms,
+  acquisition/contention counters, a current-waiters gauge), a
+  per-thread CPU sampler (utilization + runnable-vs-blocked fractions
+  per named thread), and a ``SaturationAnalyzer`` joining lock waits,
+  thread windows and the per-partition ``streams_*`` gauges into an
+  Amdahl decomposition of an N-consumer run — Karp–Flatt
+  ``serial_fraction``, top contended locks, per-partition blocked
+  share, projected speedup at 2N (``/contentionz``;
+  ``scripts/obs_report.py --contention``).
+
 - ``obs.disttrace`` — the CAUSAL plane: deterministic cross-process
   trace identity (``record_trace_id`` — WAL offsets are the
   propagation tokens; ``TraceContext`` carries trace id + parent span
@@ -88,6 +100,20 @@ from large_scale_recommendation_tpu.obs.anomaly import (
     MonotonicGrowthCheck,
     ewma_zscore,
     rate_of_change,
+)
+from large_scale_recommendation_tpu.obs.contention import (
+    ContentionTracker,
+    InstrumentedCondition,
+    InstrumentedLock,
+    InstrumentedRLock,
+    SaturationAnalyzer,
+    amdahl_speedup,
+    get_contention,
+    karp_flatt_serial_fraction,
+    named_condition,
+    named_lock,
+    named_rlock,
+    set_contention,
 )
 from large_scale_recommendation_tpu.obs.dataquality import (
     DataQualityInspector,
@@ -219,6 +245,19 @@ __all__ = [
     "get_lineage",
     "set_lineage",
     "enable_lineage",
+    "ContentionTracker",
+    "SaturationAnalyzer",
+    "InstrumentedLock",
+    "InstrumentedRLock",
+    "InstrumentedCondition",
+    "karp_flatt_serial_fraction",
+    "amdahl_speedup",
+    "named_lock",
+    "named_rlock",
+    "named_condition",
+    "get_contention",
+    "set_contention",
+    "enable_contention",
     "TraceContext",
     "process_namespace",
     "CriticalPathAnalyzer",
@@ -327,11 +366,34 @@ def enable_disttrace(capacity: int = 256,
     return analyzer
 
 
+def enable_contention(interval_s: float = 1.0, start: bool = True,
+                      **tracker_kwargs) -> ContentionTracker:
+    """Install a ``ContentionTracker`` as the module-level default —
+    the concurrency plane every ``named_lock``/``named_rlock``/
+    ``named_condition`` site resolves. Call AFTER ``enable()`` (the
+    tracker binds the live registry for its ``lock_*``/``thread_*``/
+    ``contention_*`` instruments; under the null layer it still tracks
+    its own lock/thread stats and publishes nothing) and BEFORE
+    building the models/engines/drivers whose locks you want
+    instrumented — primitives bind at construction, same as every
+    other plane. Starts the thread sampler unless ``start=False``.
+    Returns the tracker (served at ``/contentionz`` by any subsequently
+    built ``ObsServer``)."""
+    prev = get_contention()
+    if prev is not None:  # re-enable must not leak the old sampler
+        prev.stop()
+    tracker = ContentionTracker(**tracker_kwargs)
+    set_contention(tracker)
+    if start:
+        tracker.start(interval_s)
+    return tracker
+
+
 def disable() -> None:
     """Restore the zero-cost defaults: null registry/tracer, no flight
-    recorder, event journal or lineage journal, and no introspector
-    (its compile hook is removed and sampler threads are stopped
-    first)."""
+    recorder, event journal, lineage journal or contention tracker,
+    and no introspector (its compile hook is removed and sampler
+    threads are stopped first)."""
     from large_scale_recommendation_tpu.obs import registry as _r
     from large_scale_recommendation_tpu.obs import trace as _t
 
@@ -341,6 +403,10 @@ def disable() -> None:
     introspector = get_introspector()
     if introspector is not None:
         introspector.close()
+    contention = get_contention()
+    if contention is not None:
+        contention.stop()
+    set_contention(None)
     set_introspector(None)
     set_recorder(None)
     set_events(None)

@@ -257,6 +257,73 @@ class FleetAggregator:
         out["unreachable"] = skipped
         return out
 
+    def contention(self, top_k: int = 8) -> dict:
+        """Scrape every target's ``/contentionz`` into one pod
+        saturation view: per-host Amdahl summaries, the pod lock table
+        merged BY LOCK NAME (wait/hold/acquisition totals summed — the
+        processes run the same code, so a name prices the same lock
+        class fleet-wide), and a capacity-weighted pod
+        ``serial_fraction`` (each host's estimate weighted by its
+        N·wall window capacity). Targets with no tracker installed
+        report their note and contribute nothing; unreachable targets
+        are listed — a partial pod view beats none."""
+        per_target = []
+        skipped: list[str] = []
+        lock_rows: dict[str, dict] = {}
+        cap_total = 0.0
+        serial_weighted = 0.0
+        for url in self.targets:
+            host = _host_of(url)
+            code, body = http_get(url + "/contentionz",
+                                  timeout=self.timeout_s)
+            if code != 200:
+                skipped.append(host)
+                continue
+            try:
+                doc = json.loads(body)
+            except json.JSONDecodeError:
+                skipped.append(host)
+                continue
+            per_target.append({
+                "host": host, "url": url,
+                "note": doc.get("note"),
+                "consumers": doc.get("consumers"),
+                "wall_s": (doc.get("window") or {}).get("wall_s"),
+                "capacity_s": doc.get("capacity_s"),
+                "efficiency": doc.get("efficiency"),
+                "serial_fraction": doc.get("serial_fraction"),
+                "lock_wait_s_total": doc.get("lock_wait_s_total"),
+            })
+            for row in doc.get("locks", []):
+                agg = lock_rows.setdefault(
+                    row["lock"], {"lock": row["lock"],
+                                  "kind": row.get("kind"),
+                                  "acquisitions": 0, "contended": 0,
+                                  "wait_s": 0.0, "hold_s": 0.0,
+                                  "hosts": 0})
+                agg["acquisitions"] += row.get("acquisitions", 0)
+                agg["contended"] += row.get("contended", 0)
+                agg["wait_s"] += row.get("wait_s", 0.0)
+                agg["hold_s"] += row.get("hold_s", 0.0)
+                agg["hosts"] += 1
+            s, cap = doc.get("serial_fraction"), doc.get("capacity_s")
+            if s is not None and cap:
+                serial_weighted += s * cap
+                cap_total += cap
+        merged = sorted(lock_rows.values(),
+                        key=lambda r: (-r["wait_s"], -r["acquisitions"]))
+        return {
+            "time": time.time(),
+            "targets": per_target,
+            "unreachable": skipped,
+            "locks": merged,
+            "top_contended": merged[:top_k],
+            "serial_fraction": (serial_weighted / cap_total
+                                if cap_total > 0 else None),
+            "capacity_s": cap_total,
+            "lock_wait_s_total": sum(r["wait_s"] for r in merged),
+        }
+
     def healthz(self) -> tuple[int, dict]:
         """(http_status, pod report) — 503 iff the pod aggregate is
         CRITICAL (including any unreachable member), the same contract
@@ -282,7 +349,9 @@ class FleetServer(EndpointServerBase):
     (merged Prometheus text), ``/healthz`` (pod aggregate JSON, 503 on
     CRITICAL — ``/healthz``-only scrape), ``/fleetz`` (full per-target
     view), ``/podtracez`` (the assembled pod timeline — load it at
-    https://ui.perfetto.dev). Rides ``obs.server.EndpointServerBase``
+    https://ui.perfetto.dev), ``/contentionz`` (the pod saturation
+    view: per-host Amdahl summaries + the lock table merged by name).
+    Rides ``obs.server.EndpointServerBase``
     — the SAME lifecycle/handler plumbing as the per-process
     ``ObsServer``, so the HTTP semantics cannot drift between the
     two."""
@@ -310,8 +379,10 @@ class FleetServer(EndpointServerBase):
                 return 400, {"error": err}
             return 200, self.aggregator.pod_trace(
                 limit=8192 if limit is None else limit)
+        if path == "/contentionz":
+            return 200, self.aggregator.contention()
         if path == "/":
             return 200, {"routes": ["/metrics", "/healthz", "/fleetz",
-                                    "/podtracez"],
+                                    "/podtracez", "/contentionz"],
                          "targets": self.aggregator.targets}
         return None

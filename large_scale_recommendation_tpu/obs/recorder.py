@@ -27,7 +27,9 @@ snapshots into one atomic bundle directory the moment something breaks.
   ``events.jsonl``, ``trace.json`` (span tail), ``health.json``,
   ``metrics.json``, ``config.json``, ``device_memory.json``,
   ``lineage.json`` (catalog-swap provenance + the latest quality /
-  data-quality snapshots) and a ``manifest.json`` indexing them. Triggers: watchdog trip, a CRITICAL health transition
+  data-quality snapshots), ``contention.json`` (the saturation
+  analyzer's lock/thread window) and a ``manifest.json`` indexing
+  them. Triggers: watchdog trip, a CRITICAL health transition
   (``HealthMonitor``), or an explicit ``dump()``. ``validate_bundle``
   is the schema contract the golden test and ``scripts/obs_report.py
   --bundle`` both run.
@@ -60,18 +62,21 @@ from large_scale_recommendation_tpu.obs.trace import get_tracer
 
 # version 2 added device_memory.json; version 3 added lineage.json (the
 # model-plane freeze: catalog-swap provenance + the latest quality and
-# data-quality gauge snapshots). Bundles written before each layer must
+# data-quality gauge snapshots); version 4 added contention.json (the
+# concurrency-plane freeze: the saturation analyzer's Amdahl window +
+# lock table at incident time). Bundles written before each layer must
 # stay loadable — an ARCHIVED incident bundle is exactly the artifact
 # this module exists to preserve, so the loader validates per the
 # version it finds
-BUNDLE_VERSION = 3
+BUNDLE_VERSION = 4
 BUNDLE_FILES = ("series.json", "events.jsonl", "trace.json", "health.json",
                 "metrics.json", "config.json", "device_memory.json",
-                "lineage.json")
+                "lineage.json", "contention.json")
 _BUNDLE_FILES_BY_VERSION = {
-    1: BUNDLE_FILES[:-2],
-    2: BUNDLE_FILES[:-1],
-    3: BUNDLE_FILES,
+    1: BUNDLE_FILES[:-3],
+    2: BUNDLE_FILES[:-2],
+    3: BUNDLE_FILES[:-1],
+    4: BUNDLE_FILES,
 }
 # env prefixes worth freezing into a bundle — runtime knobs, never secrets
 _ENV_PREFIXES = ("JAX_", "XLA_", "OBS_", "BENCH_", "LIBTPU", "TPU_")
@@ -462,6 +467,27 @@ def write_bundle(directory: str, *, trigger: str, detail: dict | None = None,
         "quality": _metric_subset("eval_"),
         "data_quality": _metric_subset("dataq_"),
     }
+    # the concurrency-plane freeze: the saturation analyzer's Amdahl
+    # window + lock table at incident time — "was the stall a lock?"
+    # must be answerable without a live process. Graceful everywhere:
+    # no tracker -> a note doc; a failing snapshot must not void the
+    # bundle
+    from large_scale_recommendation_tpu.obs.contention import (
+        SaturationAnalyzer,
+        get_contention,
+    )
+
+    contention_tracker = get_contention()
+    if contention_tracker is not None:
+        try:
+            contention_doc = SaturationAnalyzer(
+                contention_tracker, registry=registry).snapshot()
+        except Exception as e:
+            contention_doc = {"note": f"snapshot failed: {e!r}",
+                              "locks": [], "partitions": {}}
+    else:
+        contention_doc = {"note": "no contention tracker installed",
+                          "locks": [], "partitions": {}}
     config_doc = {
         "time": created,
         "pid": os.getpid(),
@@ -506,6 +532,7 @@ def write_bundle(directory: str, *, trigger: str, detail: dict | None = None,
         _write_json("config.json", config_doc)
         _write_json("device_memory.json", device_memory_doc)
         _write_json("lineage.json", lineage_doc)
+        _write_json("contention.json", contention_doc)
         _write_json("manifest.json", manifest)
         if os.path.isdir(directory):  # re-dump to the same explicit path
             import shutil
@@ -617,10 +644,18 @@ def load_bundle(directory: str) -> dict:
                            "freeze)",
                    "lineage": {"records": []}, "quality": [],
                    "data_quality": []}
+    if "contention.json" in required_files:
+        contention = _load("contention.json")
+        if not isinstance(contention.get("locks"), list):
+            raise ValueError(f"bundle {directory}: contention.json has "
+                             "no locks list")
+    else:  # pre-concurrency-plane bundle (version <= 3)
+        contention = {"note": f"version-{version} bundle (no contention "
+                              "freeze)", "locks": [], "partitions": {}}
     return {"manifest": manifest, "series": series, "events": events,
             "trace": trace, "health": health, "metrics": metrics,
             "config": config, "device_memory": device_memory,
-            "lineage": lineage}
+            "lineage": lineage, "contention": contention}
 
 
 def validate_bundle(directory: str) -> dict:

@@ -30,6 +30,12 @@ minimal, can expose its live state to a scraper or a ``curl``:
   (queue wait / train apply / swap lag / flush wait) plus the newest
   completed samples (``scripts/obs_report.py --critical-path``
   renders it).
+- ``/contentionz`` — concurrency & saturation
+  (``obs.contention.SaturationAnalyzer``): the Amdahl decomposition of
+  the current N-consumer window (efficiency, Karp–Flatt
+  ``serial_fraction``, projected speedup at 2N), the top contended
+  locks, and per-partition busy/blocked shares
+  (``scripts/obs_report.py --contention`` renders it).
 - ``/profilez``  — on-demand ``jax.profiler`` capture:
   ``GET /profilez?seconds=N`` records N seconds (capped, default 1)
   of the whole process into an artifact directory (``profile_dir`` or
@@ -64,6 +70,7 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from large_scale_recommendation_tpu.obs.contention import get_contention
 from large_scale_recommendation_tpu.obs.disttrace import get_disttrace
 from large_scale_recommendation_tpu.obs.events import get_events
 from large_scale_recommendation_tpu.obs.health import CRITICAL
@@ -246,7 +253,7 @@ class ObsServer(EndpointServerBase):
 
     def __init__(self, registry=None, tracer=None, monitor=None,
                  recorder=None, events=None, introspector=None,
-                 lineage=None, disttrace=None,
+                 lineage=None, disttrace=None, contention=None,
                  host: str = "127.0.0.1", port: int = 0,
                  tracez_limit: int = DEFAULT_TRACEZ_LIMIT,
                  eventz_limit: int = DEFAULT_EVENTZ_LIMIT,
@@ -264,6 +271,8 @@ class ObsServer(EndpointServerBase):
         self.lineage = lineage if lineage is not None else get_lineage()
         self.disttrace = (disttrace if disttrace is not None
                           else get_disttrace())
+        self.contention = (contention if contention is not None
+                           else get_contention())
         self.profile_dir = profile_dir
         self.eventz_limit = int(eventz_limit)
         self.tracez_limit = int(tracez_limit)
@@ -292,6 +301,8 @@ class ObsServer(EndpointServerBase):
             return 200, self.lineagez()
         if path == "/criticalpathz":
             return 200, self.criticalpathz()
+        if path == "/contentionz":
+            return 200, self.contentionz()
         if path == "/profilez":
             from urllib.parse import parse_qs
 
@@ -305,7 +316,8 @@ class ObsServer(EndpointServerBase):
             return 200, {"routes": ["/metrics", "/healthz", "/varz",
                                     "/tracez", "/seriesz", "/eventz",
                                     "/rooflinez", "/lineagez",
-                                    "/criticalpathz", "/profilez"]}
+                                    "/criticalpathz", "/contentionz",
+                                    "/profilez"]}
         return None
 
     # -- route bodies (shared with tests / in-process callers) --------------
@@ -359,6 +371,18 @@ class ObsServer(EndpointServerBase):
                             "(obs.enable_disttrace())", "samples": [],
                     "stages": {}}
         return self.disttrace.snapshot()
+
+    def contentionz(self) -> dict:
+        if self.contention is None:
+            return {"note": "no contention tracker installed "
+                            "(obs.enable_contention())", "locks": [],
+                    "top_contended": [], "partitions": {}}
+        from large_scale_recommendation_tpu.obs.contention import (
+            SaturationAnalyzer,
+        )
+
+        return SaturationAnalyzer(self.contention,
+                                  registry=self.registry).snapshot()
 
     def profilez(self, seconds: float | None = None) -> tuple[int, dict]:
         """(http_status, body) for ``/profilez``: run one N-second

@@ -68,6 +68,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from large_scale_recommendation_tpu.models.mf import MFModel, _assemble_topk
+from large_scale_recommendation_tpu.obs.contention import named_rlock
 from large_scale_recommendation_tpu.obs.disttrace import get_disttrace
 from large_scale_recommendation_tpu.obs.events import get_events
 from large_scale_recommendation_tpu.obs.lineage import get_lineage
@@ -183,7 +184,10 @@ class ServingEngine:
         # batches, which let the admission ladder relax while backlogged
         # requests were still seconds late — measured in the traffic sim)
         self._pending_t: list[float] = []
-        self._lock = threading.RLock()
+        # named_rlock: raw unless the contention plane is armed, in
+        # which case the engine's submit/flush/refresh serialization
+        # publishes as lock_*{lock="serving.engine"}
+        self._lock = named_rlock("serving.engine")
         self.stats = {"requests": 0, "rows": 0, "microbatches": 0,
                       "flushes": 0, "refreshes": 0, "delta_swaps": 0,
                       "deferred_delta_rows": 0, "delta_flushes": 0,

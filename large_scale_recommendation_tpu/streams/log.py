@@ -86,8 +86,14 @@ class _Partition:
         # guards self.segments against the reader/truncator race: the
         # driver's consumer thread truncates on checkpoint while the
         # QueuedSource feeder thread reads the tail (re-entrant: _read
-        # calls refresh)
-        self._lock = threading.RLock()
+        # calls refresh). named_rlock: raw unless the contention plane
+        # is armed — producer-append vs tail-read serialization then
+        # publishes as lock_*{lock="streams.wal_partition"}
+        from large_scale_recommendation_tpu.obs.contention import (
+            named_rlock,
+        )
+
+        self._lock = named_rlock("streams.wal_partition")
         self._scan()
 
     # -- recovery-on-open ---------------------------------------------------

@@ -62,6 +62,10 @@ from typing import Any, Callable, Iterable
 
 import numpy as np
 
+from large_scale_recommendation_tpu.obs.contention import (
+    named_condition,
+    named_lock,
+)
 from large_scale_recommendation_tpu.streams.driver import (
     StreamingDriver,
     StreamingDriverConfig,
@@ -125,11 +129,25 @@ class RowConflictGate:
     """
 
     def __init__(self):
-        self._cv = threading.Condition()
+        from large_scale_recommendation_tpu.obs.registry import (
+            get_registry,
+        )
+
+        # named_condition: raw unless the contention plane is armed —
+        # a genuinely colliding batch's wait then publishes as
+        # lock_wait_s{lock="streams.row_conflict_gate"}
+        self._cv = named_condition("streams.row_conflict_gate")
         self._users: set[int] = set()
         self._items: set[int] = set()
         self.grants = 0
         self.waits = 0
+        # grants/waits as REGISTRY counters too (they were runner-local
+        # telemetry dict entries only): /metrics, the flight recorder
+        # and fleet aggregation all see whether routing delivers
+        # disjointness — null singletons when obs is off
+        obs = get_registry()
+        self._m_grants = obs.counter("streams_gate_grants_total")
+        self._m_waits = obs.counter("streams_gate_waits_total")
 
     def acquire(self, user_ids, item_ids) -> tuple[set, set]:
         # tolist() then set(): both C-speed — a Python comprehension
@@ -144,11 +162,13 @@ class RowConflictGate:
                        and i.isdisjoint(self._items)):
                 if not waited:
                     self.waits += 1
+                    self._m_waits.inc()
                     waited = True
                 self._cv.wait()
             self._users |= u
             self._items |= i
             self.grants += 1
+            self._m_grants.inc()
         return u, i
 
     def release(self, token: tuple[set, set]) -> None:
@@ -243,11 +263,11 @@ class ParallelIngestRunner:
         # partition; one lock for the trigger accounting (held briefly
         # per batch — the snapshot itself is taken under the MODEL's
         # apply_lock, and the npz write happens outside both)
-        self._barrier_lock = threading.Lock()
+        self._barrier_lock = named_lock("streams.barrier")
         # serializes the (slow) snapshot WRITES: captures overlap with
         # applies by design, but two in-flight npz writes would race
         # the manager's retention sweep
-        self._write_lock = threading.Lock()
+        self._write_lock = named_lock("streams.ckpt_write")
         self._frontier: dict[int, int] = {}
         self._since_barrier: dict[int, int] = {p: 0
                                                for p in self.partitions}
@@ -258,7 +278,7 @@ class ParallelIngestRunner:
         # per-partition swap stamps), but ONLY the runner swaps them
         self._engines: list = []
         self.catalog_versions: list[int] = []
-        self._refresh_lock = threading.Lock()
+        self._refresh_lock = named_lock("streams.refresh")
         self._refreshing = False
         # None = nothing pending; (delta,) = a coalesced request (the
         # 1-tuple keeps delta=None distinguishable from "no request")
@@ -266,11 +286,20 @@ class ParallelIngestRunner:
         self.refreshes_coalesced = 0
         self._threads: list[threading.Thread] = []
         self._error: BaseException | None = None
+        from large_scale_recommendation_tpu.obs.contention import (
+            get_contention,
+        )
         from large_scale_recommendation_tpu.obs.events import get_events
         from large_scale_recommendation_tpu.obs.registry import (
             get_registry,
         )
 
+        # concurrency plane (obs.contention): None unless installed —
+        # consumer threads check in/out of the named-thread registry so
+        # even a rung that drains between two sampler ticks prices its
+        # per-partition busy time (one `is not None` test per thread
+        # LIFETIME, nothing per batch)
+        self._contention = get_contention()
         obs = get_registry()
         self._obs = obs
         self._obs_on = obs.enabled
@@ -278,6 +307,13 @@ class ParallelIngestRunner:
         self._m_barriers = obs.counter("streams_barrier_checkpoints_total")
         self._m_ckpt = obs.histogram("streams_checkpoint_s",
                                      partition="all")
+        # barriers_held / refreshes_coalesced as registry counters too
+        # (they were runner-local ints only — satellite, ISSUE 14):
+        # the frozen-stamp hold rate and swap-coalescing rate are
+        # saturation signals the fleet plane needs to see
+        self._m_held = obs.counter("streams_barriers_held_total")
+        self._m_coalesced = obs.counter(
+            "streams_refreshes_coalesced_total")
 
     # -- recovery ------------------------------------------------------------
 
@@ -373,6 +409,7 @@ class ParallelIngestRunner:
                 return False
             if not self._stamps_caught_up():
                 self.barriers_held += 1
+                self._m_held.inc()
                 return False
             arrays, meta = self._capture_locked()
         self._write_snapshot(arrays, meta)
@@ -447,6 +484,9 @@ class ParallelIngestRunner:
         applied = {p: 0 for p in self.partitions}
 
         def consume(p: int, driver: StreamingDriver) -> None:
+            ct = self._contention
+            if ct is not None:
+                ct.note_thread_start()
             try:
                 applied[p] = driver.run(max_batches=max_batches,
                                         follow=follow)
@@ -454,6 +494,9 @@ class ParallelIngestRunner:
                 if self._error is None:
                     self._error = exc
                 self.stop()
+            finally:
+                if ct is not None:
+                    ct.note_thread_end()
 
         self._threads = [
             threading.Thread(target=consume, args=(p, d), daemon=True,
@@ -482,12 +525,18 @@ class ParallelIngestRunner:
             d._stop.clear()              # run())
 
         def consume(driver: StreamingDriver) -> None:
+            ct = self._contention
+            if ct is not None:
+                ct.note_thread_start()
             try:
                 driver.run(follow=follow)
             except BaseException as exc:
                 if self._error is None:
                     self._error = exc
                 self.stop()
+            finally:
+                if ct is not None:
+                    ct.note_thread_end()
 
         self._threads = [
             threading.Thread(target=consume, args=(d,), daemon=True,
@@ -569,6 +618,7 @@ class ParallelIngestRunner:
                 # coalescing; True is a testing knob)
                 self._refresh_pending = (delta,)
                 self.refreshes_coalesced += 1
+                self._m_coalesced.inc()
                 return
             self._refreshing = True
         try:

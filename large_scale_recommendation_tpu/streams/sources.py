@@ -164,10 +164,17 @@ class IngestQueue:
         self.stats = IngestStats()
         self._items: list[StreamBatch] = []
         self._closed = False
-        self._cv = threading.Condition()
-        # structured event journal (obs.events): None unless installed —
-        # quarantine/shed emissions are one `is not None` test each
+        # named_condition: raw unless the contention plane is armed —
+        # producer backpressure blocks and consumer dequeue waits then
+        # publish as lock_*{lock="streams.ingest_queue"} (every queue
+        # instance shares the one stats row: the analyzer prices the
+        # queue CLASS, not one partition's instance)
+        from large_scale_recommendation_tpu.obs.contention import (
+            named_condition,
+        )
         from large_scale_recommendation_tpu.obs.events import get_events
+
+        self._cv = named_condition("streams.ingest_queue")
 
         self._events = get_events()
 
@@ -489,7 +496,11 @@ class QueuedSource:
 
     def start(self) -> "QueuedSource":
         if self._thread is None:
-            self._thread = threading.Thread(target=self._feed, daemon=True)
+            # named so the contention plane's thread sampler can
+            # attribute feeder CPU/blocked time per partition
+            part = getattr(self.source, "partition", "?")
+            self._thread = threading.Thread(
+                target=self._feed, daemon=True, name=f"wal-feed-p{part}")
             self._thread.start()
         return self
 

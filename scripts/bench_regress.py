@@ -196,7 +196,17 @@ DEFAULT_LOWER = ("_wall_s", "_ms_", "time_to_", "_s_p", "_pad_ratio",
                  "rmse_final", "staleness_s", "critical_path",
                  # ingest family (ISSUE 13): recovery-after-kill wall
                  # and the per-partition replay window regress UP
-                 "recovery_s", "duplicate_window")
+                 "recovery_s", "duplicate_window",
+                 # contention plane (ISSUE 14): a rising Amdahl serial
+                 # fraction or per-rung lock-wait total is a
+                 # serialization regression even when throughput noise
+                 # hides it (covers serial_fraction_n<K> and
+                 # lock_wait_s_total_n<K>). Watched via --key on rounds
+                 # that carry them — not in the family default set: the
+                 # pre-ISSUE-14 committed round lacks the keys, and a
+                 # default watch key the baseline can't contain is
+                 # permanent "missing" noise (the PR 10/13 lesson).
+                 "serial_fraction", "lock_wait")
 
 _NUM_PAIR = re.compile(
     r'"([A-Za-z_][A-Za-z0-9_]*)":\s*(-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)')

@@ -44,6 +44,15 @@ summary (queue wait / train apply / swap lag / flush wait) and the
 newest completed samples. ``src`` is a ``/criticalpathz`` URL or a
 dumped snapshot JSON.
 
+``--contention <src>`` renders the concurrency & saturation plane
+(``obs.contention.SaturationAnalyzer``): the Amdahl window summary
+(consumers, efficiency, Karp–Flatt serial fraction, projected speedup
+at 2N), the contended-lock table, and per-partition busy/blocked
+shares joined with their ``streams_*`` gauges. ``src`` is a
+``/contentionz`` URL, a dumped snapshot JSON (the streams_bench
+sustained pass writes one), a bundle ``contention.json``, or a fleet
+``/contentionz`` pod aggregate.
+
 Input is a single-snapshot JSON file, a JSONL metrics log
 (``MetricsRegistry.append_jsonl``), or — live mode — an HTTP URL to a
 running ``obs.server.ObsServer``'s ``/varz`` route. For JSONL the LAST
@@ -442,6 +451,75 @@ def render_critical_path(doc: dict, tail: int = 20) -> str:
     return "\n".join(out)
 
 
+def render_contention(doc: dict, tail: int = 20) -> str:
+    """Render a ``/contentionz`` body (or dumped snapshot / bundle
+    ``contention.json`` / fleet pod aggregate): the Amdahl window
+    summary, the contended-lock table (wait/hold/acquisition columns),
+    and — per-process docs — one row per consumer partition with its
+    busy/blocked split and ``streams_*`` joins."""
+    window = doc.get("window") or {}
+    head = ["# concurrency & saturation"]
+    if doc.get("note"):
+        head[0] += f" — note: {doc['note']}"
+    summary = (f"consumers: {_fmt(doc.get('consumers'))}; "
+               f"window: {_fmt(window.get('wall_s'))}s wall, "
+               f"{_fmt(doc.get('capacity_s'))}s capacity; "
+               f"busy {_fmt(doc.get('busy_s'))}s / blocked "
+               f"{_fmt(doc.get('blocked_s'))}s")
+    head.append(summary)
+    head.append(
+        f"efficiency: {_fmt(doc.get('efficiency'))}; serial fraction "
+        f"(Karp–Flatt): {_fmt(doc.get('serial_fraction'))}; projected "
+        f"speedup at 2N: {_fmt(doc.get('projected_speedup_at_2n'))}; "
+        f"Amdahl limit: {_fmt(doc.get('amdahl_limit'))}"
+        + (f" (cpu: {doc['cpu_source']})" if doc.get("cpu_source")
+           else ""))
+    head.append(f"lock wait total: "
+                f"{_fmt(doc.get('lock_wait_s_total'))}s")
+    out = head + [""]
+    locks = doc.get("locks", [])
+    if locks:
+        rows = [(r["lock"], str(r.get("kind") or "-"),
+                 _fmt(r.get("acquisitions")), _fmt(r.get("contended")),
+                 _fmt(r.get("cv_waits")), _fmt(r.get("wait_s")),
+                 _fmt(r.get("hold_s")),
+                 _fmt(r.get("wait_frac_of_capacity")))
+                for r in locks[:tail]]
+        out.extend(format_table(("lock", "kind", "acq", "contended",
+                                 "cv_waits", "wait_s", "hold_s",
+                                 "wait/cap"), rows))
+        out.append("")
+    else:
+        out.append("(no lock activity in window — arm "
+                   "obs.enable_contention() before building the "
+                   "models/drivers/engines)")
+    partitions = doc.get("partitions") or {}
+    if partitions:
+        rows = [(p, str(row.get("thread") or "-"),
+                 _fmt(row.get("busy_s")), _fmt(row.get("blocked_s")),
+                 _fmt(row.get("blocked_frac")),
+                 _fmt(row.get("records_total")),
+                 _fmt(row.get("lag_records")),
+                 _fmt(row.get("queue_depth")))
+                for p, row in sorted(partitions.items())]
+        out.extend(format_table(("part", "thread", "busy_s",
+                                 "blocked_s", "blocked%", "records",
+                                 "lag", "queue"), rows))
+        out.append("")
+    targets = doc.get("targets")
+    if targets:  # a fleet pod aggregate: per-host summaries ride along
+        rows = [(str(t.get("host")), _fmt(t.get("consumers")),
+                 _fmt(t.get("wall_s")), _fmt(t.get("efficiency")),
+                 _fmt(t.get("serial_fraction")),
+                 _fmt(t.get("lock_wait_s_total")),
+                 str(t.get("note") or "-"))
+                for t in targets]
+        out.extend(format_table(("host", "consumers", "wall_s", "eff",
+                                 "serial", "lock_wait_s", "note"), rows))
+        out.append("")
+    return "\n".join(out).rstrip()
+
+
 QUALITY_PREFIXES = ("eval_", "dataq_", "lineage_")
 
 
@@ -519,6 +597,13 @@ def main(argv=None) -> int:
                     help="render the ingest→servable critical-path "
                          "stage table from a /criticalpathz URL or a "
                          "dumped analyzer snapshot JSON")
+    ap.add_argument("--contention", default=None, metavar="SRC",
+                    help="render the concurrency/saturation table "
+                         "(Amdahl summary + contended locks + "
+                         "per-partition blocked shares) from a "
+                         "/contentionz URL, a dumped snapshot JSON, a "
+                         "bundle contention.json, or a fleet pod "
+                         "aggregate")
     args = ap.parse_args(argv)
     if args.bundle is not None:
         print(render_bundle(args.bundle, args.name))
@@ -534,6 +619,9 @@ def main(argv=None) -> int:
         return 0
     if args.critical_path is not None:
         print(render_critical_path(fetch_snapshot(args.critical_path)))
+        return 0
+    if args.contention is not None:
+        print(render_contention(fetch_snapshot(args.contention)))
         return 0
     if args.path is None:
         ap.error("path is required unless --bundle is given")

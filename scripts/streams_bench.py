@@ -49,12 +49,26 @@ Contract: the LAST stdout line is one JSON object
 a stderr flush (the bench.py/serving_bench hardening, so 2>&1-merged
 wrappers always parse the last line).
 
+Every result header stamps ``cpu_count`` and ``jax_platforms`` (the
+round's machine identity — cross-machine gating must read them), the
+1-core ``error`` caveat auto-emits whenever ``cores < max(N_CONSUMERS)``,
+and each scaling rung runs with the contention plane armed
+(``obs.enable_contention``): ``serial_fraction_n<K>`` (the Karp–Flatt
+Amdahl estimate over the rung's window, N>1 rungs) and
+``lock_wait_s_total_n<K>`` extras say WHERE a flat curve's headroom
+went (ISSUE 14 — the ``--family ingest`` gate watches them as
+lower-is-better via direction rules). The sustained pass serves
+``/contentionz`` over a real socket and dumps the body to
+``STREAMS_CONTENTION_OUT`` (the CI smoke's structural-assert artifact).
+
 Env knobs: STREAMS_USERS, STREAMS_ITEMS, STREAMS_RANK, STREAMS_BATCHES,
 STREAMS_BATCH (records per micro-batch), STREAMS_CHECKPOINT_EVERY,
 STREAMS_FSYNC (=1 to fsync appends), STREAMS_FORCE_CPU (=0 for the
 default jax backend). Parallel mode adds: STREAMS_CONSUMERS (the N
 curve; presence selects the mode), STREAMS_FRESHNESS_S (sustained-pass
-duration, 0 skips), STREAMS_RECOVERY (=0 skips the kill/restart pass).
+duration, 0 skips), STREAMS_RECOVERY (=0 skips the kill/restart pass),
+STREAMS_CONTENTION_OUT (path for the sustained pass's /contentionz
+dump).
 """
 
 from __future__ import annotations
@@ -111,7 +125,10 @@ def run(num_users=20_000, num_items=5_000, rank=32, n_batches=10,
             init_capacity=1 << 15))
 
     extra = {
-        "device": str(jax.devices()[0]), "num_users": num_users,
+        "device": str(jax.devices()[0]), "cpu_count": os.cpu_count() or 1,
+        "jax_platforms": os.environ.get("JAX_PLATFORMS",
+                                        jax.default_backend()),
+        "num_users": num_users,
         "num_items": num_items, "rank": rank, "n_batches": n_batches,
         "batch_records": batch_records,
         "checkpoint_every": checkpoint_every, "fsync": fsync,
@@ -244,17 +261,29 @@ def run_parallel(curve=(1, 2, 4, 8), total_users=32_000,
                  freshness_s=2.0, recovery=True, seed=0) -> dict:
     import jax
 
+    from large_scale_recommendation_tpu import obs
+
     minibatch = min(8192, batch_records)
     curve = sorted(set(int(n) for n in curve))
     cores = os.cpu_count() or 1
     extra = {
         "device": str(jax.devices()[0]), "cpu_count": cores,
+        "jax_platforms": os.environ.get("JAX_PLATFORMS",
+                                        jax.default_backend()),
         "curve": list(curve), "total_users": total_users,
         "total_items": total_items, "rank": rank,
         "n_batches_total": n_batches,
         "batch_records": batch_records,
         "checkpoint_every": checkpoint_every, "fsync": fsync,
     }
+
+    # the contention plane rides every rung (ISSUE 14): the locks bind
+    # at model/runner construction, the window resets per rung, and
+    # serial_fraction_n<K>/lock_wait_s_total_n<K> say where a flat
+    # curve's headroom went. Registry stays NULL here — the tracker
+    # keeps its own stats, so the rungs pay only the (µs-scale)
+    # wrapped-lock accounting, not the full obs stack.
+    tracker = obs.enable_contention(interval_s=0.2)
 
     rates: dict[int, float] = {}
     with tempfile.TemporaryDirectory() as tmp:
@@ -271,16 +300,23 @@ def run_parallel(curve=(1, 2, 4, 8), total_users=32_000,
                          1 + bpp, batch_records, seed=seed)
             runner.run(max_batches=1)
             total = n * bpp * batch_records
+            tracker.reset_window()
             t0 = time.perf_counter()
             applied = runner.run()
             jax.block_until_ready(model.users.array)
             wall = time.perf_counter() - t0
+            sat = obs.SaturationAnalyzer(tracker).snapshot()
             tele = runner.telemetry()
             assert applied == n * bpp, (applied, n, bpp)
             assert all(v == 0 for v in tele["lag_records"].values())
             rates[n] = total / wall
             extra[f"ingest_n{n}_ratings_per_s"] = round(rates[n], 1)
+            extra[f"lock_wait_s_total_n{n}"] = round(
+                sat["lock_wait_s_total"], 4)
             if n > 1:
+                if sat["serial_fraction"] is not None:
+                    extra[f"serial_fraction_n{n}"] = round(
+                        sat["serial_fraction"], 4)
                 if 1 in rates:
                     # efficiency is DEFINED against the true N=1 rate;
                     # a curve without N=1 has no honest baseline —
@@ -292,8 +328,13 @@ def run_parallel(curve=(1, 2, 4, 8), total_users=32_000,
                     extra[f"gate_waits_n{n}"] = tele["gate"]["waits"]
             extra[f"checkpoints_n{n}"] = tele["checkpoints_written"]
             log.close()
+            top = (sat["top_contended"][0] if sat["top_contended"]
+                   else None)
             print(f"[parallel] N={n}: {rates[n]:,.0f} ratings/s "
-                  f"({applied} batches)", file=sys.stderr)
+                  f"({applied} batches; lock wait "
+                  f"{sat['lock_wait_s_total']:.3f}s"
+                  + (f", top {top['lock']}" if top else "") + ")",
+                  file=sys.stderr)
 
         n_max = max(curve)
 
@@ -312,6 +353,9 @@ def run_parallel(curve=(1, 2, 4, 8), total_users=32_000,
                 batch_records, checkpoint_every, fsync, minibatch,
                 freshness_s, seed))
 
+    obs.disable()  # the rungs' tracker (the sustained pass tears its
+    # own stack down; with freshness_s=0 this is what stops the
+    # contention sampler)
     speedup = rates[n_max] / rates[min(curve)]
     result = {
         "metric": (f"parallel ingest ratings/s (N={n_max} per-partition "
@@ -415,19 +459,32 @@ def _sustained_pass(tmp, n, total_users, total_items, rank,
     lineage + critical path armed: periodic coalesced delta refreshes
     must keep the ingest→serve ``FreshnessCheck`` green, and
     ``/criticalpathz`` samples must resolve for every partition."""
+    import json as _json
+
     from large_scale_recommendation_tpu import obs
     from large_scale_recommendation_tpu.obs.health import OK
     from large_scale_recommendation_tpu.obs.lineage import FreshnessCheck
+    from large_scale_recommendation_tpu.obs.server import (
+        ObsServer,
+        http_get,
+    )
 
     per = max(1024, batch_records // 8)  # smaller sustained batches
     try:
         obs.enable()
         obs.enable_lineage()
         analyzer = obs.enable_disttrace()
+        # the contention plane re-arms ON TOP of the live registry (the
+        # rungs ran it against the null one) so /contentionz joins the
+        # per-partition streams_* gauges — locks bind at the runner
+        # construction below
+        tracker = obs.enable_contention(interval_s=0.2)
         log, model, runner = _make_parallel(
             tmp, "log_sustained", n, rank, per, checkpoint_every,
             fsync, minibatch)
         engine = runner.serving_engine(k=10, max_batch=256)
+        server = ObsServer().start()
+        tracker.reset_window()
         check = FreshnessCheck(obs.get_lineage(),
                                degraded_after_s=max(2.0, duration_s),
                                critical_after_s=4 * max(2.0, duration_s))
@@ -451,6 +508,18 @@ def _sustained_pass(tmp, n, total_users, total_items, rank,
             time.sleep(0.1)
             runner.refresh_serving()
             verdicts.append(check().status)
+        # /contentionz over the REAL socket while the N consumers are
+        # still following (live threads, live lock traffic) — the body
+        # the CI smoke structurally asserts on and the --contention
+        # renderer's artifact
+        code, body = http_get(server.url + "/contentionz")
+        contention_doc = _json.loads(body) if code == 200 else {
+            "note": f"fetch failed: {code}", "locks": [],
+            "partitions": {}}
+        out_path = os.environ.get("STREAMS_CONTENTION_OUT")
+        if out_path:
+            with open(out_path, "w") as f:
+                _json.dump(contention_doc, f, indent=2)
         stop.set()
         producer.join()
         runner.stop()
@@ -459,12 +528,16 @@ def _sustained_pass(tmp, n, total_users, total_items, rank,
         verdicts.append(check().status)
         parts = {s["partition"] for s in analyzer.samples()}
         tele = runner.telemetry()
+        server.stop()
         log.close()
         return {
             "freshness_slo_held": int(all(v == OK for v in verdicts)),
             "freshness_checks": len(verdicts),
             "critical_path_partitions": len(parts),
             "critical_path_samples": analyzer.samples_total,
+            "contention_partitions": len(contention_doc.get(
+                "partitions", {})),
+            "contention_locks": len(contention_doc.get("locks", [])),
             "sustained_records": tele["records_processed"],
             "sustained_refreshes_coalesced": tele["refreshes_coalesced"],
             "sustained_catalog_swaps": len(tele["catalog_versions"]),

@@ -56,6 +56,14 @@ if _OBS_OUT:
     # the suite builds stamp their ingest→servable stages
     # (critical_path_s{stage} gauges ride the same recorder)
     _OBS_DISTTRACE = _obs.enable_disttrace()
+    # concurrency plane for the whole session: every model/engine/
+    # driver lock the suite constructs binds its instrumented form,
+    # the thread sampler feeds contention_* gauges into the recorder,
+    # and sessionfinish freezes tier1_contention.json
+    # (max_threads raised: a whole tier-1 session churns through many
+    # short-lived driver/server threads; the table is still bounded)
+    _OBS_CONTENTION = _obs.enable_contention(interval_s=1.0,
+                                             max_threads=512)
     _OBS_MONITOR = _health.HealthMonitor()
 
     def _session_check():
@@ -84,6 +92,10 @@ def null_obs():
     shared by every obs test file: the restore invariant is non-trivial
     and must not drift between copies."""
     from large_scale_recommendation_tpu import obs
+    from large_scale_recommendation_tpu.obs.contention import (
+        get_contention,
+        set_contention,
+    )
     from large_scale_recommendation_tpu.obs.disttrace import (
         get_disttrace,
         set_disttrace,
@@ -117,8 +129,10 @@ def null_obs():
     prev_j, prev_rec = get_events(), get_recorder()
     prev_ins, prev_lin = get_introspector(), get_lineage()
     prev_dt = get_disttrace()
+    prev_ct = get_contention()
     was_running = prev_rec is not None and prev_rec.running
     ins_was_running = prev_ins is not None and prev_ins.running
+    ct_was_running = prev_ct is not None and prev_ct.running
     obs.disable()  # closes the introspector too: compile funnel unpatched
     yield get_registry()
     set_registry(prev_r)
@@ -127,6 +141,9 @@ def null_obs():
     set_recorder(prev_rec)
     set_lineage(prev_lin)
     set_disttrace(prev_dt)
+    set_contention(prev_ct)
+    if ct_was_running:  # an OBS_OUT session runs one suite-wide
+        prev_ct.start()
     set_introspector(prev_ins)
     if prev_ins is not None:  # an OBS_OUT session runs one suite-wide
         prev_ins.install()
@@ -179,6 +196,22 @@ def pytest_sessionfinish(session, exitstatus):
             json.dump(_quality_doc, f, indent=2)
     except Exception as e:
         with open(os.path.join(_OBS_OUT, "tier1_quality_error.txt"),
+                  "w") as f:
+            f.write(repr(e))
+    # the concurrency plane's artifact (ISSUE 14): the suite-long
+    # saturation window — lock table + thread utilization — next to
+    # the roofline/quality artifacts
+    try:
+        from large_scale_recommendation_tpu.obs.contention import (
+            SaturationAnalyzer,
+        )
+
+        with open(os.path.join(_OBS_OUT, "tier1_contention.json"),
+                  "w") as f:
+            json.dump(SaturationAnalyzer(_OBS_CONTENTION).snapshot(), f,
+                      indent=2, default=repr)
+    except Exception as e:
+        with open(os.path.join(_OBS_OUT, "tier1_contention_error.txt"),
                   "w") as f:
             f.write(repr(e))
     # scrape the session's endpoint server for real: the artifacts below

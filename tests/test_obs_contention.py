@@ -1,0 +1,596 @@
+"""Concurrency & saturation observability (obs/contention.py, ISSUE 14).
+
+Covers the instrumented-primitive family (wait/hold split pinned across
+real threads, RLock reentrancy never double-counts, condition waits
+price as blocked time with the hold clock paused), the named-thread
+sampler's cross-thread CPU deltas, the Amdahl/Karp–Flatt math
+hand-pinned as pure functions, the ``/contentionz`` route over a real
+socket on a real ``ParallelIngestRunner`` at N=2 (the acceptance
+reconciliation: capacity/busy/blocked/serial-fraction identities), the
+postmortem-bundle freeze, the fleet aggregation, and the
+default-off-is-raw-primitives zero-cost pin.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from large_scale_recommendation_tpu import obs
+from large_scale_recommendation_tpu.obs.contention import (
+    CONSUMER_THREAD_PATTERN,
+    ContentionTracker,
+    InstrumentedCondition,
+    InstrumentedLock,
+    InstrumentedRLock,
+    SaturationAnalyzer,
+    amdahl_speedup,
+    decompose_window,
+    get_contention,
+    karp_flatt_serial_fraction,
+    named_condition,
+    named_lock,
+    named_rlock,
+    set_contention,
+)
+from large_scale_recommendation_tpu.obs.server import ObsServer, http_get
+
+
+@pytest.fixture
+def tracker(null_obs):
+    """A standalone tracker (null registry — stats are tracker-local),
+    installed as the module default for the duration of the test."""
+    t = ContentionTracker()
+    set_contention(t)
+    yield t
+    t.stop()
+    set_contention(None)
+
+
+# --------------------------------------------------------------------------
+# Instrumented primitives
+# --------------------------------------------------------------------------
+
+
+class TestInstrumentedLocks:
+    def test_wait_hold_split_across_real_threads(self, tracker):
+        """The core accounting pin: thread A holds for ~150 ms, the
+        main thread blocks on the same lock — A's HOLD and main's WAIT
+        both land, on the right sides of the split."""
+        lk = tracker.lock("t.lock")
+        held = threading.Event()
+
+        def holder():
+            with lk:
+                held.set()
+                time.sleep(0.15)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        held.wait(5)
+        with lk:
+            pass
+        t.join()
+        s = lk.stats.snapshot()
+        assert s["acquisitions"] == 2
+        assert s["contended"] == 1          # only the blocked acquire
+        assert s["wait_s"] >= 0.10          # main blocked ~150 ms
+        assert s["hold_s"] >= 0.14          # A's hold dominates
+        assert s["waiters"] == 0            # all drained
+
+    def test_uncontended_fast_path_records_no_wait(self, tracker):
+        lk = tracker.lock("t.free")
+        for _ in range(5):
+            with lk:
+                pass
+        s = lk.stats.snapshot()
+        assert s["acquisitions"] == 5
+        assert s["contended"] == 0
+        assert s["wait_s"] == 0.0
+        assert s["hold_s"] > 0.0
+
+    def test_waiters_gauge_tracks_blocked_threads(self, tracker):
+        lk = tracker.lock("t.waiters")
+        lk.acquire()
+        entered = threading.Event()
+
+        def waiter():
+            entered.set()
+            with lk:
+                pass
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        entered.wait(5)
+        deadline = time.time() + 5
+        while lk.stats.snapshot()["waiters"] != 1:
+            assert time.time() < deadline, "waiter never observed"
+            time.sleep(0.005)
+        lk.release()
+        t.join()
+        assert lk.stats.snapshot()["waiters"] == 0
+
+    def test_rlock_reentrancy_does_not_double_count(self, tracker):
+        """Nested acquires by the owner are one acquisition and ONE
+        hold — the reentrant bumps land in their own counter."""
+        rl = tracker.rlock("t.re")
+        t0 = time.perf_counter()
+        with rl:
+            with rl:
+                with rl:
+                    time.sleep(0.05)
+        span = time.perf_counter() - t0
+        s = rl.stats.snapshot()
+        assert s["acquisitions"] == 1
+        assert s["reentrant"] == 2
+        assert s["contended"] == 0
+        # exactly one hold segment, covering the OUTER span
+        assert 0.04 <= s["hold_s"] <= span + 0.01
+
+    def test_rlock_still_excludes_other_threads(self, tracker):
+        rl = tracker.rlock("t.re2")
+        rl.acquire()
+        got = []
+
+        def other():
+            got.append(rl.acquire(blocking=False))
+
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+        assert got == [False]
+        rl.release()
+
+    def test_condition_wait_prices_blocked_not_held(self, tracker):
+        """``wait()`` releases the lock — the blocked stretch lands in
+        wait_s (as a cv_wait), and the hold clock PAUSES: the hold
+        total must not absorb the 150 ms spent waiting."""
+        cv = tracker.condition("t.cv")
+        waiting = threading.Event()
+        woke = []
+
+        def consumer():
+            with cv:
+                waiting.set()
+                woke.append(cv.wait(5))
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        waiting.wait(5)
+        time.sleep(0.15)
+        with cv:
+            cv.notify_all()
+        t.join()
+        s = cv.stats.snapshot()
+        assert woke == [True]
+        assert s["cv_waits"] == 1
+        assert s["wait_s"] >= 0.10
+        assert s["hold_s"] < 0.10  # the wait never counted as a hold
+
+    def test_condition_wait_timeout_returns_false(self, tracker):
+        cv = tracker.condition("t.cv_to")
+        with cv:
+            assert cv.wait(0.01) is False
+        assert cv.stats.snapshot()["cv_waits"] == 1
+
+    def test_lock_table_bounded_overflow_gets_raw(self, null_obs):
+        t = ContentionTracker(max_locks=2)
+        a = t.lock("a")
+        b = t.condition("b")
+        c = t.lock("c")  # table full: raw primitive, counted
+        assert isinstance(a, InstrumentedLock)
+        assert isinstance(b, InstrumentedCondition)
+        assert type(c).__module__ == "_thread"
+        assert t.locks_dropped == 1
+        assert t.lock_names() == ["a", "b"]
+
+    def test_same_name_shares_stats_distinct_primitives(self, tracker):
+        """Two queues named the same guard DIFFERENT state but price
+        into ONE stats row — the analyzer sees the lock class."""
+        a = named_lock("t.shared")
+        b = named_lock("t.shared")
+        assert a is not b
+        assert a.stats is b.stats
+        with a:
+            pass
+        with b:
+            pass
+        assert a.stats.snapshot()["acquisitions"] == 2
+
+
+# --------------------------------------------------------------------------
+# Thread sampler
+# --------------------------------------------------------------------------
+
+
+class TestThreadSampler:
+    def test_named_thread_cpu_deltas(self, tracker):
+        """A spinning thread accrues CPU in the window; a sleeping one
+        doesn't — the cross-thread clock read is real."""
+        if not tracker.cpu_supported:
+            pytest.skip("no pthread_getcpuclockid on this platform")
+        stop = threading.Event()
+
+        def burn():
+            x = 0
+            while not stop.is_set():
+                x += 1
+
+        b = threading.Thread(target=burn, name="t-burner")
+        s = threading.Thread(target=lambda: stop.wait(5), name="t-sleeper")
+        b.start()
+        s.start()
+        tracker.reset_window()
+        time.sleep(0.3)
+        tracker.sample_threads()
+        stop.set()
+        b.join()
+        s.join()
+        rows = {r["thread"]: r for r in tracker.thread_window()}
+        assert rows["t-burner"]["cpu_s"] > 0.05
+        assert rows["t-sleeper"]["cpu_s"] < 0.05
+
+    def test_short_lived_registered_thread_prices_cpu(self, tracker):
+        """A worker that checks in/out via the named-thread registry
+        prices its busy time even if no sampler tick ever saw it alive
+        — the scaling-rung case the explicit registry exists for."""
+        def worker():
+            tracker.note_thread_start()
+            t0 = time.perf_counter()
+            x = 0
+            while time.perf_counter() - t0 < 0.1:
+                x += 1
+            tracker.note_thread_end()
+
+        tracker.reset_window()
+        t = threading.Thread(target=worker, name="ingest-p7")
+        t.start()
+        t.join()
+        tracker.sample_threads()  # archives the dead thread
+        rows = {r["thread"]: r for r in tracker.thread_window()}
+        assert "ingest-p7" in rows
+        assert rows["ingest-p7"]["alive"] is False
+        assert rows["ingest-p7"]["cpu_s"] > 0.03
+        busy = tracker.consumer_busy()
+        assert 7 in busy and busy[7]["busy_s"] > 0.03
+
+    def test_sampler_publishes_contention_gauges(self, null_obs):
+        reg, _ = obs.enable()
+        try:
+            t = obs.enable_contention(start=False)
+            t.sample_threads()
+            time.sleep(0.02)
+            t.sample_threads()  # per-thread fracs need a tick DELTA
+            names = reg.names()
+            assert "contention_lock_wait_s_total" in names
+            assert "contention_threads_tracked" in names
+            assert "thread_cpu_frac" in names or not t.cpu_supported
+        finally:
+            obs.disable()
+
+
+# --------------------------------------------------------------------------
+# Amdahl / Karp–Flatt math — hand-pinned
+# --------------------------------------------------------------------------
+
+
+class TestAmdahlMath:
+    def test_karp_flatt_hand_pins(self):
+        # perfect efficiency ⇒ nothing serial
+        assert karp_flatt_serial_fraction(1.0, 4) == 0.0
+        # E = 0.5 at N = 2 inverts to fully serial
+        assert karp_flatt_serial_fraction(0.5, 2) == 1.0
+        # the textbook case: E = 0.8 at N = 4 ⇒ (1/0.8 − 1)/3
+        assert karp_flatt_serial_fraction(0.8, 4) == pytest.approx(
+            (1 / 0.8 - 1) / 3)
+        # undefined: one worker, or no measurement
+        assert karp_flatt_serial_fraction(0.9, 1) is None
+        assert karp_flatt_serial_fraction(None, 4) is None
+        assert karp_flatt_serial_fraction(0.0, 4) is None
+        # sampling jitter past E=1 clamps, never goes negative
+        assert karp_flatt_serial_fraction(1.2, 4) == 0.0
+
+    def test_amdahl_speedup_hand_pins(self):
+        assert amdahl_speedup(0.0, 8) == pytest.approx(8.0)
+        assert amdahl_speedup(1.0, 8) == pytest.approx(1.0)
+        assert amdahl_speedup(0.1, 8) == pytest.approx(
+            1 / (0.1 + 0.9 / 8))
+
+    def test_decompose_window_hand_pinned(self):
+        """wall 10 s, two consumers busy 8 s and 6 s ⇒ capacity 20,
+        busy 14, E = 0.7, s = (1/0.7 − 1)/1 ≈ 0.4286, and the Amdahl
+        projections follow."""
+        d = decompose_window(10.0, {0: 8.0, 1: 6.0}, 1.5)
+        assert d["consumers"] == 2
+        assert d["capacity_s"] == pytest.approx(20.0)
+        assert d["busy_s"] == pytest.approx(14.0)
+        assert d["blocked_s"] == pytest.approx(6.0)
+        assert d["efficiency"] == pytest.approx(0.7)
+        s = (1 / 0.7 - 1) / 1
+        assert d["serial_fraction"] == pytest.approx(s)
+        assert d["speedup_at_n"] == pytest.approx(amdahl_speedup(s, 2))
+        assert d["projected_speedup_at_2n"] == pytest.approx(
+            amdahl_speedup(s, 4))
+        assert d["amdahl_limit"] == pytest.approx(1 / s)
+        assert d["cpu_source"] == "pthread_getcpuclockid"
+
+    def test_decompose_window_lock_wait_fallback(self):
+        """No per-thread CPU ⇒ busy is estimated as capacity minus the
+        lock-wait total, labeled so readers know the provenance."""
+        d = decompose_window(10.0, {0: 0.0, 1: 0.0}, 4.0,
+                             cpu_supported=False)
+        assert d["busy_s"] == pytest.approx(16.0)
+        assert d["efficiency"] == pytest.approx(0.8)
+        assert d["cpu_source"] == "lock_wait_fallback"
+
+    def test_decompose_window_single_consumer(self):
+        d = decompose_window(5.0, {0: 4.0}, 0.0)
+        assert d["serial_fraction"] is None  # N=1 prices no parallelism
+        assert d["efficiency"] == pytest.approx(0.8)
+
+
+# --------------------------------------------------------------------------
+# /contentionz end to end (the acceptance pin)
+# --------------------------------------------------------------------------
+
+
+def _fill_routed(log, n_batches=6, records=4000, users=2000, items=500,
+                 seed=0):
+    from large_scale_recommendation_tpu.streams.parallel import (
+        append_routed,
+    )
+
+    rng = np.random.default_rng(seed)
+    for _ in range(n_batches):
+        append_routed(log, rng.integers(0, users, records),
+                      rng.integers(0, items, records),
+                      rng.random(records).astype(np.float32))
+
+
+class TestContentionzEndToEnd:
+    def test_real_runner_n2_over_socket_reconciles(self, null_obs,
+                                                   tmp_path):
+        """The ISSUE-14 acceptance pin: a real ``ParallelIngestRunner``
+        at N=2 with the plane armed serves an Amdahl decomposition at
+        ``/contentionz`` whose numbers reconcile against wall time —
+        capacity = N·wall, busy + blocked = capacity, the lock-wait
+        total fits inside capacity, and serial_fraction is exactly the
+        Karp–Flatt inversion of the reported efficiency."""
+        from large_scale_recommendation_tpu.models.online import (
+            OnlineMF,
+            OnlineMFConfig,
+        )
+        from large_scale_recommendation_tpu.streams import (
+            EventLog,
+            ParallelIngestRunner,
+            StreamingDriverConfig,
+        )
+
+        obs.enable()
+        try:
+            tracker = obs.enable_contention(interval_s=0.1)
+            log = EventLog(str(tmp_path / "log"), num_partitions=2)
+            _fill_routed(log)
+            model = OnlineMF(OnlineMFConfig(
+                num_factors=8, minibatch_size=2048,
+                init_capacity=1 << 12))
+            runner = ParallelIngestRunner(
+                model, log, str(tmp_path / "ckpt"),
+                config=StreamingDriverConfig(batch_records=4000,
+                                             checkpoint_every=2))
+            with ObsServer() as server:
+                tracker.reset_window()
+                t0 = time.perf_counter()
+                applied = runner.run()
+                run_wall = time.perf_counter() - t0
+                code, body = http_get(server.url + "/contentionz")
+            assert code == 200
+            doc = json.loads(body)
+            assert applied > 0
+            # all N partitions present, each with a busy/blocked split
+            assert set(doc["partitions"]) == {"0", "1"}
+            for row in doc["partitions"].values():
+                assert row["busy_s"] >= 0.0
+                assert 0.0 <= row["blocked_frac"] <= 1.0
+                # the streams_* join rode along
+                assert row["records_total"] > 0
+            assert doc["consumers"] == 2
+            assert 0.0 <= doc["serial_fraction"] <= 1.0
+            # locks were exercised: the apply lock and barrier at least
+            assert doc["top_contended"]
+            names = {r["lock"] for r in doc["locks"]}
+            assert "online.apply_lock" in names
+            assert "streams.barrier" in names
+            # --- the reconciliation identities (hand-recomputed) -----
+            wall = doc["window"]["wall_s"]
+            assert wall >= run_wall - 0.01  # window covers the run
+            assert doc["capacity_s"] == pytest.approx(2 * wall)
+            assert doc["busy_s"] + doc["blocked_s"] == pytest.approx(
+                doc["capacity_s"])
+            assert doc["lock_wait_s_total"] <= doc["capacity_s"] + 0.1
+            assert doc["serial_fraction"] == pytest.approx(
+                karp_flatt_serial_fraction(doc["efficiency"], 2))
+            # per-partition busy sums to the aggregate (when supported)
+            if doc["cpu_source"] == "pthread_getcpuclockid":
+                assert sum(r["busy_s"]
+                           for r in doc["partitions"].values()) == \
+                    pytest.approx(doc["busy_s"], abs=1e-6)
+            # the recorder-facing gauges exist on the live registry
+            names = obs.get_registry().names()
+            assert "contention_lock_wait_s_total" in names
+            assert "lock_acquisitions_total" in names
+            # the satellite exports: gate/runner telemetry now lives on
+            # the registry, not just the runner-local telemetry dict
+            assert "streams_gate_grants_total" in names
+            assert "streams_gate_waits_total" in names
+            assert "streams_barriers_held_total" in names
+            assert "streams_refreshes_coalesced_total" in names
+            # the gate counter agrees with the runner-local telemetry
+            grants = [i for i in obs.get_registry().find(
+                "streams_gate_grants_total")]
+            assert grants and grants[0].value == runner.gate.grants
+        finally:
+            obs.disable()
+
+    def test_route_without_tracker_answers_note(self, null_obs):
+        with ObsServer() as server:
+            code, body = http_get(server.url + "/contentionz")
+        assert code == 200
+        doc = json.loads(body)
+        assert "note" in doc and doc["locks"] == []
+
+    def test_index_lists_contentionz(self, null_obs):
+        with ObsServer() as server:
+            code, body = http_get(server.url + "/")
+        assert "/contentionz" in json.loads(body)["routes"]
+
+    def test_bundle_carries_contention_snapshot(self, null_obs,
+                                                tmp_path):
+        """The postmortem freeze: with the plane armed, write_bundle
+        ships contention.json and load_bundle validates it; the loader
+        synthesizes a note doc for pre-ISSUE-14 (version-3) bundles."""
+        from large_scale_recommendation_tpu.obs.recorder import (
+            BUNDLE_VERSION,
+            load_bundle,
+            write_bundle,
+        )
+
+        obs.enable()
+        try:
+            tracker = obs.enable_contention(start=False)
+            lk = tracker.lock("t.bundle")
+            with lk:
+                pass
+            path = write_bundle(str(tmp_path / "b"), trigger="manual")
+            docs = load_bundle(path)
+            assert BUNDLE_VERSION == 4
+            assert docs["manifest"]["bundle_version"] == 4
+            locks = {r["lock"] for r in docs["contention"]["locks"]}
+            assert "t.bundle" in locks
+            # an archived version-3 bundle (pre-concurrency-plane)
+            # stays loadable with the note synthesized
+            import os
+
+            manifest_path = str(tmp_path / "b" / "manifest.json")
+            with open(manifest_path) as f:
+                manifest = json.load(f)
+            manifest["bundle_version"] = 3
+            manifest["files"] = [x for x in manifest["files"]
+                                 if x != "contention.json"]
+            with open(manifest_path, "w") as f:
+                json.dump(manifest, f)
+            os.unlink(str(tmp_path / "b" / "contention.json"))
+            docs3 = load_bundle(path)
+            assert docs3["contention"]["locks"] == []
+            assert "version-3" in docs3["contention"]["note"]
+        finally:
+            obs.disable()
+
+    def test_fleet_contentionz_aggregates(self, null_obs):
+        """The pod view: the fleet route scrapes each member's
+        ``/contentionz`` and merges the lock table by name."""
+        from large_scale_recommendation_tpu.obs.fleet import (
+            FleetAggregator,
+            FleetServer,
+        )
+
+        obs.enable()
+        try:
+            tracker = obs.enable_contention(start=False)
+            lk = tracker.lock("t.fleet")
+            with lk:
+                pass
+            with ObsServer() as member:
+                agg = FleetAggregator([member.url])
+                with FleetServer(agg) as fleet:
+                    code, body = http_get(fleet.url + "/contentionz")
+            assert code == 200
+            doc = json.loads(body)
+            assert len(doc["targets"]) == 1
+            assert any(r["lock"] == "t.fleet" for r in doc["locks"])
+            assert doc["unreachable"] == []
+        finally:
+            obs.disable()
+
+    def test_report_renderer_accepts_snapshot(self, tracker):
+        import sys
+
+        sys.path.insert(0, ".")
+        from scripts.obs_report import render_contention
+
+        lk = tracker.lock("t.render")
+        with lk:
+            pass
+        doc = SaturationAnalyzer(tracker).snapshot()
+        text = render_contention(doc)
+        assert "t.render" in text
+        assert "serial fraction" in text
+
+
+# --------------------------------------------------------------------------
+# Zero-cost default-off pin
+# --------------------------------------------------------------------------
+
+
+class TestNullPathZeroWork:
+    def test_contention_default_off_everywhere(self, null_obs, tmp_path):
+        """The ISSUE-14 extension of the zero-cost pin: with nothing
+        enabled, get_contention() is None and every named-lock site
+        binds a RAW ``threading`` primitive — no wrapper object, no
+        stats row, zero clock reads on any acquire/release — and no
+        lock_*/thread_*/contention_* names appear anywhere."""
+        from large_scale_recommendation_tpu.models.adaptive import (
+            AdaptiveMF,
+            AdaptiveMFConfig,
+        )
+        from large_scale_recommendation_tpu.models.mf import MFModel
+        from large_scale_recommendation_tpu.models.online import (
+            OnlineMF,
+            OnlineMFConfig,
+        )
+        from large_scale_recommendation_tpu.serving.engine import (
+            ServingEngine,
+        )
+        from large_scale_recommendation_tpu.streams.log import EventLog
+        from large_scale_recommendation_tpu.streams.parallel import (
+            RowConflictGate,
+        )
+        from large_scale_recommendation_tpu.streams.sources import (
+            IngestQueue,
+        )
+
+        assert get_contention() is None
+        # raw helpers hand back bare _thread primitives
+        assert type(named_lock("x")).__module__ == "_thread"
+        assert type(named_rlock("x")).__module__ == "_thread"
+        assert type(named_condition("x")).__name__ == "Condition"
+        assert not isinstance(named_condition("x"),
+                              InstrumentedCondition)
+        # every named hot lock binds raw at construction
+        model = OnlineMF(OnlineMFConfig(num_factors=4))
+        assert type(model.apply_lock).__module__ == "_thread"
+        adaptive = AdaptiveMF(AdaptiveMFConfig(num_factors=4))
+        assert type(adaptive.apply_lock).__module__ == "_thread"
+        assert not isinstance(model.apply_lock, InstrumentedRLock)
+        import jax.numpy as jnp
+
+        from large_scale_recommendation_tpu.data.blocking import (
+            flat_index,
+        )
+
+        mf = MFModel(U=jnp.zeros((16, 4)), V=jnp.zeros((16, 4)),
+                     users=flat_index(np.arange(16, dtype=np.int64)),
+                     items=flat_index(np.arange(16, dtype=np.int64)))
+        engine = ServingEngine(mf, k=2, max_batch=32, min_bucket=8)
+        assert type(engine._lock).__module__ == "_thread"
+        gate = RowConflictGate()
+        assert type(gate._cv).__name__ == "Condition"
+        assert not isinstance(gate._cv, InstrumentedCondition)
+        queue = IngestQueue(capacity=2)
+        assert type(queue._cv).__name__ == "Condition"
+        log = EventLog(str(tmp_path / "log"))
+        assert type(log._parts[0]._lock).__module__ == "_thread"
+        # nothing registered anywhere
+        assert null_obs.names() == set()

@@ -669,3 +669,46 @@ class TestIngestFamily:
             assert not any(pat in key for pat in DEFAULT_HIGHER), key
         for key in ("scaling_eff_n4", "ingest_n4_ratings_per_s"):
             assert not any(pat in key for pat in DEFAULT_LOWER), key
+
+    def test_contention_direction_rules(self):
+        """The ISSUE-14 concurrency keys: a rising Amdahl serial
+        fraction or per-rung lock-wait total is a serialization
+        regression — LOWER is better, at every N suffix the bench
+        emits."""
+        from scripts.bench_regress import is_lower_better
+
+        for key in ("serial_fraction_n2", "serial_fraction_n8",
+                    "lock_wait_s_total_n2", "lock_wait_s_total_n4"):
+            assert is_lower_better(key, set()), key
+
+    def test_contention_no_direction_collision(self):
+        """serial_fraction/lock_wait must not match any
+        higher-is-better pattern (which would win and flip the
+        direction), and no existing higher-is-better ingest key may
+        match the new lower patterns."""
+        from scripts.bench_regress import DEFAULT_HIGHER, DEFAULT_LOWER
+
+        for key in ("serial_fraction_n4", "lock_wait_s_total_n4"):
+            assert not any(pat in key for pat in DEFAULT_HIGHER), key
+        for key in ("ingest_n4_ratings_per_s", "scaling_eff_n4",
+                    "qps_at_slo", "effective_hbm_gbs"):
+            assert not any(pat in key
+                           for pat in ("serial_fraction", "lock_wait")), key
+        assert "serial_fraction" in DEFAULT_LOWER
+        assert "lock_wait" in DEFAULT_LOWER
+
+    def test_serial_fraction_rise_trips_via_key(self, tmp_path):
+        """The watch-via---key contract the CI step uses on rounds that
+        carry the contention extras (the committed pre-ISSUE-14 round
+        doesn't, so the keys stay out of the family default set)."""
+        b = self._round(tmp_path, "INGEST_r01.json",
+                        serial_fraction_n4=0.10)
+        c = self._round(tmp_path, "INGEST_r02.json",
+                        serial_fraction_n4=0.40)
+        assert regress_main(["--family", "ingest",
+                             "--baseline", b, "--current", c,
+                             "--key", "serial_fraction_n4=50"]) == 1
+        # an IMPROVED (dropping) serial fraction never trips
+        assert regress_main(["--family", "ingest",
+                             "--baseline", c, "--current", b,
+                             "--key", "serial_fraction_n4=50"]) == 0
