@@ -340,6 +340,7 @@ class OnlineMF:
         if self._obs_on:
             # block so the histogram reads device time, not dispatch
             # (enabled-only: the uninstrumented path stays async)
+            # graftlint: disable=host-sync  (deliberate, _obs_on-gated)
             U.block_until_ready()
             self._m_batch_s.observe(time.perf_counter() - t0)
             self._m_batches.inc()
@@ -370,6 +371,8 @@ class OnlineMF:
             n = len(rows)
             idx = np.zeros(pow2_pad(n), np.int64)
             idx[:n] = rows
+            # graftlint: disable=host-sync  (deliberate: emit_updates
+            # callers asked for host vectors — one bulk pull per side)
             return np.asarray(table[jnp.asarray(idx)])[:n]
 
         u_vecs = gather(U, u_rows[first_u])
@@ -495,6 +498,7 @@ class OnlineMF:
                 self.consumed_offsets[int(offset[0])] = int(offset[1])
             committed = self.users.array
         if self._obs_on:
+            # graftlint: disable=host-sync  (deliberate, _obs_on-gated)
             committed.block_until_ready()  # outside the lock: blocking
             # under apply_lock would serialize the overlap this mode
             # exists to provide

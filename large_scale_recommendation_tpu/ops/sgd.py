@@ -344,7 +344,13 @@ def pad_minibatches(
     aligned numpy buffers on the CPU backend, so refilling a reused
     buffer races an in-flight async kernel's read of it (measured as
     factor divergence under concurrent consumers, ISSUE 13 — the
-    streaming ``partial_fit`` paths therefore allocate fresh).
+    streaming ``partial_fit`` paths therefore allocate fresh). This
+    hazard is mechanically enforced: graftlint rule ``buffer-aliasing``
+    (tools/graftlint, docs/STATIC_ANALYSIS.md) flags any caller that
+    passes ``buffers=`` and feeds the results to ``jnp.asarray``/
+    ``jnp.frombuffer`` — as of ISSUE 15 no production caller does
+    (``ps/mf.py``, ``ps/adaptive.py``, and both ``models/online.py``
+    paths all allocate fresh staging per batch).
     Returns ``(ur, ir, vals, w)`` int32/int32/float32/float32 of the padded
     length.
     """

@@ -125,9 +125,12 @@ def make_global_array(host_data: np.ndarray, mesh, spec):
     ``Partitioner.place`` are the rules-table spellings new code uses.
     """
     import jax
-    from jax.sharding import NamedSharding
 
-    sharding = NamedSharding(mesh, spec)
+    from large_scale_recommendation_tpu.parallel.partitioner import (
+        raw_sharding,
+    )
+
+    sharding = raw_sharding(mesh, spec)
     return jax.make_array_from_callback(
         host_data.shape, sharding, lambda idx: host_data[idx]
     )

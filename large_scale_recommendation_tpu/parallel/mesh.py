@@ -10,8 +10,6 @@ instead of engine shuffles (SURVEY §2.3).
 
 from __future__ import annotations
 
-import numpy as np
-
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
@@ -81,10 +79,16 @@ def make_block_mesh(num_devices: int | None = None,
     ``parallel.partitioner.Partitioner`` (which builds the 2D
     ``('data', 'model')`` mesh); meshes built here are still accepted
     everywhere — the partitioner adopts the 1D ring's only axis as its
-    data role, producing identical shardings.
+    data role, producing identical shardings. Construction itself lives
+    in the partitioner module (the sharding-funnel invariant: one
+    audited surface builds every mesh/sharding), this is the
+    compatibility name.
     """
-    return Mesh(np.array(select_devices(num_devices, devices)),
-                (BLOCK_AXIS,))
+    from large_scale_recommendation_tpu.parallel.partitioner import (
+        make_legacy_block_mesh,
+    )
+
+    return make_legacy_block_mesh(num_devices, devices)
 
 
 def block_sharding(mesh: Mesh) -> NamedSharding:
@@ -101,7 +105,17 @@ def block_sharding(mesh: Mesh) -> NamedSharding:
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, PartitionSpec())
+    """Fully-replicated sharding on ANY mesh — routed through the
+    funnel's raw constructor (the produced sharding is identical to the
+    pre-funnel spelling: same mesh, empty spec). Deliberately NOT
+    ``as_partitioner(mesh).replicated()``: the rules table must infer a
+    data axis, which arbitrary external meshes may not carry, while an
+    empty ``PartitionSpec`` is valid on every mesh."""
+    from large_scale_recommendation_tpu.parallel.partitioner import (
+        raw_sharding,
+    )
+
+    return raw_sharding(mesh, PartitionSpec())
 
 
 def ring_backward(k: int) -> list[tuple[int, int]]:

@@ -56,11 +56,15 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from large_scale_recommendation_tpu.parallel.mesh import select_devices
+from large_scale_recommendation_tpu.parallel.mesh import (
+    BLOCK_AXIS,
+    select_devices,
+)
 
 __all__ = [
     "DATA_AXIS", "MODEL_AXIS", "DEFAULT_RULES", "Partitioner",
-    "as_partitioner", "make_data_model_mesh",
+    "as_partitioner", "make_data_model_mesh", "make_legacy_block_mesh",
+    "raw_sharding",
 ]
 
 # physical mesh axis roles (T5X's ('data', 'model') convention)
@@ -95,6 +99,29 @@ def make_data_model_mesh(num_devices: int | None = None, devices=None,
             f"model_parallel={model_parallel} does not divide {n} devices")
     grid = np.asarray(devices).reshape(n // model_parallel, model_parallel)
     return Mesh(grid, (DATA_AXIS, MODEL_AXIS))
+
+
+def make_legacy_block_mesh(num_devices: int | None = None,
+                           devices=None) -> Mesh:
+    """The legacy 1D ``('blocks',)`` ring, constructed HERE so every
+    mesh in the system comes off the one audited surface (graftlint
+    rule ``sharding-funnel``). ``parallel.mesh.make_block_mesh`` is the
+    public spelling and delegates to this; the partitioner adopts the
+    ring's only axis as its data role, so both mesh spellings resolve
+    identical shardings (pinned by tests/test_partitioner.py)."""
+    return Mesh(np.array(select_devices(num_devices, devices)),
+                (BLOCK_AXIS,))
+
+
+def raw_sharding(mesh: Mesh, spec: PartitionSpec) -> NamedSharding:
+    """The ONE audited constructor for legacy raw-``PartitionSpec``
+    callers (``parallel.distributed.make_global_array`` and external
+    code that predates the rules table). New code names LOGICAL axes
+    through ``Partitioner.sharding``/``spec`` instead — a raw spec is a
+    layout decision the rules table cannot see, which is exactly why
+    construction is funneled here where the escape hatch is greppable
+    (graftlint rule ``sharding-funnel``)."""
+    return NamedSharding(mesh, spec)
 
 
 class Partitioner:

@@ -279,6 +279,9 @@ def _mesh_topk_step(mesh: Mesh, k_local: int, k_out: int,
             return existing
         per_mesh[key] = jitted
         while len(per_mesh) > _STEP_CACHE_CAP:  # evict least-recent
+            # graftlint: disable=lock-gap  (not stale state: per_mesh
+            # is the cache CONTAINER, and the re-acquisition re-reads
+            # it first — a racing builder's entry wins, never reverted)
             per_mesh.pop(next(iter(per_mesh)))
     return jitted
 

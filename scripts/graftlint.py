@@ -1,0 +1,166 @@
+#!/usr/bin/env python
+"""graftlint runner: check the codebase's sharding/concurrency/
+zero-cost-observability invariants (tools/graftlint/) and emit a human
+table (stderr) plus ONE machine-readable JSON line as the LAST stdout
+line — the established ``_emit_final`` contract every bench harness in
+this repo follows (stderr flushed first, so a 2>&1-merged wrapper
+always parses the final line).
+
+Usage:
+
+    python scripts/graftlint.py                      # report, exit 0
+    python scripts/graftlint.py --strict             # CI gate
+    python scripts/graftlint.py --rules obs-gate,lock-gap path/ ...
+    python scripts/graftlint.py --disable host-sync
+    python scripts/graftlint.py --write-baseline     # grandfather now
+    python scripts/graftlint.py --json out.json      # full doc to file
+
+Exit codes: 0 clean (or non-strict report); 1 unsuppressed findings
+under --strict, reason-less baseline entries under --strict, or
+parse/usage errors.
+
+Pure-stdlib AST analysis — no jax import, safe to run anywhere,
+sub-second on the whole package.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.graftlint import ALL_CHECKERS, run_lint  # noqa: E402
+from tools.graftlint.core import DEFAULT_BASELINE, write_baseline  # noqa: E402
+
+
+def _emit_final(result: dict) -> None:
+    """stderr first, the JSON line last — pinned for merged streams."""
+    sys.stderr.flush()
+    print(json.dumps(result), flush=True)
+
+
+def render_table(res) -> str:
+    """Human-readable findings + summary table."""
+    lines = []
+    if res.findings:
+        w = max(len(f.rule) for f in res.findings)
+        for f in res.findings:
+            lines.append(f"{f.rule:<{w}}  {f.path}:{f.line}  "
+                         f"[{f.symbol}]")
+            lines.append(f"{'':<{w}}  {f.message}")
+            if f.line_text.strip():
+                lines.append(f"{'':<{w}}  > {f.line_text.strip()}")
+    lines.append("")
+    lines.append(f"{'rule':<18} {'findings':>8} {'baselined':>9} "
+                 f"{'suppressed':>10}")
+    per = res.per_rule()
+    base_per: dict[str, int] = {}
+    for f in res.baselined:
+        base_per[f.rule] = base_per.get(f.rule, 0) + 1
+    sup_per: dict[str, int] = {}
+    for f in res.suppressed:
+        sup_per[f.rule] = sup_per.get(f.rule, 0) + 1
+    for rule in res.rules_run:
+        lines.append(f"{rule:<18} {per.get(rule, 0):>8} "
+                     f"{base_per.get(rule, 0):>9} "
+                     f"{sup_per.get(rule, 0):>10}")
+    lines.append(f"{'TOTAL':<18} {len(res.findings):>8} "
+                 f"{len(res.baselined):>9} {len(res.suppressed):>10}"
+                 f"    ({res.files_scanned} files)")
+    for e in res.baseline_errors:
+        lines.append(f"baseline ERROR: {e}")
+    for e in res.baseline_stale:
+        lines.append(f"baseline stale (fixed? remove the entry): "
+                     f"{e.get('rule')}:{e.get('path')}:{e.get('symbol')}")
+    for e in res.parse_errors:
+        lines.append(f"parse ERROR: {e}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("paths", nargs="*",
+                   help="files/dirs to scan (default: the production "
+                        "package)")
+    p.add_argument("--rules", help="comma-separated rule subset "
+                   f"(have: {','.join(sorted(ALL_CHECKERS))})")
+    p.add_argument("--disable", default="",
+                   help="comma-separated rules to skip")
+    p.add_argument("--baseline", default=DEFAULT_BASELINE,
+                   help="baseline file (empty string disables)")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="grandfather current unsuppressed findings "
+                        "(each entry then needs a reason filled in)")
+    p.add_argument("--strict", action="store_true",
+                   help="exit 1 on any unsuppressed finding or "
+                        "reason-less baseline entry")
+    p.add_argument("--json", dest="json_out",
+                   help="write the full machine-readable doc here too")
+    args = p.parse_args(argv)
+
+    rules = args.rules.split(",") if args.rules else None
+    disable = [r for r in args.disable.split(",") if r]
+    try:
+        res = run_lint(paths=args.paths or None, rules=rules,
+                       disable=disable,
+                       baseline_path=args.baseline or None)
+    except ValueError as e:
+        print(f"graftlint: {e}", file=sys.stderr)
+        _emit_final({"metric": "graftlint unsuppressed findings",
+                     "value": -1, "unit": "findings", "vs_baseline": 0,
+                     "error": str(e), "extra": {}})
+        return 1
+
+    if args.write_baseline:
+        if not args.baseline:
+            # --baseline '' means "no baseline in play" — silently
+            # falling back to rewriting the committed default would
+            # touch the exact file the user opted out of
+            print("graftlint: --write-baseline needs a --baseline "
+                  "path (got an explicitly disabled baseline)",
+                  file=sys.stderr)
+            _emit_final({"metric": "graftlint unsuppressed findings",
+                         "value": -1, "unit": "findings",
+                         "vs_baseline": 0,
+                         "error": "--write-baseline with disabled "
+                                  "baseline", "extra": {}})
+            return 1
+        path = args.baseline
+        if not os.path.isabs(path):
+            path = os.path.join(REPO, path)
+        write_baseline(path, res.findings + res.baselined,
+                       rules_run=res.rules_run,
+                       scanned_paths=res.scanned_paths)
+        print(f"baseline written: {path} "
+              f"({len(res.findings) + len(res.baselined)} entries — "
+              f"fill in every reason)", file=sys.stderr)
+
+    print(render_table(res), file=sys.stderr)
+
+    doc = res.to_dict()
+    strict_ok = (not res.findings and not res.baseline_errors
+                 and not res.parse_errors)
+    final = {
+        "metric": "graftlint unsuppressed findings",
+        "value": len(res.findings),
+        "unit": "findings",
+        "vs_baseline": len(res.baselined),
+        "extra": doc | {"strict": bool(args.strict),
+                        "strict_ok": strict_ok},
+    }
+    if args.json_out:
+        with open(args.json_out, "w", encoding="utf-8") as fh:
+            json.dump(final, fh, indent=2)
+    _emit_final(final)
+    if res.parse_errors:
+        return 1  # a typo'd path / unparseable file is never a clean
+        # run, strict or not (the docstring's exit-code contract)
+    if args.strict and not strict_ok:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

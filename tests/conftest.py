@@ -161,6 +161,26 @@ def pytest_sessionfinish(session, exitstatus):
     from large_scale_recommendation_tpu.obs.server import http_get
 
     os.makedirs(_OBS_OUT, exist_ok=True)
+    # graftlint finding counts stamped into the SAME registry the
+    # metrics artifacts freeze below (ISSUE 15): the trajectory of
+    # suppressed/baselined static-analysis debt ships with every tier-1
+    # round — a rising lint_baselined_total is debt accruing even while
+    # the --strict CI gate stays green
+    try:
+        from tools.graftlint import run_lint as _graftlint
+
+        _lint = _graftlint()  # pure-AST, sub-second, no jax touched
+        _OBS_REG.gauge("lint_findings_total").set(len(_lint.findings))
+        for _rule, _n in _lint.per_rule().items():
+            _OBS_REG.gauge("lint_findings", rule=_rule).set(_n)
+        _OBS_REG.gauge("lint_baselined_total").set(len(_lint.baselined))
+        _OBS_REG.gauge("lint_suppressed_total").set(len(_lint.suppressed))
+        with open(os.path.join(_OBS_OUT, "tier1_lint.json"), "w") as f:
+            json.dump(_lint.to_dict(), f, indent=2)
+    except Exception as e:  # artifact-only: never fail the session
+        with open(os.path.join(_OBS_OUT, "tier1_lint_error.txt"),
+                  "w") as f:
+            f.write(repr(e))
     _OBS_REG.append_jsonl(os.path.join(_OBS_OUT, "tier1_metrics.jsonl"))
     _OBS_TRACER.to_chrome_trace(os.path.join(_OBS_OUT, "tier1_trace.json"))
     # the session's per-kernel roofline: every compile key the suite

@@ -1,0 +1,26 @@
+"""graftlint: AST-based invariant checkers for this codebase's
+sharding, concurrency, and zero-cost-observability contracts.
+
+Every rule here descends from a measured incident (see
+docs/STATIC_ANALYSIS.md for the catalog and the CHANGES.md PR each rule
+cites). The checkers are pure-stdlib ``ast`` analysis — no jax import,
+no package import — so the whole suite runs in well under a second and
+can gate CI before the test session even starts.
+
+Public surface:
+
+- ``run_lint(paths, ...)`` — parse + check + apply suppressions and the
+  committed baseline; returns a ``LintResult``.
+- ``ALL_CHECKERS`` — the rule registry (name -> Checker class).
+- ``Finding`` / ``LintResult`` — the result shapes.
+"""
+
+from tools.graftlint.core import (  # noqa: F401
+    Finding,
+    LintResult,
+    Project,
+    run_lint,
+)
+from tools.graftlint.checkers import ALL_CHECKERS  # noqa: F401
+
+__all__ = ["Finding", "LintResult", "Project", "run_lint", "ALL_CHECKERS"]

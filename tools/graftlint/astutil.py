@@ -1,0 +1,135 @@
+"""Shared AST helpers for the graftlint checkers."""
+
+from __future__ import annotations
+
+import ast
+
+
+def call_name(node: ast.Call) -> str | None:
+    """Terminal name of a call's callee: ``NamedSharding(...)`` and
+    ``jax.sharding.NamedSharding(...)`` both resolve to
+    ``"NamedSharding"``."""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return None
+
+
+def expr_key(node: ast.AST) -> str | None:
+    """Canonical string for a simple expression — the identity the
+    obs-gate and lock checkers compare guards/locks by. Only dotted
+    name chains qualify (``ev``, ``self._events``); anything with calls
+    or subscripts is not a stable identity."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = expr_key(node.value)
+        return f"{base}.{node.attr}" if base is not None else None
+    return None
+
+
+def assigned_names(target: ast.AST) -> list[str]:
+    """Plain local names bound by an assignment target (tuple/list
+    unpack included; starred, attribute and subscript targets are not
+    local-name bindings)."""
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: list[str] = []
+        for elt in target.elts:
+            if isinstance(elt, ast.Starred):
+                elt = elt.value
+            out.extend(assigned_names(elt))
+        return out
+    return []
+
+
+def subtree_mentions(node: ast.AST, names: set[str]) -> bool:
+    """True when any ``Name`` in the subtree is in ``names``."""
+    return any(isinstance(n, ast.Name) and n.id in names
+               for n in ast.walk(node))
+
+
+def loaded_names(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)}
+
+
+def walk_functions(tree: ast.AST):
+    """Yield ``(func_node, stack)`` for every def, with the enclosing
+    Class/Function stack (outermost first, ending at the def itself)."""
+    out = []
+
+    def visit(node, stack):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append((child, stack + [child]))
+                visit(child, stack + [child])
+            elif isinstance(child, ast.ClassDef):
+                visit(child, stack + [child])
+            else:
+                visit(child, stack)
+
+    visit(tree, [])
+    return out
+
+
+def none_compare(node: ast.AST) -> tuple[str | None, bool] | None:
+    """``X is not None`` -> (key(X), True); ``X is None`` -> (key(X),
+    False); anything else -> None."""
+    if (isinstance(node, ast.Compare) and len(node.ops) == 1
+            and isinstance(node.comparators[0], ast.Constant)
+            and node.comparators[0].value is None):
+        key = expr_key(node.left)
+        if key is None:
+            return None
+        if isinstance(node.ops[0], ast.IsNot):
+            return key, True
+        if isinstance(node.ops[0], ast.Is):
+            return key, False
+    return None
+
+
+def truthy_implies_not_none(test: ast.AST, obs_keys: set[str]) -> set[str]:
+    """Keys guaranteed non-None when ``test`` is truthy. ``and`` chains
+    accumulate; ``or`` guarantees nothing; a bare obs name is its own
+    guard (``if ev:``)."""
+    cmp = none_compare(test)
+    if cmp is not None:
+        return {cmp[0]} if cmp[1] else set()
+    key = expr_key(test)
+    if key is not None and key in obs_keys:
+        return {key}
+    if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+        out: set[str] = set()
+        for v in test.values:
+            out |= truthy_implies_not_none(v, obs_keys)
+        return out
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return falsy_implies_not_none(test.operand, obs_keys)
+    return set()
+
+
+def falsy_implies_not_none(test: ast.AST, obs_keys: set[str]) -> set[str]:
+    """Keys guaranteed non-None when ``test`` is FALSY — the early-exit
+    shape: after ``if X is None: return``, X is non-None. ``or`` chains
+    accumulate (all disjuncts falsy); ``and`` guarantees nothing."""
+    cmp = none_compare(test)
+    if cmp is not None:
+        return set() if cmp[1] else {cmp[0]}
+    if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.Or):
+        out: set[str] = set()
+        for v in test.values:
+            out |= falsy_implies_not_none(v, obs_keys)
+        return out
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return truthy_implies_not_none(test.operand, obs_keys)
+    return set()
+
+
+def terminates(body: list[ast.stmt]) -> bool:
+    """Does this block unconditionally leave the enclosing block?"""
+    return bool(body) and isinstance(
+        body[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))

@@ -1,0 +1,28 @@
+"""Checker registry: rule name -> Checker class.
+
+Adding a checker: write a module here subclassing
+``tools.graftlint.core.Checker``, import it below, add it to
+``ALL_CHECKERS``, give it a planted-violation + clean-twin fixture in
+``tests/test_graftlint.py``, and document its measured incident in
+``docs/STATIC_ANALYSIS.md``.
+"""
+
+from tools.graftlint.checkers.buffer_aliasing import BufferAliasingChecker
+from tools.graftlint.checkers.host_sync import HostSyncChecker
+from tools.graftlint.checkers.lock_gap import LockGapChecker
+from tools.graftlint.checkers.lock_order import LockOrderChecker
+from tools.graftlint.checkers.obs_gate import ObsGateChecker
+from tools.graftlint.checkers.sharding_funnel import ShardingFunnelChecker
+
+ALL_CHECKERS = {
+    c.name: c for c in (
+        ShardingFunnelChecker,
+        ObsGateChecker,
+        LockOrderChecker,
+        LockGapChecker,
+        BufferAliasingChecker,
+        HostSyncChecker,
+    )
+}
+
+__all__ = ["ALL_CHECKERS"]

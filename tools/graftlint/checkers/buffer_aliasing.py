@@ -1,0 +1,140 @@
+"""buffer-aliasing: no ``jnp.asarray``/``jnp.frombuffer`` on a reused
+numpy staging buffer.
+
+Incident this descends from (CHANGES.md PR 13, review-grade fix):
+``jnp.asarray`` zero-copy ALIASES aligned numpy buffers on the CPU
+backend and dispatch is asynchronous, so refilling a REUSED staging
+buffer (the ``_pad_buffers`` dict carried since PR 3) raced the
+previous batch's in-flight kernel's read of the same memory — measured
+as whole-partition factor divergence under N consumers, latent even
+single-threaded. ``ops/sgd.py::pad_minibatches`` pins the hazard in
+its docstring; this rule enforces it mechanically for every caller.
+
+Flagged shapes:
+
+1. results of a call passing ``buffers=<attr/name>`` (the
+   ``pad_minibatches`` reuse contract) later fed to
+   ``jnp.asarray``/``jnp.frombuffer`` — the exact PR 13 shape;
+2. a local bound from an attribute (or subscript of one) that is
+   refilled via subscript-store and then fed to ``jnp.asarray`` — the
+   hand-rolled staging-buffer shape;
+3. ``jnp.asarray(self.X)``/``jnp.asarray(MODULE_BUF)`` where that
+   attribute/module name is subscript-stored anywhere in the same
+   class/module — an attribute that is both refilled and zero-copy
+   wrapped is a reuse race whenever the wrap's consumer is async.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.astutil import assigned_names, expr_key, walk_functions
+from tools.graftlint.core import Checker, Finding, ModuleInfo, Project
+
+WRAPPERS = {"asarray", "frombuffer"}
+
+
+def _is_jnp_wrap(node: ast.Call) -> bool:
+    f = node.func
+    return (isinstance(f, ast.Attribute) and f.attr in WRAPPERS
+            and isinstance(f.value, ast.Name) and f.value.id == "jnp")
+
+
+def _subscript_stored_attrs(scope: ast.AST) -> set[str]:
+    """Dotted keys of attributes/names stored through a subscript
+    anywhere in ``scope`` (``self._buf[n:] = 0`` -> ``self._buf``)."""
+    out: set[str] = set()
+    for node in ast.walk(scope):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AugAssign):
+            targets = [node.target]
+        for t in targets:
+            for sub in ast.walk(t):
+                if isinstance(sub, ast.Subscript):
+                    key = expr_key(sub.value)
+                    if key is not None:
+                        out.add(key)
+    return out
+
+
+class BufferAliasingChecker(Checker):
+    name = "buffer-aliasing"
+    description = ("jnp.asarray/frombuffer on a reused numpy staging "
+                   "buffer (write-after-read race vs async dispatch)")
+
+    def run(self, project: Project) -> list[Finding]:
+        out: list[Finding] = []
+        for mod in project.modules:
+            out.extend(self._check_module(mod))
+        return out
+
+    def _check_module(self, mod: ModuleInfo) -> list[Finding]:
+        out: list[Finding] = []
+        # shape 3 context: attrs subscript-stored per class, names per
+        # module (a refill anywhere marks the buffer as reused)
+        stored_by_class: dict[ast.ClassDef, set[str]] = {
+            cls: _subscript_stored_attrs(cls)
+            for cls in ast.walk(mod.tree) if isinstance(cls, ast.ClassDef)}
+        module_stored = _subscript_stored_attrs(mod.tree)
+
+        for func, stack in walk_functions(mod.tree):
+            cls = next((n for n in reversed(stack[:-1])
+                        if isinstance(n, ast.ClassDef)), None)
+            reused_attrs = set(stored_by_class.get(cls, set()))
+            reused_attrs |= {k for k in module_stored
+                             if not k.startswith("self.")}
+
+            # staged locals: shape 1 (buffers= results) and shape 2
+            # (attr-bound locals refilled in-function)
+            staged: dict[str, str] = {}      # name -> why
+            attr_bound: set[str] = set()
+            stored_local = _subscript_stored_attrs(func)
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Assign):
+                    continue
+                names = [n for t in node.targets
+                         for n in assigned_names(t)]
+                if isinstance(node.value, ast.Call):
+                    for kw in node.value.keywords:
+                        if kw.arg == "buffers" and not (
+                                isinstance(kw.value, ast.Constant)
+                                and kw.value.value is None):
+                            for n in names:
+                                staged[n] = ("result of a buffers=-"
+                                             "reusing call")
+                src = node.value
+                if isinstance(src, ast.Subscript):
+                    src = src.value
+                key = expr_key(src)
+                if key is not None and ("self." in key or "." in key):
+                    attr_bound.update(names)
+            for n in attr_bound & stored_local:
+                staged.setdefault(
+                    n, "attribute-held buffer refilled in this function")
+
+            for node in ast.walk(func):
+                if not (isinstance(node, ast.Call) and _is_jnp_wrap(node)
+                        and node.args):
+                    continue
+                arg = node.args[0]
+                if isinstance(arg, ast.Name) and arg.id in staged:
+                    out.append(self.finding(
+                        mod, node, stack,
+                        f"jnp.{node.func.attr} on `{arg.id}` — "
+                        f"{staged[arg.id]}: zero-copy aliasing races "
+                        f"the previous in-flight dispatch's read "
+                        f"(the PR 13 staging-buffer class); allocate "
+                        f"fresh per batch or copy before wrapping"))
+                    continue
+                akey = expr_key(arg)
+                if akey is not None and "." in akey \
+                        and akey in reused_attrs:
+                    out.append(self.finding(
+                        mod, node, stack,
+                        f"jnp.{node.func.attr} on reused staging "
+                        f"buffer `{akey}` (subscript-refilled "
+                        f"elsewhere in this scope) — zero-copy "
+                        f"aliasing races async dispatch"))
+        return out

@@ -1,0 +1,214 @@
+"""host-sync-in-hot-path: no device→host synchronization inside
+functions reachable from the training/serving hot paths.
+
+Incident this descends from (CHANGES.md PRs 4/8/13, measured
+repeatedly): the streaming ``partial_fit`` path and the serving
+``_serve_rows`` drain are built on ASYNC dispatch — one stray
+``.item()`` / ``float(device_val)`` / ``np.asarray(device_val)`` /
+implicit bool coercion serializes the pipeline on the device and the
+measured overlap win disappears (the PR 7 pod harness even found the
+opposite bug: a wall-clock that STOPPED too early because nothing
+synced). Deliberate syncs exist (the enabled-only ``block_until_ready``
+behind ``_obs_on``, the ``emit_updates`` gather) — they carry inline
+``# graftlint: disable=host-sync`` suppressions stating why, so every
+OTHER sync is a regression this rule catches.
+
+Reachability: BFS from the root names (``partial_fit``,
+``_serve_rows``, ``sgd_block_sweep`` — the stratum sweep) through
+same-module calls, same-class ``self.m()`` calls, and
+``import``-resolved package-module calls. Device-ness is dataflow-lite:
+an expression mentioning ``jnp``/``jax`` (or a local bound from one)
+is treated as device-resident.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.astutil import (
+    assigned_names,
+    expr_key,
+    walk_functions,
+)
+from tools.graftlint.core import Checker, Finding, Project
+
+HOT_ROOTS = ("partial_fit", "_serve_rows", "sgd_block_sweep")
+
+SYNC_BUILTINS = {"float", "int", "bool"}
+
+
+def _mentions_device(node: ast.AST, device_locals: set[str]) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and (
+                n.id in ("jnp", "jax") or n.id in device_locals):
+            return True
+    return False
+
+
+class _FuncRef:
+    __slots__ = ("mod", "node", "stack", "qual")
+
+    def __init__(self, mod, node, stack):
+        self.mod, self.node, self.stack = mod, node, stack
+        self.qual = Checker.qualname(stack)
+
+
+class HostSyncChecker(Checker):
+    name = "host-sync"
+    description = (".item()/float()/np.asarray/bool coercion on device "
+                   "values in functions reachable from the hot paths")
+
+    def run(self, project: Project) -> list[Finding]:
+        index = self._index(project)
+        reachable = self._reach(index)
+        out: list[Finding] = []
+        for ref in reachable:
+            out.extend(self._check_function(ref))
+        return out
+
+    # -- project index --------------------------------------------------------
+
+    def _index(self, project: Project):
+        """(modname, kind, name[, cls]) lookup tables for call
+        resolution. modname is the repo-relative path sans .py."""
+        funcs: dict[tuple[str, str], _FuncRef] = {}       # (mod, fname)
+        methods: dict[tuple[str, str, str], _FuncRef] = {}  # (mod, cls, m)
+        imports: dict[str, dict[str, str]] = {}   # mod -> alias -> target
+        fromimp: dict[str, dict[str, tuple[str, str]]] = {}
+        for mod in project.modules:
+            mname = mod.rel[:-3].replace("/", ".")
+            imports[mname] = {}
+            fromimp[mname] = {}
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Import):
+                    for a in node.names:
+                        imports[mname][a.asname or a.name.split(".")[0]] \
+                            = a.name
+                elif isinstance(node, ast.ImportFrom) and node.module:
+                    for a in node.names:
+                        fromimp[mname][a.asname or a.name] = (
+                            node.module, a.name)
+            for func, stack in walk_functions(mod.tree):
+                ref = _FuncRef(mod, func, stack)
+                cls = next((n for n in reversed(stack[:-1])
+                            if isinstance(n, ast.ClassDef)), None)
+                if cls is not None:
+                    methods[(mname, cls.name, func.name)] = ref
+                elif len(stack) == 1:
+                    funcs[(mname, func.name)] = ref
+        return {"funcs": funcs, "methods": methods,
+                "imports": imports, "fromimp": fromimp}
+
+    def _reach(self, index) -> list[_FuncRef]:
+        funcs, methods = index["funcs"], index["methods"]
+        queue = [ref for (m, f), ref in funcs.items() if f in HOT_ROOTS]
+        queue += [ref for (m, c, f), ref in methods.items()
+                  if f in HOT_ROOTS]
+        seen = {id(r.node) for r in queue}
+        out = []
+        while queue:
+            ref = queue.pop()
+            out.append(ref)
+            mname = ref.mod.rel[:-3].replace("/", ".")
+            cls = next((n for n in reversed(ref.stack[:-1])
+                        if isinstance(n, ast.ClassDef)), None)
+            for node in ast.walk(ref.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = None
+                f = node.func
+                if isinstance(f, ast.Name):
+                    target = funcs.get((mname, f.id))
+                    if target is None and f.id in index["fromimp"][mname]:
+                        srcmod, srcname = index["fromimp"][mname][f.id]
+                        target = self._by_module_tail(
+                            funcs, srcmod, srcname)
+                elif isinstance(f, ast.Attribute):
+                    base = expr_key(f.value)
+                    if base == "self" and cls is not None:
+                        target = methods.get((mname, cls.name, f.attr))
+                    elif base is not None and base in \
+                            index["imports"][mname]:
+                        target = self._by_module_tail(
+                            funcs, index["imports"][mname][base], f.attr)
+                    elif base is not None and base in \
+                            index["fromimp"][mname]:
+                        srcmod, srcname = index["fromimp"][mname][base]
+                        target = self._by_module_tail(
+                            funcs, f"{srcmod}.{srcname}", f.attr)
+                if target is not None and id(target.node) not in seen:
+                    seen.add(id(target.node))
+                    queue.append(target)
+        return out
+
+    @staticmethod
+    def _by_module_tail(funcs, module: str, fname: str):
+        """Match an imported module path against the repo-relative
+        module names (``large_scale_recommendation_tpu.ops.sgd`` ==
+        rel ``large_scale_recommendation_tpu/ops/sgd.py``)."""
+        for (m, f), ref in funcs.items():
+            if f != fname:
+                continue
+            if m == module or m.split(".")[-1] == module.split(".")[-1]:
+                return ref
+        return None
+
+    # -- per-function check ---------------------------------------------------
+
+    def _check_function(self, ref: _FuncRef) -> list[Finding]:
+        out: list[Finding] = []
+        device_locals: set[str] = set()
+        # dataflow-lite: locals bound from jnp/jax-mentioning exprs
+        for node in ast.walk(ref.node):
+            if isinstance(node, ast.Assign) and _mentions_device(
+                    node.value, device_locals):
+                for t in node.targets:
+                    device_locals.update(assigned_names(t))
+
+        for node in ast.walk(ref.node):
+            if isinstance(node, ast.Call):
+                f = node.func
+                if isinstance(f, ast.Attribute) and f.attr == "item" \
+                        and not node.args:
+                    out.append(self.finding(
+                        ref.mod, node, ref.stack,
+                        ".item() in a hot-path-reachable function — "
+                        "device→host sync serializes the async "
+                        "pipeline"))
+                elif isinstance(f, ast.Attribute) \
+                        and f.attr == "block_until_ready":
+                    out.append(self.finding(
+                        ref.mod, node, ref.stack,
+                        "block_until_ready() in a hot-path-reachable "
+                        "function — deliberate syncs must carry an "
+                        "inline suppression stating why"))
+                elif (isinstance(f, ast.Name) and f.id in SYNC_BUILTINS
+                      and len(node.args) == 1
+                      and not isinstance(node.args[0], ast.Constant)
+                      and _mentions_device(node.args[0], device_locals)):
+                    out.append(self.finding(
+                        ref.mod, node, ref.stack,
+                        f"{f.id}() on a device value in a hot-path-"
+                        f"reachable function — implicit device→host "
+                        f"sync"))
+                elif (isinstance(f, ast.Attribute) and f.attr == "asarray"
+                      and isinstance(f.value, ast.Name)
+                      and f.value.id in ("np", "numpy") and node.args
+                      and _mentions_device(node.args[0], device_locals)):
+                    out.append(self.finding(
+                        ref.mod, node, ref.stack,
+                        "np.asarray on a device value in a hot-path-"
+                        "reachable function — device→host copy "
+                        "serializes dispatch"))
+            elif isinstance(node, (ast.If, ast.While)):
+                test = node.test
+                if (not isinstance(test, ast.Compare)
+                        or not any(isinstance(op, (ast.Is, ast.IsNot))
+                                   for op in test.ops)) \
+                        and not isinstance(test, ast.Call) \
+                        and _mentions_device(test, device_locals):
+                    out.append(self.finding(
+                        ref.mod, test, ref.stack,
+                        "implicit bool() coercion of a device value in "
+                        "a hot-path branch — hidden device→host sync"))
+        return out

@@ -1,0 +1,198 @@
+"""lock-gap: state gathered under one hold must not be written under a
+later re-acquisition of the same lock.
+
+Incident this descends from (CHANGES.md PR 13, second review round —
+found TWICE by human review): ``flush_deltas`` released the engine lock
+between TAKING the pending-delta dict and INSTALLING it, and the
+adaptive ``_do_refresh`` held no lock between gathering dirty rows and
+flushing them — in both, a writer landing in the gap (a background
+retrain's install) was silently overwritten by the stale state gathered
+under the first hold. The fix is always the same: hold the lock across
+gather→write, or re-validate under the second hold.
+
+Detection shape: within one function, two sibling ``with`` blocks on
+the SAME lock where a local name bound inside the first block is read
+inside the second block while feeding a write (an attribute/subscript
+assignment's value, or the arguments of a method call — method calls
+are how the install usually happens). The window between the holds is
+the reversion window.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.astutil import (
+    assigned_names,
+    expr_key,
+    terminates,
+    walk_functions,
+)
+from tools.graftlint.core import Checker, Finding, Project
+
+
+def _with_lock_key(item: ast.withitem) -> str | None:
+    """Identity of a with-item's lock: the dotted expression text.
+    (Same-function comparison only, so the raw expr key is identity
+    enough — ``self._lock`` == ``self._lock``.)"""
+    return expr_key(item.context_expr)
+
+
+def _bound_locals(block: ast.With) -> dict[str, int]:
+    """Local names bound inside ``block`` -> earliest binding lineno."""
+    names: dict[str, int] = {}
+
+    def note(bound: list[str], lineno: int):
+        for n in bound:
+            if n not in names or lineno < names[n]:
+                names[n] = lineno
+
+    for node in ast.walk(block):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                note(assigned_names(t), node.lineno)
+        elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+            note(assigned_names(node.target), node.lineno)
+        elif isinstance(node, ast.For):
+            note(assigned_names(node.target), node.lineno)
+        elif isinstance(node, ast.withitem) and node.optional_vars:
+            note(assigned_names(node.optional_vars),
+                 node.optional_vars.lineno)
+    return names
+
+
+def _dominating_binds(block: ast.With) -> dict[str, int]:
+    """Names rebound by DIRECT top-level assignments of the hold's body
+    -> lineno. Only these exonerate a later read (the re-validate
+    idiom rebinds unconditionally at the top); a rebind nested under an
+    ``if``/loop does not dominate the read and exonerates nothing."""
+    binds: dict[str, int] = {}
+    for st in block.body:
+        if isinstance(st, ast.Assign):
+            for t in st.targets:
+                for n in assigned_names(t):
+                    binds.setdefault(n, st.lineno)
+        elif isinstance(st, ast.AnnAssign) and st.value is not None:
+            for n in assigned_names(st.target):
+                binds.setdefault(n, st.lineno)
+    return binds
+
+
+def _written_reads(block: ast.With, gathered: set[str]):
+    """Yield (node, name) where a gathered name feeds a write inside
+    ``block``: the value side of an attribute/subscript assignment, an
+    augmented assignment, or any method-call argument."""
+    for node in ast.walk(block):
+        if isinstance(node, ast.Assign):
+            targets_write = any(
+                isinstance(t, (ast.Attribute, ast.Subscript))
+                or any(isinstance(e, (ast.Attribute, ast.Subscript))
+                       for e in getattr(t, "elts", []))
+                for t in node.targets)
+            if targets_write:
+                for n in ast.walk(node.value):
+                    if (isinstance(n, ast.Name)
+                            and isinstance(n.ctx, ast.Load)
+                            and n.id in gathered):
+                        yield node, n.id
+        elif isinstance(node, ast.AugAssign) and isinstance(
+                node.target, (ast.Attribute, ast.Subscript)):
+            for n in ast.walk(node.value):
+                if isinstance(n, ast.Name) and n.id in gathered:
+                    yield node, n.id
+        elif (isinstance(node, ast.Call)
+              and isinstance(node.func, ast.Attribute)):
+            for arg in list(node.args) + [k.value for k in node.keywords]:
+                for n in ast.walk(arg):
+                    if (isinstance(n, ast.Name)
+                            and isinstance(n.ctx, ast.Load)
+                            and n.id in gathered):
+                        yield node, n.id
+
+
+class LockGapChecker(Checker):
+    name = "lock-gap"
+    description = ("take-release-retake on one lock where state from "
+                   "the first hold is written under the second")
+
+    def run(self, project: Project) -> list[Finding]:
+        out: list[Finding] = []
+        for mod in project.modules:
+            for func, stack in walk_functions(mod.tree):
+                out.extend(self._check_function(mod, func, stack))
+        return out
+
+    def _check_function(self, mod, func, stack) -> list[Finding]:
+        # every with-block in the function, keyed by lock identity,
+        # EXCLUDING blocks nested inside another hold of the same lock
+        holds: dict[str, list[ast.With]] = {}
+
+        def visit(node, enclosing: tuple[str, ...]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    continue  # separate scope, visited on its own
+                if isinstance(child, ast.With):
+                    keys = [k for item in child.items
+                            if (k := _with_lock_key(item)) is not None]
+                    for k in keys:
+                        if k not in enclosing:
+                            holds.setdefault(k, []).append(child)
+                    visit(child, enclosing + tuple(keys))
+                else:
+                    visit(child, enclosing)
+
+        visit(func, ())
+
+        out: list[Finding] = []
+        for lock, blocks in holds.items():
+            if len(blocks) < 2:
+                continue
+            blocks.sort(key=lambda b: b.lineno)
+            bounds = [_bound_locals(b) for b in blocks]
+            for j, w2 in enumerate(blocks[1:], start=1):
+                # every name bound under ANY earlier hold is a gap
+                # candidate — not just the lineno-adjacent one (a
+                # telemetry-only hold in between must not hide the
+                # 1st→3rd reversion window)
+                gathered: set[str] = set()
+                for b in bounds[:j]:
+                    gathered |= set(b)
+                if not gathered:
+                    continue
+                reported: set[str] = set()
+                for node, name in _written_reads(w2, gathered):
+                    if name in reported:
+                        continue
+                    # a name the second hold re-binds BEFORE this read
+                    # by a DOMINATING (top-level, unconditional)
+                    # assignment is re-gathered fresh under the lock
+                    # (the re-validate idiom) — a rebind after the
+                    # write (reset-for-next-cycle) or inside a branch
+                    # (conditionally fresh) exonerates nothing
+                    if _dominating_binds(w2).get(name, 10**9) \
+                            <= node.lineno:
+                        continue
+                    # charge the NEAREST earlier binder whose body can
+                    # fall through; a binder that terminates (e.g. the
+                    # defer arm's `return`) never reaches this hold —
+                    # keep looking further back
+                    w1 = None
+                    for i in range(j - 1, -1, -1):
+                        if name in bounds[i]:
+                            if terminates(blocks[i].body):
+                                continue
+                            w1 = blocks[i]
+                            break
+                    if w1 is None:
+                        continue
+                    reported.add(name)
+                    out.append(self.finding(
+                        mod, node, stack,
+                        f"`{name}` gathered under the hold of "
+                        f"`{lock}` at line {w1.lineno} is written "
+                        f"under a re-acquisition (line {w2.lineno}) — "
+                        f"a writer landing in the gap is silently "
+                        f"reverted; hold the lock across gather→write "
+                        f"or re-validate under the second hold"))
+        return out

@@ -1,0 +1,247 @@
+"""lock-order: the static lock-acquisition graph must be acyclic.
+
+Incident this descends from (CHANGES.md PR 13/14): the parallel-ingest
+runner composes FOUR named locks (barrier / ckpt-write / refresh /
+the model apply lock) around N consumer threads — exactly the shape the
+PR 13 barrier/retain race lived next to, found only by review rounds.
+Two code paths acquiring the same pair of locks in opposite orders is
+a deadlock that no single-threaded test will ever trip; the order
+graph, however, is statically checkable.
+
+Graph construction (best-effort, documented in STATIC_ANALYSIS.md):
+
+- lock identity: the ``named_lock``/``named_rlock``/``named_condition``
+  literal name where one was assigned to the attribute; an alias
+  assignment (``self._apply_lock = model.apply_lock``) becomes
+  ``~apply_lock`` (one node per aliased attr name); raw
+  ``threading.Lock()``-family attrs become ``Class.attr``.
+- edges: lexical ``with A:`` nesting inside one function, plus ONE
+  level of same-class interprocedural propagation (``with A:`` around
+  ``self.m()`` where ``m`` acquires B ⇒ edge A→B) — the
+  ``_run_barrier`` → ``_capture`` → apply-lock shape.
+- a cycle in the merged graph across all scanned modules is the
+  finding; self-loops only count for non-reentrant kinds (``Lock`` /
+  ``named_lock`` — a nested ``with`` on a plain Lock deadlocks
+  unconditionally).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.astutil import expr_key, walk_functions
+from tools.graftlint.core import Checker, Finding, ModuleInfo, Project
+
+NAMED_CTORS = {"named_lock": "lock", "named_rlock": "rlock",
+               "named_condition": "condition"}
+RAW_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "condition"}
+
+
+def _ctor_kind(value: ast.AST) -> tuple[str, str | None] | None:
+    """(kind, name literal or None) when value constructs a lock."""
+    if not isinstance(value, ast.Call):
+        return None
+    f = value.func
+    fname = f.id if isinstance(f, ast.Name) else (
+        f.attr if isinstance(f, ast.Attribute) else None)
+    if fname in NAMED_CTORS:
+        name = None
+        if value.args and isinstance(value.args[0], ast.Constant) \
+                and isinstance(value.args[0].value, str):
+            name = value.args[0].value
+        return NAMED_CTORS[fname], name
+    if fname in RAW_CTORS:
+        return RAW_CTORS[fname], None
+    return None
+
+
+class LockOrderChecker(Checker):
+    name = "lock-order"
+    description = ("the static with-nesting lock-acquisition graph "
+                   "contains no cycle")
+
+    def run(self, project: Project) -> list[Finding]:
+        # node -> kind; edge (a, b) -> example site (mod, lineno, qual)
+        self.kinds: dict[str, str] = {}
+        edges: dict[tuple[str, str], tuple[ModuleInfo, int, str]] = {}
+        for mod in project.modules:
+            self._collect_module(mod, edges)
+        return self._report_cycles(edges)
+
+    # -- lock identity --------------------------------------------------------
+
+    def _class_locks(self, cls: ast.ClassDef) -> dict[str, str]:
+        """attr name -> node id for locks assigned to self.* anywhere
+        in the class."""
+        table: dict[str, str] = {}
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                if not (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    continue
+                ctor = _ctor_kind(node.value)
+                if ctor is not None:
+                    kind, lit = ctor
+                    node_id = lit or f"{cls.name}.{t.attr}"
+                    table[t.attr] = node_id
+                    self.kinds[node_id] = kind
+                    continue
+                # alias of another object's lock attribute:
+                # self._apply_lock = model.apply_lock (IfExp branches too)
+                vals = ([node.value.body, node.value.orelse]
+                        if isinstance(node.value, ast.IfExp)
+                        else [node.value])
+                for v in vals:
+                    if (isinstance(v, ast.Attribute)
+                            and "lock" in v.attr.lower()):
+                        node_id = f"~{v.attr}"
+                        table[t.attr] = node_id
+                        self.kinds.setdefault(node_id, "alias")
+        return table
+
+    # -- graph construction ---------------------------------------------------
+
+    def _collect_module(self, mod: ModuleInfo, edges) -> None:
+        for cls in [n for n in ast.walk(mod.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            locks = self._class_locks(cls)
+            if not locks:
+                continue
+            methods = {n.name: n for n in cls.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))}
+
+            def resolve(expr) -> str | None:
+                key = expr_key(expr)
+                if key and key.startswith("self."):
+                    return locks.get(key[len("self."):])
+                return None
+
+            # pass 1: per-method lexical acquisitions
+            lexical: dict[str, set[str]] = {}
+            for name, m in methods.items():
+                acq: set[str] = set()
+                for node in ast.walk(m):
+                    if isinstance(node, ast.With):
+                        for item in node.items:
+                            lid = resolve(item.context_expr)
+                            if lid is not None:
+                                acq.add(lid)
+                lexical[name] = acq
+
+            # pass 2: one-level closure over self.m() calls
+            may: dict[str, set[str]] = {n: set(s)
+                                        for n, s in lexical.items()}
+            changed = True
+            while changed:
+                changed = False
+                for name, m in methods.items():
+                    for node in ast.walk(m):
+                        if (isinstance(node, ast.Call)
+                                and isinstance(node.func, ast.Attribute)
+                                and expr_key(node.func.value) == "self"
+                                and node.func.attr in may):
+                            before = len(may[name])
+                            may[name] |= may[node.func.attr]
+                            changed |= len(may[name]) != before
+
+            # pass 3: edges — walk each method tracking held locks
+            for name, m in methods.items():
+                for st in m.body:
+                    self._edges_in(st, f"{cls.name}.{name}", mod,
+                                   resolve, may, [], edges)
+
+    def _edges_in(self, node, qual, mod, resolve, may, held, edges):
+        if isinstance(node, ast.With):
+            acquired = [lid for item in node.items
+                        if (lid := resolve(item.context_expr))
+                        is not None]
+            new_held = list(held)
+            for lid in acquired:
+                for h in new_held:
+                    edges.setdefault((h, lid), (mod, node.lineno, qual))
+                new_held.append(lid)
+            for sub in node.body:
+                self._edges_in(sub, qual, mod, resolve, may, new_held,
+                               edges)
+            # with-item expressions evaluate BEFORE the acquisition
+            for item in node.items:
+                self._edges_in(item.context_expr, qual, mod, resolve,
+                               may, held, edges)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs run later, not under this hold
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and expr_key(node.func.value) == "self"
+                and node.func.attr in may and held):
+            for lid in may[node.func.attr]:
+                for h in held:
+                    if h != lid:
+                        edges.setdefault(
+                            (h, lid), (mod, node.lineno, qual))
+        for child in ast.iter_child_nodes(node):
+            self._edges_in(child, qual, mod, resolve, may, held, edges)
+
+    # -- cycle detection ------------------------------------------------------
+
+    def _report_cycles(self, edges) -> list[Finding]:
+        out: list[Finding] = []
+        graph: dict[str, set[str]] = {}
+        for (a, b) in edges:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+
+        # self-loops: deadlock iff the lock is not reentrant
+        for (a, b), (mod, lineno, qual) in sorted(
+                edges.items(), key=lambda kv: (kv[1][0].rel, kv[1][1])):
+            if a == b and self.kinds.get(a) in ("lock", "condition"):
+                out.append(Finding(
+                    rule=self.name, path=mod.rel, line=lineno,
+                    symbol=qual, line_text=mod.line_text(lineno),
+                    message=(f"nested acquisition of non-reentrant "
+                             f"lock `{a}` — self-deadlock")))
+
+        # multi-node cycles via iterative DFS
+        color: dict[str, int] = {}
+        stack_path: list[str] = []
+        cycles: list[list[str]] = []
+
+        def dfs(n):
+            color[n] = 1
+            stack_path.append(n)
+            for m in sorted(graph.get(n, ())):
+                if m == n:
+                    continue
+                if color.get(m, 0) == 1:
+                    cyc = stack_path[stack_path.index(m):] + [m]
+                    cycles.append(cyc)
+                elif color.get(m, 0) == 0:
+                    dfs(m)
+            stack_path.pop()
+            color[n] = 2
+
+        for n in sorted(graph):
+            if color.get(n, 0) == 0:
+                dfs(n)
+
+        seen: set[frozenset] = set()
+        for cyc in cycles:
+            ident = frozenset(cyc)
+            if ident in seen:
+                continue
+            seen.add(ident)
+            # anchor the finding at the edge that closes the cycle
+            mod, lineno, qual = edges.get(
+                (cyc[-2], cyc[-1]), next(iter(edges.values())))
+            out.append(Finding(
+                rule=self.name, path=mod.rel, line=lineno, symbol=qual,
+                line_text=mod.line_text(lineno),
+                message=("lock-order cycle: "
+                         + " -> ".join(f"`{n}`" for n in cyc)
+                         + " — two paths acquire these locks in "
+                           "opposite orders")))
+        return out

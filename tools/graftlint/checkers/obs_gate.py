@@ -1,0 +1,278 @@
+"""obs-gate: calls on module-default-None obs objects must be gated.
+
+Incident this descends from (CHANGES.md PRs 5/10/12/14): the zero-cost
+observability contract — pinned by
+``TestNullPathZeroWork::test_*_default_off_everywhere`` — rests on
+every production site paying exactly ONE ``is not None`` test when a
+plane is off. The journal/recorder/lineage/disttrace/contention/
+introspector module defaults are ``None`` (not null objects), so an
+ungated call site is an ``AttributeError`` waiting for the first
+default-off run that reaches it — a regression the zero-cost pins only
+catch for the specific sites they exercise. This rule closes the gap
+mechanically: any method call on a name bound from a None-default
+getter must sit behind a dominating ``is not None`` (or equivalent
+truthiness) guard.
+
+Recognized guard shapes: ``if x is not None:``, ``if x:``, ``and``
+chains, ``assert x is not None``, early exits (``if x is None:
+return``), ternaries, ``while`` tests, and boolean flags assigned from
+an implying expression (``grew = ev is not None and ...`` then
+``if grew: ev.emit(...)`` — the ``_apply_concurrent`` shape).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.astutil import (
+    assigned_names,
+    expr_key,
+    none_compare,
+    terminates,
+    walk_functions,
+)
+from tools.graftlint.core import Checker, Finding, ModuleInfo, Project
+
+# the getters whose module default is None (get_tracer/get_registry
+# return null objects and need no gate)
+NONE_GETTERS = {
+    "get_events", "get_recorder", "get_lineage", "get_disttrace",
+    "get_contention", "get_introspector",
+}
+
+
+def _is_getter_bound(value: ast.AST) -> bool:
+    """Does this assignment value derive from a None-default getter?"""
+    return any(isinstance(n, ast.Call)
+               and isinstance(n.func, (ast.Name, ast.Attribute))
+               and (n.func.id if isinstance(n.func, ast.Name)
+                    else n.func.attr) in NONE_GETTERS
+               for n in ast.walk(value))
+
+
+class ObsGateChecker(Checker):
+    name = "obs-gate"
+    description = ("every call on a module-default-None obs object "
+                   "sits behind an `is not None` gate")
+
+    def run(self, project: Project) -> list[Finding]:
+        out: list[Finding] = []
+        for mod in project.modules:
+            if mod.rel.replace("\\", "/").split("/")[-2:-1] == ["obs"]:
+                # the obs package itself manages its own lifecycles
+                # (enable/disable/set_* own the None transitions)
+                continue
+            out.extend(self._check_module(mod))
+        return out
+
+    # -- symbol collection ---------------------------------------------------
+
+    def _class_obs_attrs(self, cls: ast.ClassDef) -> set[str]:
+        attrs: set[str] = set()
+        for node in ast.walk(cls):
+            if isinstance(node, ast.Assign) and _is_getter_bound(node.value):
+                for t in node.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        attrs.add(t.attr)
+        return attrs
+
+    def _module_obs_names(self, mod: ModuleInfo) -> set[str]:
+        names: set[str] = set()
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and _is_getter_bound(node.value):
+                for t in node.targets:
+                    names.update(assigned_names(t))
+        return names
+
+    # -- per-module ---------------------------------------------------------
+
+    def _check_module(self, mod: ModuleInfo) -> list[Finding]:
+        out: list[Finding] = []
+        module_names = self._module_obs_names(mod)
+        for func, stack in walk_functions(mod.tree):
+            cls = next((n for n in reversed(stack[:-1])
+                        if isinstance(n, ast.ClassDef)), None)
+            keys = {f"self.{a}" for a in
+                    (self._class_obs_attrs(cls) if cls else set())}
+            keys |= module_names
+            # locals bound from getters inside THIS function
+            for node in ast.walk(func):
+                if isinstance(node, ast.Assign) and _is_getter_bound(
+                        node.value):
+                    for t in node.targets:
+                        keys.update(assigned_names(t))
+                # aliases of known obs keys: ev = self._events
+                elif (isinstance(node, ast.Assign)
+                      and expr_key(node.value) in keys):
+                    for t in node.targets:
+                        keys.update(assigned_names(t))
+            # always walk: the direct-getter-result check needs no keys
+            _FuncWalker(self, mod, func, stack, keys, out).check()
+        return out
+
+
+class _FuncWalker:
+    """Guard-tracking walk of one function body."""
+
+    def __init__(self, checker, mod, func, stack, keys, out):
+        self.c, self.mod, self.func = checker, mod, func
+        self.stack, self.keys, self.out = stack, keys, out
+        self.flags: dict[str, set[str]] = {}  # flag name -> implied keys
+        # sentinel implication: local assigned non-None ONLY under
+        # guards G ⇒ `x is not None` implies G (the emit-outside-lock
+        # idiom: swap_detail set under `if self._events is not None:`,
+        # emitted outside the lock behind `if swap_detail is not None:`)
+        self.nonnull: dict[str, set[str]] = {}
+
+    def check(self):
+        self._block(self.func.body, set())
+
+    # -- condition algebra (flag-aware) -------------------------------------
+
+    def _truthy(self, test) -> set[str]:
+        cmp = none_compare(test)
+        if cmp is not None:
+            if not cmp[1]:
+                return set()
+            return {cmp[0]} | self.nonnull.get(cmp[0], set())
+        if isinstance(test, ast.Name) and test.id in self.flags:
+            return set(self.flags[test.id])
+        key = expr_key(test)
+        if key is not None and key in self.keys:
+            return {key}
+        if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.And):
+            g: set[str] = set()
+            for v in test.values:
+                g |= self._truthy(v)
+            return g
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return self._falsy(test.operand)
+        return set()
+
+    def _falsy(self, test) -> set[str]:
+        cmp = none_compare(test)
+        if cmp is not None:
+            return set() if cmp[1] else {cmp[0]}
+        if isinstance(test, ast.BoolOp) and isinstance(test.op, ast.Or):
+            g: set[str] = set()
+            for v in test.values:
+                g |= self._falsy(v)
+            return g
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            return self._truthy(test.operand)
+        return set()
+
+    # -- statements ----------------------------------------------------------
+
+    def _block(self, stmts: list[ast.stmt], guards: set[str]):
+        g = set(guards)
+        for st in stmts:
+            g = self._stmt(st, g)
+
+    def _stmt(self, st: ast.stmt, g: set[str]) -> set[str]:
+        if isinstance(st, ast.If):
+            self._expr(st.test, g)
+            t, f = self._truthy(st.test), self._falsy(st.test)
+            self._block(st.body, g | t)
+            self._block(st.orelse, g | f)
+            if terminates(st.body):
+                g = g | f   # fell through: test was falsy
+            if st.orelse and terminates(st.orelse):
+                g = g | t
+            return g
+        if isinstance(st, ast.Assert):
+            self._expr(st.test, g)
+            return g | self._truthy(st.test)
+        if isinstance(st, ast.While):
+            self._expr(st.test, g)
+            self._block(st.body, g | self._truthy(st.test))
+            self._block(st.orelse, g)
+            return g
+        if isinstance(st, ast.For):
+            self._expr(st.iter, g)
+            self._block(st.body, g)
+            self._block(st.orelse, g)
+            return g
+        if isinstance(st, ast.With):
+            for item in st.items:
+                self._expr(item.context_expr, g)
+            self._block(st.body, g)
+            return g
+        if isinstance(st, ast.Try):
+            self._block(st.body, g)
+            for h in st.handlers:
+                self._block(h.body, g)
+            self._block(st.orelse, g)
+            self._block(st.finalbody, g)
+            return g
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return g  # nested defs are visited as their own functions
+        if isinstance(st, ast.Assign):
+            self._expr(st.value, g)
+            # boolean-flag implication: grew = ev is not None and ...
+            implied = self._truthy(st.value)
+            if implied and len(st.targets) == 1 and isinstance(
+                    st.targets[0], ast.Name):
+                self.flags[st.targets[0].id] = implied
+            # sentinel implication: non-None assignments accumulate the
+            # INTERSECTION of guards they happened under; `= None`
+            # assignments preserve the implication
+            is_none = (isinstance(st.value, ast.Constant)
+                       and st.value.value is None)
+            if not is_none:
+                for t in st.targets:
+                    if isinstance(t, ast.Name):
+                        prev = self.nonnull.get(t.id)
+                        self.nonnull[t.id] = (set(g) if prev is None
+                                              else prev & g)
+            return g
+        for child in ast.iter_child_nodes(st):
+            if isinstance(child, ast.expr):
+                self._expr(child, g)
+        return g
+
+    # -- expressions ---------------------------------------------------------
+
+    def _expr(self, node: ast.expr, g: set[str]):
+        if isinstance(node, ast.BoolOp):
+            cur = set(g)
+            for v in node.values:
+                self._expr(v, cur)
+                cur |= (self._truthy(v) if isinstance(node.op, ast.And)
+                        else self._falsy(v))
+            return
+        if isinstance(node, ast.IfExp):
+            self._expr(node.test, g)
+            self._expr(node.body, g | self._truthy(node.test))
+            self._expr(node.orelse, g | self._falsy(node.test))
+            return
+        if isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute):
+                key = expr_key(f.value)
+                if key is not None and key in self.keys and key not in g:
+                    self.out.append(self.c.finding(
+                        self.mod, node, self.stack,
+                        f"ungated call on None-default obs object "
+                        f"`{key}` — wrap in `if {key} is not None:` "
+                        f"(the zero-cost pin contract)"))
+                if (isinstance(f.value, ast.Call)
+                        and isinstance(f.value.func,
+                                       (ast.Name, ast.Attribute))
+                        and (f.value.func.id
+                             if isinstance(f.value.func, ast.Name)
+                             else f.value.func.attr) in NONE_GETTERS):
+                    self.out.append(self.c.finding(
+                        self.mod, node, self.stack,
+                        "call on a None-default getter result without "
+                        "binding + gating it first"))
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child, g)
+            elif isinstance(child, (ast.keyword, ast.comprehension)):
+                for sub in ast.iter_child_nodes(child):
+                    if isinstance(sub, ast.expr):
+                        self._expr(sub, g)

@@ -1,0 +1,60 @@
+"""sharding-funnel: Partitioner is the ONLY constructor of shardings.
+
+Incident this descends from (CHANGES.md PR 7): before the unified
+Partitioner, ``dsgd_mesh``/``als_mesh``/``serving`` each hand-rolled
+``NamedSharding``s against a private 1D ring, and every layout decision
+had to be re-audited at every site. PR 7 funneled construction through
+``parallel/partitioner.py``'s one rules table; this rule keeps it
+funneled — a ``NamedSharding``/``PositionalSharding``/``Mesh``
+constructed anywhere else is a layout decision escaping the audited
+surface (and, on a multi-process pod, a collective the other processes
+may never join — the measured PR 12 hang).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.astutil import call_name
+from tools.graftlint.core import Checker, Finding, ModuleInfo, Project
+
+SHARDING_CTORS = ("NamedSharding", "PositionalSharding", "Mesh")
+
+# the one audited surface (rules table + raw_sharding escape hatch)
+ALLOWED_SUFFIXES = ("parallel/partitioner.py",)
+
+
+class ShardingFunnelChecker(Checker):
+    name = "sharding-funnel"
+    description = ("no NamedSharding/PositionalSharding/Mesh "
+                   "construction outside parallel/partitioner.py")
+
+    def run(self, project: Project) -> list[Finding]:
+        out: list[Finding] = []
+        for mod in project.modules:
+            if mod.rel.endswith(ALLOWED_SUFFIXES):
+                continue
+            out.extend(self._check_module(mod))
+        return out
+
+    def _check_module(self, mod: ModuleInfo) -> list[Finding]:
+        out: list[Finding] = []
+
+        def visit(node, stack):
+            for child in ast.iter_child_nodes(node):
+                child_stack = (stack + [child] if isinstance(
+                    child, (ast.ClassDef, ast.FunctionDef,
+                            ast.AsyncFunctionDef)) else stack)
+                if (isinstance(child, ast.Call)
+                        and call_name(child) in SHARDING_CTORS):
+                    out.append(self.finding(
+                        mod, child, stack,
+                        f"{call_name(child)} constructed outside the "
+                        f"Partitioner funnel — route through "
+                        f"parallel/partitioner.py (rules-table "
+                        f"sharding(), replicated(), raw_sharding(), or "
+                        f"the mesh factories)"))
+                visit(child, child_stack)
+
+        visit(mod.tree, [])
+        return out

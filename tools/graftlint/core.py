@@ -1,0 +1,350 @@
+"""graftlint core: module parsing, the checker plugin contract, inline
+suppressions, and the committed-baseline workflow.
+
+Design constraints (why this looks the way it does):
+
+- **No package import.** Checkers reason about source text only; a
+  syntax-valid file that cannot import (missing accelerator deps,
+  gated backends) must still lint. Everything is stdlib ``ast``.
+- **Stable fingerprints.** Baseline entries must survive unrelated line
+  drift, so a finding's identity is ``(rule, path, symbol, line_text)``
+  — the enclosing def/class qualname plus the stripped source line —
+  never a line number.
+- **Suppression is visible at the site.** ``# graftlint: disable=rule``
+  on the flagged line (or the line directly above it) is the only
+  inline escape hatch; grandfathered debt goes in the baseline file,
+  where ``--strict`` requires every entry to carry a justifying
+  ``reason`` string.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Iterable
+
+REPO_ROOT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+# the default scan target: the production package the invariants govern
+DEFAULT_PATHS = ("large_scale_recommendation_tpu",)
+
+DEFAULT_BASELINE = os.path.join("tools", "graftlint", "baseline.json")
+
+_SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One rule violation at one site."""
+
+    rule: str
+    path: str        # repo-relative, forward slashes
+    line: int        # 1-based
+    symbol: str      # enclosing qualname ("Class.method" / "<module>")
+    message: str
+    line_text: str = ""
+
+    def key(self) -> tuple[str, str, str, str]:
+        """Baseline identity: line numbers drift, these don't."""
+        return (self.rule, self.path, self.symbol, self.line_text.strip())
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    """One parsed source file."""
+
+    path: str               # absolute
+    rel: str                # repo-relative, forward slashes
+    tree: ast.AST
+    lines: list[str]        # source lines, index 0 = line 1
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+class Project:
+    """All parsed modules of one lint run; shared by every checker so
+    the whole-program checkers (lock-order, host-sync reachability) see
+    one consistent snapshot parsed exactly once."""
+
+    def __init__(self, modules: list[ModuleInfo]):
+        self.modules = modules
+
+    @classmethod
+    def load(cls, paths: Iterable[str], repo_root: str = REPO_ROOT,
+             ) -> tuple["Project", list[str]]:
+        """Parse every ``.py`` under ``paths`` (files or directories).
+        Returns (project, parse_errors) — an unparseable file is an
+        error string, never a crash (the linter must not be the first
+        thing a broken tree kills)."""
+        files: list[str] = []
+        errors: list[str] = []
+        for p in paths:
+            if os.path.isabs(p):
+                absp = p
+            else:
+                # relative paths resolve against the caller's cwd
+                # first, then repo root (so both `graftlint mod.py`
+                # from anywhere and the bare default package path work)
+                cand_cwd = os.path.abspath(p)
+                cand_root = os.path.join(repo_root, p)
+                absp = (cand_cwd if os.path.exists(cand_cwd)
+                        else cand_root)
+            if os.path.isfile(absp):
+                files.append(absp)
+            elif os.path.isdir(absp):
+                for dirpath, dirnames, filenames in os.walk(absp):
+                    dirnames[:] = [d for d in dirnames
+                                   if d != "__pycache__"]
+                    files.extend(os.path.join(dirpath, f)
+                                 for f in filenames if f.endswith(".py"))
+            else:
+                # a typo'd or renamed path must FAIL the strict gate,
+                # never silently scan zero files and pass vacuously
+                tried = (absp if os.path.isabs(p)
+                         else f"{cand_cwd} or {cand_root}")
+                errors.append(f"{p}: path not found (tried {tried})")
+        if not files and not errors:
+            errors.append(
+                f"no python files found under {list(paths)}")
+        modules = []
+        for f in sorted(files):
+            try:
+                with open(f, encoding="utf-8") as fh:
+                    src = fh.read()
+                tree = ast.parse(src, filename=f)
+            except (OSError, SyntaxError) as e:
+                errors.append(f"{f}: {e}")
+                continue
+            rel = os.path.relpath(f, repo_root).replace(os.sep, "/")
+            modules.append(ModuleInfo(path=f, rel=rel, tree=tree,
+                                      lines=src.splitlines()))
+        return cls(modules), errors
+
+
+class Checker:
+    """The plugin contract: subclass, set ``name``, implement ``run``.
+
+    ``run`` sees the whole project and returns raw findings; core
+    applies suppressions and the baseline afterwards, so checkers stay
+    pure detection logic."""
+
+    name: str = ""
+    description: str = ""
+
+    def run(self, project: Project) -> list[Finding]:
+        raise NotImplementedError
+
+    # -- shared helpers -----------------------------------------------------
+
+    @staticmethod
+    def qualname(stack: list[ast.AST]) -> str:
+        parts = [n.name for n in stack
+                 if isinstance(n, (ast.ClassDef, ast.FunctionDef,
+                                   ast.AsyncFunctionDef))]
+        return ".".join(parts) if parts else "<module>"
+
+    def finding(self, mod: ModuleInfo, node: ast.AST,
+                stack: list[ast.AST], message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(rule=self.name, path=mod.rel, line=line,
+                       symbol=self.qualname(stack), message=message,
+                       line_text=mod.line_text(line))
+
+
+def is_suppressed(finding: Finding, mod_by_rel: dict[str, ModuleInfo],
+                  ) -> bool:
+    """``# graftlint: disable=<rule>[,rule...]`` on the flagged line or
+    anywhere in the contiguous comment block directly above it (``all``
+    disables every rule) — a multi-line justification comment counts
+    wherever the marker sits in it."""
+    mod = mod_by_rel.get(finding.path)
+    if mod is None:
+        return False
+
+    def match(lineno: int) -> bool:
+        m = _SUPPRESS_RE.search(mod.line_text(lineno))
+        if m:
+            rules = {r.strip() for r in m.group(1).split(",")}
+            return finding.rule in rules or "all" in rules
+        return False
+
+    if match(finding.line):
+        return True
+    lineno = finding.line - 1
+    while lineno >= 1 and mod.line_text(lineno).strip().startswith("#"):
+        if match(lineno):
+            return True
+        lineno -= 1
+    return False
+
+
+# -- baseline ----------------------------------------------------------------
+
+def load_baseline(path: str) -> tuple[list[dict], list[str]]:
+    """Returns (entries, errors). Errors: unreadable file, entries
+    missing the required keys, entries without a justifying reason —
+    the last is what ``--strict`` refuses (a grandfathered finding with
+    no recorded why is just debt hiding)."""
+    if not path or not os.path.exists(path):
+        return [], []
+    try:
+        with open(path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError) as e:
+        return [], [f"baseline unreadable: {e}"]
+    entries = doc.get("entries", [])
+    errors = []
+    for i, e in enumerate(entries):
+        missing = [k for k in ("rule", "path", "symbol", "line_text")
+                   if k not in e]
+        if missing:
+            errors.append(f"baseline entry {i} missing {missing}")
+        reason = str(e.get("reason", "")).strip()
+        if not reason or reason.lower().startswith("todo"):
+            # the --write-baseline TODO seed must not satisfy the gate:
+            # debt may be carried, but never with a placeholder reason
+            errors.append(
+                f"baseline entry {i} ({e.get('rule')}:{e.get('path')}:"
+                f"{e.get('symbol')}) has no justifying reason")
+    return entries, errors
+
+
+def write_baseline(path: str, findings: list[Finding],
+                   rules_run: list[str] | None = None,
+                   scanned_paths: list[str] | None = None) -> None:
+    """Regenerate the baseline from this run's findings WITHOUT losing
+    anything the run could not see: entries already present keep their
+    curated reasons, and entries outside this run's scope (a rule that
+    didn't run, a file that wasn't scanned — ``--rules``/path-subset
+    invocations) are retained verbatim. Only genuinely NEW entries get
+    the TODO seed, which ``--strict`` refuses until replaced with a
+    real justification."""
+    prev, _ = load_baseline(path)
+    prev_reasons = {
+        (e.get("rule"), e.get("path"), e.get("symbol"),
+         str(e.get("line_text", "")).strip()): str(e.get("reason", ""))
+        for e in prev}
+    entries = [{"rule": f.rule, "path": f.path, "symbol": f.symbol,
+                "line_text": f.line_text.strip(),
+                "reason": (prev_reasons.get(f.key(), "").strip()
+                           or "TODO: justify this grandfathered finding")}
+               for f in findings]
+    new_keys = {f.key() for f in findings}
+    for e in prev:  # out-of-scope entries survive a subset regeneration
+        key = (e.get("rule"), e.get("path"), e.get("symbol"),
+               str(e.get("line_text", "")).strip())
+        if key in new_keys:
+            continue
+        out_of_scope = (
+            (rules_run is not None and e.get("rule") not in rules_run)
+            or (scanned_paths is not None
+                and e.get("path") not in scanned_paths))
+        if out_of_scope:
+            entries.append(e)
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"version": 1, "entries": entries}, fh, indent=2)
+        fh.write("\n")
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: list[Finding]          # unsuppressed, unbaselined: the verdict
+    suppressed: list[Finding]        # inline-disabled sites
+    baselined: list[Finding]         # grandfathered by the baseline file
+    baseline_errors: list[str]       # reason-less / malformed entries
+    baseline_stale: list[dict]       # entries matching nothing anymore
+    parse_errors: list[str]
+    files_scanned: int
+    rules_run: list[str]
+    scanned_paths: list[str]    # repo-relative files this run looked at
+
+    def per_rule(self) -> dict[str, int]:
+        counts: dict[str, int] = {r: 0 for r in self.rules_run}
+        for f in self.findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        return counts
+
+    def to_dict(self) -> dict:
+        return {
+            "lint_findings_total": len(self.findings),
+            "per_rule": self.per_rule(),
+            "suppressed": len(self.suppressed),
+            "baselined": len(self.baselined),
+            "baseline_errors": self.baseline_errors,
+            "baseline_stale": self.baseline_stale,
+            "parse_errors": self.parse_errors,
+            "files_scanned": self.files_scanned,
+            "rules_run": self.rules_run,
+            "findings": [f.to_dict() for f in self.findings],
+            "baselined_findings": [f.to_dict() for f in self.baselined],
+        }
+
+
+def run_lint(paths: Iterable[str] | None = None,
+             rules: Iterable[str] | None = None,
+             disable: Iterable[str] = (),
+             baseline_path: str | None = DEFAULT_BASELINE,
+             repo_root: str = REPO_ROOT) -> LintResult:
+    """Parse, check, suppress, baseline — the one programmatic entry
+    the runner, the conftest stamping hook, and the tests all share."""
+    from tools.graftlint.checkers import ALL_CHECKERS
+
+    selected = dict(ALL_CHECKERS)
+    if rules is not None:
+        unknown = set(rules) - set(selected)
+        if unknown:
+            raise ValueError(f"unknown rules: {sorted(unknown)} "
+                             f"(have {sorted(selected)})")
+        selected = {r: selected[r] for r in rules}
+    for r in disable:
+        selected.pop(r, None)
+
+    project, parse_errors = Project.load(paths or DEFAULT_PATHS,
+                                         repo_root=repo_root)
+    mod_by_rel = {m.rel: m for m in project.modules}
+
+    raw: list[Finding] = []
+    for name in sorted(selected):
+        raw.extend(selected[name]().run(project))
+    raw.sort(key=lambda f: (f.path, f.line, f.rule))
+
+    suppressed: list[Finding] = []
+    remaining: list[Finding] = []
+    for f in raw:
+        (suppressed if is_suppressed(f, mod_by_rel)
+         else remaining).append(f)
+
+    if baseline_path and not os.path.isabs(baseline_path):
+        baseline_path = os.path.join(repo_root, baseline_path)
+    entries, baseline_errors = load_baseline(baseline_path or "")
+    entry_keys = {(e.get("rule"), e.get("path"), e.get("symbol"),
+                   str(e.get("line_text", "")).strip()) for e in entries}
+    baselined = [f for f in remaining if f.key() in entry_keys]
+    findings = [f for f in remaining if f.key() not in entry_keys]
+    live_keys = {f.key() for f in remaining}
+    # stale = matched nothing, judged ONLY for entries whose rule ran
+    # AND whose file was actually scanned — a path-subset run must not
+    # advise deleting entries it never looked at
+    stale = [e for e in entries
+             if (e.get("rule"), e.get("path"), e.get("symbol"),
+                 str(e.get("line_text", "")).strip()) not in live_keys
+             and e.get("rule") in selected
+             and e.get("path") in mod_by_rel]
+
+    return LintResult(findings=findings, suppressed=suppressed,
+                      baselined=baselined,
+                      baseline_errors=baseline_errors,
+                      baseline_stale=stale, parse_errors=parse_errors,
+                      files_scanned=len(project.modules),
+                      rules_run=sorted(selected),
+                      scanned_paths=sorted(mod_by_rel))
