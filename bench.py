@@ -442,10 +442,13 @@ def run_child() -> None:
     # transactions per rating; the pallas path streams each factor row
     # through VMEM once per stratum (contiguous) plus the COO streams.
     # bf16 factor storage halves the factor term on both.
+    # model_size=1: the headline bench is a single-chip run — factor rows
+    # are full-rank and no 'model'-axis collective traffic exists (the
+    # rank-sharded terms are priced in scripts/pod_dryrun.py's 2-D pass)
     bytes_per_sweep = sgd_ops.dsgd_bytes_per_sweep(
         train_nnz, rank, kernel=bench_kernel, num_blocks=blocks,
         rows_u=int(U.shape[0]), rows_v=int(V.shape[0]),
-        factor_bytes=jnp.dtype(bench_fdtype).itemsize)
+        factor_bytes=jnp.dtype(bench_fdtype).itemsize, model_size=1)
     # FLOP model via the shared hand model (ops.sgd.dsgd_flops_per_sweep
     # — the same one the /rooflinez cross-check column prices against)
     flops_per_rating = sgd_ops.dsgd_flops_per_sweep(1, rank)
@@ -612,7 +615,8 @@ def _extra_lines(extra: dict, rank: int, jax, h2d_mbps: float,
                 kern = "pallas" if label.startswith("pallas") else "xla"
                 bpv = sgd_ops.dsgd_bytes_per_sweep(
                     e_probe, pr, kernel=kern, num_blocks=1,
-                    rows_u=p_rpb_u, rows_v=p_rpb_v, factor_bytes=4)
+                    rows_u=p_rpb_u, rows_v=p_rpb_v, factor_bytes=4,
+                    model_size=1)
                 return round(ratings_per_s / e_probe * bpv / 1e9, 1)
 
             for label, val in pv.items():

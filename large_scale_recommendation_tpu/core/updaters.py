@@ -221,6 +221,7 @@ class FactorUpdater(Protocol):
         omega_u: jax.Array | None = None,
         omega_v: jax.Array | None = None,
         t: jax.Array | int = 1,
+        pred: jax.Array | None = None,
     ) -> tuple[jax.Array, jax.Array]: ...
 
 
@@ -232,9 +233,17 @@ def _scalar_lr(schedule, base_lr: float, t: int) -> float:
     return float(schedule(jnp.float32(base_lr), jnp.float32(t)))
 
 
-def _errors(ratings: jax.Array, u: jax.Array, v: jax.Array) -> jax.Array:
+def _errors(ratings: jax.Array, u: jax.Array, v: jax.Array,
+            pred: jax.Array | None = None) -> jax.Array:
     """e = r − u·v, batched. ≙ the ddot in FactorUpdater.scala:42 /
-    DSGDforMF.scala:405, as one einsum on the VPU/MXU."""
+    DSGDforMF.scala:405, as one einsum on the VPU/MXU.
+
+    ``pred`` overrides the local dot with a caller-supplied prediction —
+    the rank-sharded mesh kernels hold only a rank slice of u/v, so the
+    full dot is a ``psum`` over the ``'model'`` axis that must happen
+    OUTSIDE the updater (ops.sgd.sgd_minibatch_update computes it)."""
+    if pred is not None:
+        return ratings - pred
     return ratings - jnp.einsum("bk,bk->b", u, v)
 
 
@@ -245,9 +254,10 @@ class SGDUpdater:
     learning_rate: float = 0.01
     schedule: LearningRateSchedule = staticmethod(constant_lr)
 
-    def delta(self, ratings, u, v, *, weights=None, omega_u=None, omega_v=None, t=1):
+    def delta(self, ratings, u, v, *, weights=None, omega_u=None, omega_v=None,
+              t=1, pred=None):
         del omega_u, omega_v
-        e = _errors(ratings, u, v)
+        e = _errors(ratings, u, v, pred)
         if weights is not None:
             e = e * weights
         lr = self.schedule(jnp.float32(self.learning_rate), t)
@@ -286,8 +296,9 @@ class RegularizedSGDUpdater:
     lambda_: float = 1.0
     schedule: LearningRateSchedule = staticmethod(inverse_sqrt_lr)
 
-    def delta(self, ratings, u, v, *, weights=None, omega_u=None, omega_v=None, t=1):
-        e = _errors(ratings, u, v)
+    def delta(self, ratings, u, v, *, weights=None, omega_u=None, omega_v=None,
+              t=1, pred=None):
+        e = _errors(ratings, u, v, pred)
         if weights is not None:
             e = e * weights
         lr = self.schedule(jnp.float32(self.learning_rate), t)
@@ -325,8 +336,9 @@ class MockFactorUpdater:
     replicate reference bugs).
     """
 
-    def delta(self, ratings, u, v, *, weights=None, omega_u=None, omega_v=None, t=1):
-        del ratings, weights, omega_u, omega_v, t
+    def delta(self, ratings, u, v, *, weights=None, omega_u=None, omega_v=None,
+              t=1, pred=None):
+        del ratings, weights, omega_u, omega_v, t, pred
         return jnp.zeros_like(u), jnp.zeros_like(v)
 
     def next_factors(self, ratings, u, v, *, weights=None, omega_u=None,

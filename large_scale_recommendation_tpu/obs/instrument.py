@@ -79,7 +79,9 @@ class TrainSegmentTimer:
 
     def finish(self, units_per_iteration: int | float | None,
                bytes_per_iteration: int | float | None = None,
-               flops_per_iteration: int | float | None = None) -> None:
+               flops_per_iteration: int | float | None = None,
+               collective_bytes_per_iteration: int | float | None = None,
+               ) -> None:
         """Publish throughput gauges: ``phase="all"`` over every segment,
         ``phase="steady"`` excluding the first (compile-carrying) one —
         only when at least two segments ran, so a single-segment fit
@@ -95,10 +97,15 @@ class TrainSegmentTimer:
         (``ops.sgd.dsgd_flops_per_sweep``) — is also registered against
         this run's compile key, so the live roofline table
         (``/rooflinez``) carries the XLA-vs-model cross-check column
-        (ISSUE 9)."""
+        (ISSUE 9). ``collective_bytes_per_iteration``
+        (``ops.sgd.dsgd_collective_bytes_per_sweep``) is the
+        rank-sharded kernels' interconnect term — registered as its OWN
+        roofline key so HBM and wire traffic price separately
+        (ISSUE 16)."""
         if not self._on or not self._walls or not units_per_iteration:
             return
-        if bytes_per_iteration or flops_per_iteration:
+        if (bytes_per_iteration or flops_per_iteration
+                or collective_bytes_per_iteration):
             from large_scale_recommendation_tpu.obs.introspect import (
                 get_introspector,
             )
@@ -107,7 +114,9 @@ class TrainSegmentTimer:
             if introspector is not None:
                 introspector.register_model_cost(
                     self._key, bytes_per_iteration=bytes_per_iteration,
-                    flops_per_iteration=flops_per_iteration)
+                    flops_per_iteration=flops_per_iteration,
+                    collective_bytes_per_iteration=(
+                        collective_bytes_per_iteration))
 
         def rate(walls, units):
             iters = sum(i for i, _ in walls)

@@ -305,12 +305,18 @@ class Introspector:
     def register_model_cost(self, key: Any,
                             bytes_per_iteration: float | None = None,
                             flops_per_iteration: float | None = None,
+                            collective_bytes_per_iteration: float | None
+                            = None,
                             ) -> None:
         """Attach the HAND cost model for one compile key (bytes/flops
         one iteration — one sweep — moves), the reference the roofline
         cross-checks XLA's bytes-accessed against.
         ``TrainSegmentTimer.finish`` calls this with
-        ``ops.sgd.dsgd_bytes_per_sweep`` / ``dsgd_flops_per_sweep``."""
+        ``ops.sgd.dsgd_bytes_per_sweep`` / ``dsgd_flops_per_sweep``.
+        ``collective_bytes_per_iteration``
+        (``dsgd_collective_bytes_per_sweep``) is the rank-sharded
+        kernels' per-device interconnect traffic — kept as its own term
+        so the roofline prices HBM and wire separately (ISSUE 16)."""
         rendered = render_key(key)
         with self._lock:
             mc = self._model_costs.setdefault(rendered, {})
@@ -318,6 +324,9 @@ class Introspector:
                 mc["bytes_per_iteration"] = float(bytes_per_iteration)
             if flops_per_iteration:
                 mc["flops_per_iteration"] = float(flops_per_iteration)
+            if collective_bytes_per_iteration:
+                mc["collective_bytes_per_iteration"] = float(
+                    collective_bytes_per_iteration)
 
     def model_costs(self) -> dict:
         with self._lock:
@@ -494,6 +503,10 @@ def roofline_rows(records: list[dict], walls: dict, model_costs: dict,
     - ``achieved_tflops`` / ``pct_of_fp32_peak`` likewise from flops
     - ``xla_vs_model_bytes`` = bytes_accessed / (model bytes ×
       iterations-per-execution) — the hand-model cross-check
+    - ``model_collective_bytes_per_exec`` = registered collective bytes ×
+      iterations-per-execution — the rank-sharded kernels' interconnect
+      term, its OWN key so wire traffic never hides inside the HBM
+      number (None for replicated kernels)
     """
     by_key: dict[str, list[dict]] = {}
     for rec in records:
@@ -522,6 +535,7 @@ def roofline_rows(records: list[dict], walls: dict, model_costs: dict,
             "pct_of_fp32_peak": None,
             "model_bytes_per_exec": None,
             "xla_vs_model_bytes": None,
+            "model_collective_bytes_per_exec": None,
         }
         if n_exec > 0:
             wall = w["execute_total_s"] / n_exec
@@ -541,6 +555,9 @@ def roofline_rows(records: list[dict], walls: dict, model_costs: dict,
                 if model_bytes > 0:
                     row["xla_vs_model_bytes"] = (
                         dom["bytes_accessed"] / model_bytes)
+            if mc and mc.get("collective_bytes_per_iteration"):
+                row["model_collective_bytes_per_exec"] = (
+                    mc["collective_bytes_per_iteration"] * iters_per_exec)
         rows.append(row)
     return rows
 

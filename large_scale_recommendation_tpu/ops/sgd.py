@@ -29,8 +29,9 @@ import jax.numpy as jnp
 
 def dsgd_bytes_per_sweep(nnz: int, rank: int, *, kernel: str = "xla",
                          num_blocks: int = 1, rows_u: int = 0,
-                         rows_v: int = 0, factor_bytes: int = 4) -> int:
-    """Bytes of HBM traffic one full DSGD sweep moves, per kernel.
+                         rows_v: int = 0, factor_bytes: int = 4,
+                         model_size: int = 1) -> int:
+    """Bytes of HBM traffic one full DSGD sweep moves PER DEVICE, per kernel.
 
     The shared roofline model behind every ``effective_hbm_gbs`` number
     (bench.py headline, the probe variants, and the ``train_hbm_gbs``
@@ -45,14 +46,51 @@ def dsgd_bytes_per_sweep(nnz: int, rank: int, *, kernel: str = "xla",
       once per sweep (k² block visits × rows-per-block), plus the
       per-entry streams (2 int32 rows + 6 f32
       vals/w/icu/icv/ωu/ωv ⇒ 32 B/rating).
+
+    ``model_size`` is the size of the ``'model'`` mesh axis: rank-sharded
+    tables hold ``rank/model_size`` columns per device, so the factor-row
+    term divides by it (the COO stream is replicated across the model
+    axis and does NOT divide). The extra wire traffic the reduction
+    collectives move is a SEPARATE term — see
+    ``dsgd_collective_bytes_per_sweep`` — so the roofline can show HBM
+    and interconnect as distinct costs. The pallas kernel has no
+    rank-sharded variant (it stages full rows through VMEM), so
+    ``model_size > 1`` there is a modeling error, not a silent division.
     """
+    if model_size < 1 or rank % model_size:
+        raise ValueError(
+            f"model_size {model_size} must be ≥1 and divide rank {rank}")
     if kernel == "pallas":
+        if model_size != 1:
+            raise ValueError(
+                "pallas kernel has no rank-sharded traffic model "
+                "(model_size must be 1)")
         if not rows_u or not rows_v:
             raise ValueError(
                 "pallas traffic model needs rows_u/rows_v (table heights)")
         factor = num_blocks * (rows_u + rows_v) * rank * factor_bytes * 2
         return int(factor + nnz * 32)
-    return int(nnz * (4 * rank * factor_bytes + 16))
+    return int(nnz * (4 * (rank // model_size) * factor_bytes + 16))
+
+
+def dsgd_collective_bytes_per_sweep(nnz: int, rank: int,
+                                    model_size: int = 1) -> int:
+    """Interconnect bytes one DSGD sweep moves per device for the
+    rank-reduction collectives, ring all-reduce model.
+
+    The rank-sharded kernel ``psum``s ONE f32 prediction per rating over
+    the ``'model'`` axis (the ``u·v`` dot); a ring all-reduce of m
+    participants moves ``2·(m−1)/m`` bytes per reduced byte per device
+    (reduce-scatter + all-gather). model_size=1 ⇒ 0 — the replicated
+    path pays no collective. Kept SEPARATE from
+    ``dsgd_bytes_per_sweep`` so ``/rooflinez`` prices HBM and wire as
+    their own terms (``rank`` is accepted for signature symmetry and
+    future per-element generalizations; the pred reduction is
+    rank-independent)."""
+    del rank
+    if model_size <= 1:
+        return 0
+    return int(nnz * 4 * 2 * (model_size - 1) / model_size)
 
 
 def dsgd_flops_per_sweep(nnz: int, rank: int) -> int:
@@ -79,6 +117,7 @@ def sgd_minibatch_update(
     collision: str = "mean",
     inv_cu: jax.Array | None = None,
     inv_cv: jax.Array | None = None,
+    pred_axis: str | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """One minibatch: gather → delta → scatter-add.
 
@@ -102,6 +141,14 @@ def sgd_minibatch_update(
 
     With ``minibatch=1`` both modes recover the reference's exact sequential
     per-rating semantics.
+
+    ``pred_axis`` names the mesh axis U/V are rank-sharded over (the
+    ``'model'`` axis inside a shard_map): each device then holds only
+    ``rank/m`` columns, the local einsum is a PARTIAL dot, and the full
+    prediction is its ``psum`` over that axis — handed to the updater as
+    ``pred=`` so the error term uses the full-rank dot while every other
+    operation (deltas, collision scaling, scatter-add) stays purely
+    row-space and therefore correct on the rank slice unchanged.
     """
     if collision not in ("mean", "sum"):
         raise ValueError(
@@ -109,6 +156,9 @@ def sgd_minibatch_update(
         )
     u = U[u_rows]
     v = V[i_rows]
+    pred = None
+    if pred_axis is not None:
+        pred = jax.lax.psum(jnp.einsum("bk,bk->b", u, v), pred_axis)
     du, dv = updater.delta(
         values,
         u,
@@ -117,6 +167,7 @@ def sgd_minibatch_update(
         omega_u=None if omega_u is None else omega_u[u_rows],
         omega_v=None if omega_v is None else omega_v[i_rows],
         t=t,
+        **({} if pred is None else {"pred": pred}),
     )
     if collision == "mean":
         if inv_cu is not None:
@@ -147,9 +198,10 @@ def sgd_block_sweep(
     collision: str = "mean",
     inv_cu: jax.Array | None = None,
     inv_cv: jax.Array | None = None,
+    pred_axis: str | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Sweep one rating block (or one whole stratum flattened) in minibatch
-    chunks via ``lax.scan``.
+    chunks via ``lax.scan``. ``pred_axis`` — see ``sgd_minibatch_update``.
 
     ≙ ``updateLocalFactors`` visiting every rating of the block once
     (DSGDforMF.scala:392-418). Chunk order is the deterministic blocked order
@@ -171,7 +223,7 @@ def sgd_block_sweep(
         icu, icv = (xs[4], xs[5]) if pre else (None, None)
         U, V = sgd_minibatch_update(
             U, V, ur, ir, vals, w, omega_u, omega_v, updater, t, collision,
-            icu, icv,
+            icu, icv, pred_axis,
         )
         return (U, V), None
 

@@ -83,16 +83,28 @@ def _build_mesh_als_step(
     implicit: bool,
     gram_dtype,
 ):
-    part.require_no_model_parallel("mesh ALS")
     axis = part.data_axis
     spec = part.spec("ratings")
+    rank_sharded = part.model_parallel > 1
+    model_axis = part.model_axis if rank_sharded else None
+    m = part.model_parallel
     n_arrays = 4 + 4 * (n_user_buckets + n_item_buckets)
+    if rank_sharded:
+        factor_in = (part.spec("users", "rank"), part.spec("items", "rank"))
+    else:
+        # keep the historical dim-0 specs at model=1 — equivalent layout,
+        # distinct cache key (see dsgd_mesh)
+        factor_in = (spec, spec)
 
     @partial(
         shard_map,
         mesh=part.mesh,
-        in_specs=(spec,) * n_arrays,
-        out_specs=(spec, spec),
+        in_specs=factor_in + (spec,) * (n_arrays - 2),
+        out_specs=factor_in,
+        # rank-sharded kernels slice by lax.axis_index over 'model',
+        # which the replication checker cannot statically type across the
+        # scan carry — the model-parity tests pin correctness instead
+        **({"check_vma": False} if rank_sharded else {}),
     )
     def run(U_l, V_l, ou_l, ov_l, *bucket_arrays):
         # drop the leading sharded dim of the per-device plan arrays
@@ -109,14 +121,33 @@ def _build_mesh_als_step(
         def varying_zeros(shape):
             # fresh accumulators marked device-varying so the VMA check can
             # verify the per-shard writes into them (older jax has no VMA
-            # type system — nothing to annotate, the zeros pass through)
+            # type system — nothing to annotate, the zeros pass through;
+            # the rank-sharded route runs with the checker off, so the
+            # annotation is skipped there too)
             z = jnp.zeros(shape, jnp.float32)
             pcast = getattr(jax.lax, "pcast", None)
-            return pcast(z, axis, to="varying") if pcast else z
+            return (pcast(z, axis, to="varying")
+                    if pcast and not rank_sharded else z)
 
         def full_gram(F):
-            # the shared iALS VᵀV term — the gathered table is replicated,
-            # so one [k, k] einsum per shard, no extra collective
+            # the shared iALS VᵀV term. Replicated tables: one [r, r]
+            # einsum per shard, no extra collective. Rank-sharded meshes
+            # distribute it instead — each model-axis participant grams a
+            # row chunk of the gathered table and the full Gram is the
+            # psum over 'model' (the ISSUE 16 reduction collective; rows
+            # are zero-padded to a multiple of m, and zero rows contribute
+            # exactly nothing to FᵀF, so the only deviation from the
+            # replicated result is fp reduction reordering).
+            if rank_sharded:
+                n = F.shape[0]
+                n_pad = -(-n // m) * m
+                Fp = jnp.pad(F, ((0, n_pad - n), (0, 0)))
+                chunk = n_pad // m
+                Fc = jax.lax.dynamic_slice_in_dim(
+                    Fp, jax.lax.axis_index(model_axis) * chunk, chunk, 0)
+                G = jnp.einsum("nk,nl->kl", Fc, Fc,
+                               preferred_element_type=jnp.float32)
+                return jax.lax.psum(G, model_axis)
             return jnp.einsum("nk,nl->kl", F, F,
                               preferred_element_type=jnp.float32)
 
@@ -129,18 +160,38 @@ def _build_mesh_als_step(
         cast = (lambda x: x.astype(gram_dtype)) if pre_cast else (lambda x: x)
         local_dtype = None if pre_cast else gram_dtype
 
+        def gather_full(F_l):
+            # rank-sharded shards gather the 'model' axis back to full
+            # width FIRST (rank slices are contiguous column ranges, so
+            # the tiled axis=1 concat reassembles the exact replicated
+            # table — bit-identical, no reduction), then ride the
+            # existing data-axis gather. The Cholesky solve needs the
+            # full-rank Gram; the memory win is the table AT REST.
+            if rank_sharded:
+                F_l = jax.lax.all_gather(F_l, model_axis, axis=1, tiled=True)
+            return jax.lax.all_gather(F_l, axis, tiled=True)
+
+        def keep_rank_slice(F_lf):
+            # back to this shard's rank slice: device j on the model axis
+            # owns columns [j·r/m, (j+1)·r/m)
+            if not rank_sharded:
+                return F_lf
+            r_loc = F_lf.shape[1] // m
+            return jax.lax.dynamic_slice_in_dim(
+                F_lf, jax.lax.axis_index(model_axis) * r_loc, r_loc, 1)
+
         def round_(carry, _):
             U_l, V_l = carry
-            V_full = jax.lax.all_gather(cast(V_l), axis, tiled=True)
+            V_full = gather_full(cast(V_l))
             Gv = full_gram(V_full) if implicit else None
-            U_l = als_ops.solve_side_local(V_full, ub, nu_l, lam, scale_u,
-                                           varying_zeros, Gv,
-                                           dtype=local_dtype)
-            U_full = jax.lax.all_gather(cast(U_l), axis, tiled=True)
+            U_l = keep_rank_slice(als_ops.solve_side_local(
+                V_full, ub, nu_l, lam, scale_u, varying_zeros, Gv,
+                dtype=local_dtype))
+            U_full = gather_full(cast(U_l))
             Gu = full_gram(U_full) if implicit else None
-            V_l = als_ops.solve_side_local(U_full, ib, ni_l, lam, scale_v,
-                                           varying_zeros, Gu,
-                                           dtype=local_dtype)
+            V_l = keep_rank_slice(als_ops.solve_side_local(
+                U_full, ib, ni_l, lam, scale_v, varying_zeros, Gu,
+                dtype=local_dtype))
             return (U_l, V_l), None
 
         (U_l, V_l), _ = jax.lax.scan(round_, (U_l, V_l), None,
@@ -174,6 +225,7 @@ class MeshALS:
         if ratings.n == 0:
             raise ValueError("cannot fit on an empty ratings set")
         k = self.num_blocks
+        self.partitioner.require_rank_divisible(cfg.num_factors, "mesh ALS")
 
         ru, ri, rv, rw = ratings.to_numpy()
         real = rw > 0

@@ -114,33 +114,57 @@ def _build_mesh_dsgd_step(
     kernel: str,
     pallas_interpret: bool,
 ):
-    part.require_no_model_parallel("mesh DSGD")
     k = num_blocks
     axis = part.data_axis
     perm = part.ring_backward()
     spec = part.spec("ratings")
+    rank_sharded = part.model_parallel > 1
+    # pred_axis: the mesh axis the SGD prediction dot psums over when
+    # U/V arrive as rank slices (ops.sgd.sgd_minibatch_update). None at
+    # model_parallel == 1 — the traced computation is then IDENTICAL to
+    # the pre-sharding kernel (no collective inserted), which keeps the
+    # replicated goldens bit-exact.
+    pred_axis = part.model_axis if rank_sharded else None
     n_sharded = 10 if with_inv else 8
     if kernel not in ("xla", "pallas"):
         raise ValueError(
             f"unknown kernel {kernel!r}; expected 'xla' or 'pallas'")
     if kernel == "pallas":
+        # The Pallas block kernel stages FULL factor rows through VMEM
+        # (its whole DMA design); there is no rank-sliced variant, so a
+        # >1 model axis must refuse at build time rather than compute on
+        # slices. This is the one reasoned surviving caller of the
+        # escape hatch.
+        part.require_no_model_parallel(  # graftlint: disable=model-guard
+            "mesh DSGD pallas kernel")
         from large_scale_recommendation_tpu.ops.pallas_sgd import (
             validate_pallas_contract,
         )
 
         validate_pallas_contract(updater, collision, with_inv)
+    if rank_sharded:
+        factor_in = (part.spec("users", "rank"), part.spec("items", "rank"))
+    else:
+        # dim-0-only specs at model=1: P('data') and P('data', None)
+        # resolve equivalent layouts but are distinct cache keys — keep
+        # the historical spec so recompiles and goldens are untouched
+        factor_in = (spec, spec)
 
     @partial(
         shard_map,
         mesh=part.mesh,
-        in_specs=(spec,) * n_sharded + (part.spec(),),
-        out_specs=(spec, spec),
+        in_specs=factor_in + (spec,) * (n_sharded - 2) + (part.spec(),),
+        out_specs=factor_in,
         # the replication checker has no rule for pallas_call at all on
         # this jax ("No replication rule for pallas_call" — AOT-measured,
         # docs/MOSAIC_AOT.json), and the Pallas interpreter's internal
         # scan additionally drops varying-axis metadata on index arrays;
-        # the XLA route keeps the checker on
-        check_vma=kernel != "pallas",
+        # the rank-sharded route mixes model-axis-varying factor slices
+        # with model-replicated strata through a psum, whose varying-axis
+        # propagation the checker mis-infers across scan carries — the
+        # model-parity tests pin its correctness instead. The replicated
+        # XLA route keeps the checker on.
+        check_vma=kernel != "pallas" and not rank_sharded,
     )
     def run(U_l, V_l, ru_l, ri_l, rv_l, rw_l, ou_l, ov_l, *rest):
         # shard_map gives [1, k, b] for the device-major strata; drop the
@@ -198,6 +222,7 @@ def _build_mesh_dsgd_step(
                     updater, t, minibatch, collision,
                     None if icu is None else icu[s],
                     None if icv is None else icv[s],
+                    pred_axis,
                 )
             # Rotate the item shard (and its omegas) one step down the ring
             # — ≙ the reference's inter-superstep shuffle of item blocks
@@ -426,6 +451,7 @@ class MeshDSGD:
         fdt = jnp.dtype(cfg.factor_dtype)
         U = jnp.asarray(U).astype(fdt)
         V = jnp.asarray(V).astype(fdt)
+        part.require_rank_divisible(int(np.shape(U)[-1]), "mesh DSGD")
 
         if resume:
             if checkpoint_manager is None:
@@ -477,12 +503,18 @@ class MeshDSGD:
                     done, {"U": U, "V": V},
                     {"kind": kind, "iterations": cfg.iterations},
                 )
+        m = part.model_parallel
         timer.finish(n_ratings, bytes_per_iteration=(
             None if n_ratings is None else sgd_ops.dsgd_bytes_per_sweep(
                 n_ratings, int(np.shape(U)[-1]), kernel=cfg.kernel,
                 num_blocks=k, rows_u=int(np.shape(U)[0]),
-                rows_v=int(np.shape(V)[0]), factor_bytes=fdt.itemsize)),
+                rows_v=int(np.shape(V)[0]), factor_bytes=fdt.itemsize,
+                model_size=m)),
             flops_per_iteration=(
                 None if n_ratings is None else sgd_ops.dsgd_flops_per_sweep(
-                    n_ratings, int(np.shape(U)[-1]))))
+                    n_ratings, int(np.shape(U)[-1]))),
+            collective_bytes_per_iteration=(
+                None if n_ratings is None
+                else sgd_ops.dsgd_collective_bytes_per_sweep(
+                    n_ratings, int(np.shape(U)[-1]), m)))
         return U, V

@@ -16,13 +16,13 @@ This module replaces all of that with the T5X recipe (SNIPPETS.md
 ``('ratings',)`` for stratum/entry layouts — and ONE rules table maps
 logical axes onto the physical ``('data', 'model')`` device mesh:
 
-    logical axis   role     today                               future
-    ------------   ------   ---------------------------------   -------
-    users          data     user rows block-sharded (ring p)    —
-    items          data     item rows block-sharded (rotate)    —
-    ratings        data     stratum dim 0 device-major           —
-    queries        (none)   serving query chunks replicated      data
-    rank           model    UNSHARDED (model axis is size 1)    rank-sharded
+    logical axis   role     today
+    ------------   ------   ------------------------------------------
+    users          data     user rows block-sharded (ring p)
+    items          data     item rows block-sharded (rotate)
+    ratings        data     stratum dim 0 device-major
+    queries        (none)   serving query chunks replicated
+    rank           model    factor columns rank-sharded (model axis ≥ 1)
 
 so training, checkpoint resume and the serving scatter all answer
 "where does this array live?" through the same table, and changing the
@@ -30,11 +30,17 @@ deployment (laptop → one TPU VM → v5e pod slice) changes only the mesh
 underneath the table, never the call sites.
 
 Physical axes: ``data`` is the DSGD stratum ring (the axis ``ppermute``
-rotates item shards around and ``all_gather`` rides); ``model`` is
-reserved for factor-rank sharding (ALX shards the rank dimension too at
-~1B-row scale) and is size 1 today — every helper resolves it so the
-rules table is already pod-shaped, while the training kernels refuse a
->1 model axis until they grow the rank-reduction collectives.
+rotates item shards around and ``all_gather`` rides); ``model`` is the
+factor-rank sharding axis (the ALX recipe: shard the rank dimension too
+at ~1B-row scale). At ``model_parallel > 1`` each device holds a
+``rank/m`` column slice of U and V, and the kernels insert the
+reduction collectives the math needs: the SGD prediction dot and the
+serving score dot ``psum`` their partial contractions over ``'model'``;
+mesh ALS all-gathers rank slices back to full width for the Cholesky
+solve (the Gram is full-rank) and keeps only its own slice of the
+solution. ``model_parallel == 1`` traces the exact pre-sharding
+computation (no collective is inserted), so the replicated goldens
+stay bit-identical.
 
 Multi-host: ``Partitioner.create()`` brings up ``jax.distributed`` via
 ``parallel.distributed.initialize_distributed`` and builds the mesh
@@ -78,7 +84,7 @@ DEFAULT_RULES: tuple[tuple[str, str | None], ...] = (
     ("items", DATA_AXIS),     # V rows: block-sharded, rotates on the ring
     ("ratings", DATA_AXIS),   # stratum layouts [k, ...] / entry streams
     ("queries", None),        # serving query chunks: replicated to shards
-    ("rank", MODEL_AXIS),     # factor columns: reserved (model axis = 1)
+    ("rank", MODEL_AXIS),     # factor columns: rank-sharded over 'model'
 )
 
 
@@ -87,7 +93,7 @@ def make_data_model_mesh(num_devices: int | None = None, devices=None,
     """The physical ``('data', 'model')`` mesh.
 
     ``data`` is the block ring (k = total devices / model_parallel);
-    ``model`` is the reserved rank-sharding axis (default size 1). The
+    ``model`` is the factor-rank sharding axis (default size 1). The
     device pick order matches ``make_block_mesh`` (global ``jax.devices()``
     order, virtual-CPU fallback), so a ring over the same devices rotates
     the same way whichever constructor built it.
@@ -296,14 +302,32 @@ class Partitioner:
     # -- guards ---------------------------------------------------------------
 
     def require_no_model_parallel(self, what: str) -> None:
-        """The training kernels and the serving dot accumulate across the
-        full rank dimension with no cross-model-axis reduction; until they
-        grow one, a >1 model axis would silently compute on rank slices.
-        Refuse loudly at build time instead."""
+        """ESCAPE HATCH, not a blanket guard: the mainline kernels (mesh
+        DSGD, mesh ALS, the serving top-k, the quantized catalog) all
+        insert the rank-reduction collectives and run at model_parallel
+        > 1. A path that accumulates across the full rank dimension with
+        NO cross-model-axis reduction (e.g. the Pallas block kernel,
+        which stages full factor rows through VMEM) must refuse loudly
+        here rather than silently compute on rank slices. Every call
+        site outside this module needs a reasoned inline graftlint
+        suppression — rule ``model-guard`` (tools/graftlint) flags any
+        new unsuppressed caller, the same contract as the
+        ``sharding-funnel`` baseline."""
         if self.model_parallel != 1:
             raise NotImplementedError(
-                f"{what} does not support rank (model-axis) sharding yet; "
+                f"{what} does not support rank (model-axis) sharding; "
                 f"mesh has model_parallel={self.model_parallel}")
+
+    def require_rank_divisible(self, rank: int, what: str) -> None:
+        """Rank-sharded layouts slice factor columns evenly over the
+        ``'model'`` axis; an uneven split would silently drop columns on
+        the last shard. Refuse loudly at build time."""
+        m = self.model_parallel
+        if rank % m:
+            raise ValueError(
+                f"{what}: rank {rank} is not divisible by "
+                f"model_parallel={m}; pick a rank that splits evenly "
+                f"over the 'model' axis")
 
 
 def as_partitioner(mesh_or_partitioner,

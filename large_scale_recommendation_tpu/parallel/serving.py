@@ -159,6 +159,7 @@ def shard_catalog(V, mesh=None, item_mask=None,
     part = as_partitioner(mesh)
     mesh = part.mesh
     cat_dtype = jnp.dtype(dtype or jnp.float32)
+    part.require_rank_divisible(int(V.shape[1]), "shard_catalog")
     n_dev = part.num_blocks
     n_rows = int(V.shape[0])
     rpb = -(-n_rows // n_dev)
@@ -234,9 +235,13 @@ def _mesh_topk_step(mesh: Mesh, k_local: int, k_out: int,
             return cached
 
     part = as_partitioner(mesh)
-    part.require_no_model_parallel("mesh serving")
     axis = part.data_axis
     cat_spec = part.spec("items", "rank")
+    # rank-sharded catalogs: each model-axis participant holds a column
+    # slice of V; the score matmul becomes a PARTIAL contraction psummed
+    # over 'model' before the per-shard top-k (the ISSUE 16 reduction
+    # collective). model_parallel == 1 traces the exact historical kernel.
+    model_axis = part.model_axis if part.model_parallel > 1 else None
 
     @partial(
         shard_map,
@@ -251,10 +256,18 @@ def _mesh_topk_step(mesh: Mesh, k_local: int, k_out: int,
         check_vma=False,
     )
     def step(U_chunk, V_l, item_w_l, excl_rows, excl_cols, excl_w):
-        # locals arrive with the sharded axis already sliced away:
-        # V_l [rpb, r], item_w_l [rpb]
-        scores = jnp.dot(U_chunk, V_l.T,
-                         preferred_element_type=jnp.float32)
+        # locals arrive with the sharded axes already sliced away:
+        # V_l [rpb, r/m], item_w_l [rpb]; U_chunk is replicated full-width
+        if model_axis is not None:
+            r_loc = V_l.shape[1]
+            U_c = jax.lax.dynamic_slice_in_dim(
+                U_chunk, jax.lax.axis_index(model_axis) * r_loc, r_loc, 1)
+            scores = jax.lax.psum(
+                jnp.dot(U_c, V_l.T, preferred_element_type=jnp.float32),
+                model_axis)
+        else:
+            scores = jnp.dot(U_chunk, V_l.T,
+                             preferred_element_type=jnp.float32)
         scores = scores + item_w_l[None, :]
         # exclusions carry GLOBAL item rows; this shard applies the ones
         # in its range (out-of-range → clamped index, +inf weight: no-op)

@@ -312,7 +312,8 @@ class ServingEngine:
             self._catalog = None
             self._retriever = TwoStageRetriever(
                 model.V, item_mask=item_mask,
-                config=self._retrieval_cfg)
+                config=self._retrieval_cfg,
+                partitioner=self.partitioner)
             U = jnp.asarray(model.U)
             self._U = (U.astype(jnp.float32)
                        if U.dtype != jnp.float32 else U)

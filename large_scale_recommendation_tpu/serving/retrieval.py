@@ -38,10 +38,19 @@ over the candidate set (an excluded candidate's score is forced to
 dead-slot sentinel contract). Masked (phantom) rows carry the same
 additive ``item_w`` offset as the exact catalogs.
 
-Everything here is single-host: the quantized catalog is a plain
-replicated device array (int8 makes a 1M×128 catalog ~128 MB — far
-below one chip's HBM; rank-sharding a quantized catalog is future work,
-same status as model-parallel factor rows in ``parallel.partitioner``).
+Everything here is single-HOST; within the host the catalog is either a
+plain replicated device array (int8 makes a 1M×128 catalog ~128 MB —
+far below one chip's HBM) or, given a ``Partitioner`` with
+``model_parallel > 1``, RANK-SHARDED: the int8 codes (flat ``q``,
+clustered ``slab_q``/``ovf_q``) and the f32 rescore table live as
+column slices over the ``'model'`` mesh axis, so catalog bytes per
+device scale down with the model size (ISSUE 16). The stage kernels
+stay unchanged — GSPMD partitions the jitted contractions over the
+sharded rank dimension and inserts the all-reduce the partial dots
+need (int32 partial sums reduce EXACTLY; the f32 stage-2 rescore and
+the clustered f32 einsum carry only reduction-reordering error).
+Per-row scales are computed on FULL rows before sharding, so the int8
+codes are identical at every model size.
 """
 
 from __future__ import annotations
@@ -281,6 +290,15 @@ class QuantizedCatalog:
     ovf_rows: jax.Array | None = None  # int32 [O] (n_rows pads)
     pos_of_row: np.ndarray | None = None  # int64 [n]: c·m+slot | C·m+j
     stats: dict = dataclasses.field(default_factory=dict)
+    # rank-sharded builds carry their Partitioner so delta patches can
+    # re-pin layouts; None = single-device replicated (the historical
+    # layout, byte-identical arrays)
+    partitioner: object | None = None
+
+    # every array field that counts toward the catalog footprint
+    _ARRAY_FIELDS = ("q", "scale", "centroids", "slab_q", "slab_scale",
+                     "slab_w", "slab_rows", "ovf_q", "ovf_scale", "ovf_w",
+                     "ovf_rows", "item_w")
 
     @property
     def clustered(self) -> bool:
@@ -288,13 +306,38 @@ class QuantizedCatalog:
 
     def nbytes(self) -> int:
         total = 0
-        for f in ("q", "scale", "centroids", "slab_q", "slab_scale",
-                  "slab_w", "slab_rows", "ovf_q", "ovf_scale", "ovf_w",
-                  "ovf_rows", "item_w"):
+        for f in self._ARRAY_FIELDS:
             arr = getattr(self, f)
             if arr is not None:
                 total += arr.size * arr.dtype.itemsize
         return int(total)
+
+    def nbytes_per_device(self) -> int:
+        """Catalog bytes RESIDENT PER DEVICE — the number the ISSUE 16
+        footprint acceptance reads. Rank-sharded builds hold only a
+        column slice of the int8 codes per device (replicated scales/
+        routing metadata count at full size on every device); the
+        replicated build returns ``nbytes()``. Measured from the actual
+        addressable shards, not modeled, so layout drift shows up."""
+        per_dev: dict = {}
+        for f in self._ARRAY_FIELDS:
+            arr = getattr(self, f)
+            if arr is None:
+                continue
+            shards = getattr(arr, "addressable_shards", None)
+            if shards:
+                for s in shards:
+                    per_dev[s.device] = (per_dev.get(s.device, 0)
+                                         + int(s.data.size
+                                               * s.data.dtype.itemsize))
+            else:
+                per_dev[None] = (per_dev.get(None, 0)
+                                 + int(arr.size * arr.dtype.itemsize))
+        if not per_dev:
+            return 0
+        # single-device arrays (key None / one device) plus the max over
+        # mesh devices: the bound a capacity plan must honor
+        return int(max(per_dev.values()))
 
     def apply_delta(self, rows, values, version: int) -> "QuantizedCatalog":
         """Re-quantize ONLY the given rows (new full-precision
@@ -308,11 +351,29 @@ class QuantizedCatalog:
         if len(rows) == 0:
             return dataclasses.replace(self, version=version)
         q_new, s_new = _quantize_rows(jnp.asarray(values))
+        part = self.partitioner
+        if part is not None:
+            # rank-sharded layout: the fresh codes are quantized on FULL
+            # rows (identical codes at any model size), replicated onto
+            # the mesh, and each scatter below re-pins to the original
+            # sharding — so only the owning shard's column slice of the
+            # dirty rows actually changes on each device
+            q_new = part.shard(q_new)
+            s_new = part.shard(s_new)
+
+        def repin(name, new):
+            # scatter outputs must keep the exact build-time layout so
+            # the stage kernels' compiled executables see the same
+            # shardings (replicated builds: no-op)
+            if part is None:
+                return new
+            return jax.device_put(new, getattr(self, name).sharding)
+
         patch: dict = {"version": version}
         if self.q is not None:
             idx = jnp.asarray(rows)
-            patch["q"] = self.q.at[idx].set(q_new)
-            patch["scale"] = self.scale.at[idx].set(s_new)
+            patch["q"] = repin("q", self.q.at[idx].set(q_new))
+            patch["scale"] = repin("scale", self.scale.at[idx].set(s_new))
         if self.clustered:
             C, m, r = self.slab_q.shape
             pos = self.pos_of_row[rows]
@@ -321,28 +382,75 @@ class QuantizedCatalog:
                 sp = jnp.asarray(pos[in_slab])
                 qs, ss = q_new[jnp.asarray(in_slab)], s_new[
                     jnp.asarray(in_slab)]
-                patch["slab_q"] = self.slab_q.reshape(
-                    C * m, r).at[sp].set(qs).reshape(C, m, r)
-                patch["slab_scale"] = self.slab_scale.reshape(
-                    C * m).at[sp].set(ss).reshape(C, m)
+                patch["slab_q"] = repin("slab_q", self.slab_q.reshape(
+                    C * m, r).at[sp].set(qs).reshape(C, m, r))
+                patch["slab_scale"] = repin(
+                    "slab_scale", self.slab_scale.reshape(
+                        C * m).at[sp].set(ss).reshape(C, m))
             in_ovf = ~in_slab
             if in_ovf.any():
                 op = jnp.asarray(pos[in_ovf] - C * m)
-                patch["ovf_q"] = self.ovf_q.at[op].set(
-                    q_new[jnp.asarray(in_ovf)])
-                patch["ovf_scale"] = self.ovf_scale.at[op].set(
-                    s_new[jnp.asarray(in_ovf)])
+                patch["ovf_q"] = repin("ovf_q", self.ovf_q.at[op].set(
+                    q_new[jnp.asarray(in_ovf)]))
+                patch["ovf_scale"] = repin(
+                    "ovf_scale", self.ovf_scale.at[op].set(
+                        s_new[jnp.asarray(in_ovf)]))
         return dataclasses.replace(self, **patch)
+
+
+def _rank_shard_partitioner(partitioner):
+    """The builder's gate: a Partitioner with ``model_parallel > 1``
+    opts the catalog into the rank-sharded layout; anything else (None,
+    or a model=1 mesh) keeps the historical single-device arrays —
+    byte-identical, nothing placed on a mesh."""
+    if partitioner is None or partitioner.model_parallel <= 1:
+        return None
+    return partitioner
+
+
+def _shard_quantized(cat: QuantizedCatalog, part) -> QuantizedCatalog:
+    """Place a built catalog rank-sharded: int8 code tables (and only
+    them — scales, routing centroids, weights and row maps replicate;
+    they are O(n), not O(n·r)) split by COLUMN over the ``'model'``
+    axis. Codes were quantized on full rows before this, so the shards
+    concatenate back to the exact replicated catalog."""
+    patch: dict = {"partitioner": part}
+    if cat.q is not None:
+        patch["q"] = part.shard(cat.q, None, "rank")
+        patch["scale"] = part.shard(cat.scale)
+    patch["item_w"] = part.shard(cat.item_w)
+    if cat.clustered:
+        patch["centroids"] = part.shard(cat.centroids)
+        patch["slab_q"] = part.shard(cat.slab_q, None, None, "rank")
+        patch["slab_scale"] = part.shard(cat.slab_scale)
+        patch["slab_w"] = part.shard(cat.slab_w)
+        patch["slab_rows"] = part.shard(cat.slab_rows)
+        patch["ovf_q"] = part.shard(cat.ovf_q, None, "rank")
+        patch["ovf_scale"] = part.shard(cat.ovf_scale)
+        patch["ovf_w"] = part.shard(cat.ovf_w)
+        patch["ovf_rows"] = part.shard(cat.ovf_rows)
+    out = dataclasses.replace(cat, **patch)
+    cat.stats.update(rank_sharded=int(part.model_parallel),
+                     bytes_per_device=out.nbytes_per_device())
+    return out
 
 
 def build_quantized_catalog(V, item_mask=None,
                             config: RetrievalConfig | None = None,
-                            version: int | None = None
+                            version: int | None = None,
+                            partitioner=None,
                             ) -> QuantizedCatalog:
     """Quantize ``V`` and (optionally) build the clustered MIPS layout.
     ``item_mask`` follows the ``shard_catalog`` contract (True = real
-    item; masked rows score ``DEAD_SLOT_OFFSET`` additively)."""
+    item; masked rows score ``DEAD_SLOT_OFFSET`` additively).
+    ``partitioner`` with ``model_parallel > 1`` rank-shards the int8
+    code tables over the ``'model'`` mesh axis (see module docstring);
+    otherwise the historical replicated layout is returned unchanged."""
     cfg = config or RetrievalConfig()
+    part = _rank_shard_partitioner(partitioner)
+    if part is not None:
+        part.require_rank_divisible(int(np.shape(V)[1]),
+                                    "build_quantized_catalog")
     t0 = time.perf_counter()
     version = catalog_version(V) if version is None else version
     V_host = np.asarray(V, np.float32)
@@ -356,6 +464,8 @@ def build_quantized_catalog(V, item_mask=None,
         cat = QuantizedCatalog(
             n_rows=n, rank=r, version=version,
             item_w=jnp.asarray(item_w), q=q_dev, scale=s_dev, stats=stats)
+        if part is not None:
+            cat = _shard_quantized(cat, part)
         stats["build_s"] = round(time.perf_counter() - t0, 3)
         stats["bytes"] = cat.nbytes()
         return cat
@@ -410,6 +520,8 @@ def build_quantized_catalog(V, item_mask=None,
         ovf_w=jnp.asarray(slab_w[C * m:]),
         ovf_rows=jnp.asarray(slab_rows[C * m:]),
         pos_of_row=pos_of_row, stats=stats)
+    if part is not None:
+        cat = _shard_quantized(cat, part)
     stats["build_s"] = round(time.perf_counter() - t0, 3)
     stats["bytes"] = cat.nbytes()
     return cat
@@ -537,13 +649,31 @@ class TwoStageRetriever:
 
     def __init__(self, V, item_mask=None,
                  config: RetrievalConfig | None = None,
-                 version: int | None = None):
+                 version: int | None = None, partitioner=None):
         self.config = config or RetrievalConfig()
+        self.partitioner = _rank_shard_partitioner(partitioner)
         self.V = jnp.asarray(V, jnp.float32)  # exact rescore table
         self.catalog = build_quantized_catalog(
             self.V, item_mask=item_mask, config=self.config,
-            version=catalog_version(V) if version is None else version)
+            version=catalog_version(V) if version is None else version,
+            partitioner=self.partitioner)
+        if self.partitioner is not None:
+            # the stage-2 rescore table rank-shards too: GSPMD turns its
+            # f32 candidate einsum into a partial contraction + all-reduce
+            self.V = self.partitioner.shard(self.V, None, "rank")
         self.buckets_seen: set[tuple] = set()  # compile-shape evidence
+
+    def nbytes_per_device(self) -> int:
+        """Stage-1 catalog + stage-2 rescore table bytes per device (the
+        ISSUE 16 per-device serving footprint)."""
+        per_cat = self.catalog.nbytes_per_device()
+        shards = getattr(self.V, "addressable_shards", None)
+        if shards:
+            v_dev = max(int(s.data.size * s.data.dtype.itemsize)
+                        for s in shards)
+        else:
+            v_dev = int(self.V.size * self.V.dtype.itemsize)
+        return per_cat + v_dev
 
     @property
     def version(self) -> int:
@@ -580,6 +710,12 @@ class TwoStageRetriever:
                 f"bucket {U_chunk.shape[0]} × catalog {cat.n_rows} "
                 f"exceeds the uint32 membership-key capacity — lower "
                 f"RetrievalConfig.max_bucket")
+        if self.partitioner is not None:
+            # rank-sharded catalogs: the query chunk and exclusion triple
+            # replicate onto the mesh so the jitted stages see one device
+            # set (GSPMD then partitions the contractions over 'model')
+            U_chunk = self.partitioner.shard(U_chunk)
+            excl = tuple(self.partitioner.shard(e) for e in excl)
         excl_rows, excl_cols, excl_w = (jnp.asarray(e) for e in excl)
         if cat.clustered:
             n_probe = min(self.config.n_probe, cat.slab_q.shape[0])
@@ -607,7 +743,13 @@ class TwoStageRetriever:
         rows = np.asarray(rows)
         if len(rows):
             vals = jnp.asarray(values, jnp.float32)
-            self.V = self.V.at[jnp.asarray(rows)].set(vals)
+            if self.partitioner is not None:
+                vals = self.partitioner.shard(vals)
+                self.V = jax.device_put(
+                    self.V.at[jnp.asarray(rows)].set(vals),
+                    self.V.sharding)  # re-pin the rank-sharded layout
+            else:
+                self.V = self.V.at[jnp.asarray(rows)].set(vals)
             self.catalog = self.catalog.apply_delta(rows, vals, version)
         else:
             self.catalog = dataclasses.replace(self.catalog,

@@ -238,32 +238,54 @@ class ShardedCheckpointManager:
     def save(self, step: int, arrays: dict, meta: dict | None = None) -> str:
         """Write this process's shards (+ manifest on process 0), then
         sweep retention. ``arrays`` values are jax global arrays sharded
-        over dim 0 (rows); replicated duplicates are deduped by offset."""
+        over dim 0 (rows) and optionally dim 1 (rank columns, the
+        model-parallel layout); replicated duplicates are deduped by
+        (row, column) offset."""
         import jax
 
         pid, nproc = jax.process_index(), jax.process_count()
         payload: dict[str, np.ndarray] = {}
         for key, arr in arrays.items():
-            pieces: dict[int, np.ndarray] = {}
+            pieces: dict[tuple[int, int], np.ndarray] = {}
+            col_sharded = False
             for sh in arr.addressable_shards:
-                # the dedupe-by-row-offset below is only sound for pure
-                # dim-0 (row) sharding — a dim-1 shard would alias offset 0
-                # and silently drop columns, so refuse loudly instead
-                for sl, dim in zip(sh.index[1:], arr.shape[1:]):
+                # pieces are keyed (row_start, col_start): dim-0 (row /
+                # 'data') and dim-1 (column / 'model', the rank-sharded
+                # factor layout, ISSUE 16) sharding both round-trip.
+                # Dims ≥ 2 would alias offsets and silently drop slabs,
+                # so refuse loudly instead
+                for sl, dim in zip(sh.index[2:], arr.shape[2:]):
                     if (sl.start not in (None, 0)
                             or sl.stop not in (None, dim)):
                         raise ValueError(
-                            f"{key} is sharded over a non-row dimension "
+                            f"{key} is sharded over dimension ≥ 2 "
                             f"({sh.index}); ShardedCheckpointManager "
-                            "requires dim-0 (row) sharding only")
+                            "supports dim-0 (row) and dim-1 (column) "
+                            "sharding only")
                 r = sh.index[0] if sh.index else slice(None)
+                c = sh.index[1] if len(sh.index) > 1 else slice(None)
                 start = int(r.start or 0)
-                if start not in pieces:
-                    pieces[start] = np.asarray(sh.data)
+                cstart = int(c.start or 0)
+                if len(arr.shape) > 1 and (
+                        cstart != 0
+                        or c.stop not in (None, arr.shape[1])):
+                    col_sharded = True
+                if (start, cstart) not in pieces:
+                    pieces[(start, cstart)] = np.asarray(sh.data)
             starts = sorted(pieces)
-            payload[f"{key}__starts"] = np.asarray(starts, np.int64)
+            payload[f"{key}__starts"] = np.asarray(
+                [s for s, _ in starts], np.int64)
             payload[f"{key}__lens"] = np.asarray(
                 [len(pieces[s]) for s in starts], np.int64)
+            if col_sharded:
+                # column metadata only when dim-1 sharding is present:
+                # old snapshots (and row-only new ones) carry no
+                # __cstarts and restore as full-width pieces — the
+                # on-disk format stays backward compatible
+                payload[f"{key}__cstarts"] = np.asarray(
+                    [c for _, c in starts], np.int64)
+                payload[f"{key}__clens"] = np.asarray(
+                    [pieces[s].shape[1] for s in starts], np.int64)
             for j, s in enumerate(starts):
                 # bit-view non-native dtypes (bf16) — the manifest's
                 # per-array dtype string drives the re-view on restore
@@ -367,11 +389,20 @@ class ShardedCheckpointManager:
 
     def restore_array(self, step: int, key: str, sharding, shape, dtype):
         """Rebuild one global array: serve each addressable device's
-        row-range from the saved pieces. Only pieces OVERLAPPING this
-        process's addressable rows are materialized (piece offsets+lengths
-        are read first, the data entries lazily) — no process ever holds
-        more rows than its devices address, which is the whole point at
-        scales where the full table cannot fit one host."""
+        (row, column)-range from the saved pieces. Only pieces
+        OVERLAPPING this process's addressable region are materialized
+        (piece offsets+lengths are read first, the data entries lazily)
+        — no process ever holds more rows than its devices address,
+        which is the whole point at scales where the full table cannot
+        fit one host.
+
+        Pieces carry column offsets when the snapshot was written under
+        dim-1 (rank/'model') sharding; snapshots without ``__cstarts``
+        restore as full-width. Because the fill is overlap-based, a
+        resume across a CHANGED model size (m=1 ↔ 2 ↔ 4 column
+        layouts) reassembles each device's slice from whichever pieces
+        cover it — and a layout the pieces do NOT cover fails loudly on
+        the area check below, never silently misplacing rows."""
         import jax
 
         m = self._manifest(step)
@@ -383,51 +414,82 @@ class ShardedCheckpointManager:
                 f"checkpoint {key} shape {want['shape']} != {list(shape)} — "
                 "resumed fit must use the same ratings, seed, rank and "
                 "block count")
-        # union of row-ranges this process's devices address
-        mine: list[tuple[int, int]] = []
+        ncols = int(shape[1]) if len(shape) > 1 else 1
+
+        def ranges(idx):
+            r = idx[0] if idx else slice(None)
+            c = idx[1] if len(idx) > 1 else slice(None)
+            return (int(r.start or 0),
+                    int(r.stop) if r.stop is not None else int(shape[0]),
+                    int(c.start or 0),
+                    int(c.stop) if c.stop is not None else ncols)
+
+        # union of (row, col)-ranges this process's devices address
+        mine: list[tuple[int, int, int, int]] = []
         addressable = set(sharding.addressable_devices)
         for d, idx in sharding.devices_indices_map(tuple(shape)).items():
             if d not in addressable:
                 continue
-            r = idx[0] if idx else slice(None)
-            mine.append((int(r.start or 0),
-                         int(r.stop) if r.stop is not None
-                         else int(shape[0])))
+            mine.append(ranges(idx))
 
-        def overlaps(lo: int, hi: int) -> bool:
-            return any(lo < b and a < hi for a, b in mine)
+        def overlaps(lo: int, hi: int, clo: int, chi: int) -> bool:
+            return any(lo < b and a < hi and clo < cb_ and ca < chi
+                       for a, b, ca, cb_ in mine)
 
         saved_tag = (want["dtype"]
                      if want["dtype"] in _DTYPE_ENCODINGS else None)
-        pieces: list[tuple[int, np.ndarray]] = []
+        # keyed (row_start, col_start): replicated copies of the same
+        # piece in several processes' shard files dedupe here, so the
+        # area accounting in cb() below never double-counts
+        pieces: dict[tuple[int, int], np.ndarray] = {}
         for name in m["shards"]:
             with np.load(os.path.join(self.directory, name)) as z:
                 if f"{key}__starts" not in z.files:
                     continue
                 starts = z[f"{key}__starts"]
                 lens = z[f"{key}__lens"]
-                for j, (s, ln) in enumerate(zip(starts, lens)):
-                    if overlaps(int(s), int(s) + int(ln)):
-                        pieces.append((int(s), _decode_array(
-                            z[f"{key}__p{j}"], saved_tag)))
-        pieces.sort(key=lambda p: p[0])
+                if f"{key}__cstarts" in z.files:
+                    cstarts = z[f"{key}__cstarts"]
+                    clens = z[f"{key}__clens"]
+                else:  # row-only snapshot (incl. pre-rank-sharding files)
+                    cstarts = np.zeros(len(starts), np.int64)
+                    clens = np.full(len(starts), ncols, np.int64)
+                for j, (s, ln, cs, cl) in enumerate(
+                        zip(starts, lens, cstarts, clens)):
+                    at = (int(s), int(cs))
+                    if at not in pieces and overlaps(
+                            int(s), int(s) + int(ln),
+                            int(cs), int(cs) + int(cl)):
+                        pieces[at] = _decode_array(
+                            z[f"{key}__p{j}"], saved_tag)
 
         def cb(index):
-            r = index[0] if index else slice(None)
-            start = int(r.start or 0)
-            stop = int(r.stop) if r.stop is not None else int(shape[0])
-            out = np.empty((stop - start,) + tuple(shape[1:]), dtype)
+            start, stop, cstart, cstop = ranges(index)
+            out = np.empty((stop - start, cstop - cstart)
+                           + tuple(shape[2:]), dtype)
             filled = 0
-            for s, data in pieces:
-                lo, hi = max(s, start), min(s + len(data), stop)
-                if lo < hi:
-                    out[lo - start: hi - start] = data[lo - s: hi - s]
-                    filled += hi - lo
-            if filled < stop - start:
+            for (s, cs), data in sorted(pieces.items()):
+                lo, hi = max(s, start), min(s + data.shape[0], stop)
+                dcols = data.shape[1] if data.ndim > 1 else 1
+                clo, chi = max(cs, cstart), min(cs + dcols, cstop)
+                if lo < hi and clo < chi:
+                    block = data[lo - s: hi - s]
+                    if data.ndim > 1:
+                        block = block[:, clo - cs: chi - cs]
+                        out[lo - start: hi - start,
+                            clo - cstart: chi - cstart] = block
+                    else:
+                        out[lo - start: hi - start] = block[:, None]
+                    filled += (hi - lo) * (chi - clo)
+            if filled < (stop - start) * (cstop - cstart):
                 raise ValueError(
                     f"checkpoint step {step} is missing rows "
-                    f"[{start},{stop}) of {key} — shard layout mismatch")
-            return out[(slice(None),) + tuple(index[1:])] if index else out
+                    f"[{start},{stop}) × cols [{cstart},{cstop}) of "
+                    f"{key} — shard layout mismatch")
+            if len(shape) < 2:
+                return out[:, 0]
+            return (out[(slice(None), slice(None)) + tuple(index[2:])]
+                    if len(index) > 2 else out)
 
         return jax.make_array_from_callback(tuple(shape), sharding, cb)
 

@@ -182,7 +182,13 @@ DEFAULT_HIGHER = ("_ratings_per_s", "_rows_per_s", "_users_per_s",
                   "_ndcg", "_hr10", "_hr_at", "ndcg_at", "coverage",
                   # ingest family (ISSUE 13): the N-consumer scaling
                   # efficiency regresses when it drops
-                  "scaling_eff")
+                  "scaling_eff",
+                  # rank-sharded 2-D mesh pass (ISSUE 16): the 'model'-
+                  # axis training throughput regresses when it drops
+                  # (already covered by _ratings_per_s — listed so the
+                  # direction is pinned even if the key is renamed
+                  # without the suffix)
+                  "rank_sharded")
 
 # keys where LOWER is better (walls, latencies, pad/layout overheads,
 # compile counts, eval error, ingest→servable critical-path walls)
@@ -206,7 +212,15 @@ DEFAULT_LOWER = ("_wall_s", "_ms_", "time_to_", "_s_p", "_pad_ratio",
                  # pre-ISSUE-14 committed round lacks the keys, and a
                  # default watch key the baseline can't contain is
                  # permanent "missing" noise (the PR 10/13 lesson).
-                 "serial_fraction", "lock_wait")
+                 "serial_fraction", "lock_wait",
+                 # rank-sharded footprint (ISSUE 16): growing per-device
+                 # factor+catalog bytes (or the ratio vs model=1) is a
+                 # sharding regression — the whole point of the 'model'
+                 # axis is dividing them. Covers rank_shard_bytes_per_
+                 # device[_m1] and rank_shard_bytes_ratio_vs_m1. Watched
+                 # via --key, NOT in MULTICHIP_KEYS: rounds before r07
+                 # lack the keys (the PR 10/13 lesson again).
+                 "rank_shard_bytes")
 
 _NUM_PAIR = re.compile(
     r'"([A-Za-z_][A-Za-z0-9_]*)":\s*(-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)')

@@ -21,7 +21,15 @@ Layers, all chip-free:
    ``scripts/bench_regress.py --family multichip`` can gate rounds
    against each other.
 4. A mesh-ALS throughput probe (rank 32) for the second solver family.
-5. A 2-PROCESS LOCAL CLUSTER pass (skippable: ``--no-two-process`` /
+5. A RANK-SHARDED 2-D MESH pass (ISSUE 16): the same N devices
+   reshaped as (N/2)×2 and (N/4)×4 ``('data','model')`` meshes.
+   Mesh-DSGD trains on rank-sharded factor slices (prediction dots
+   psum over ``'model'``), parity-pinned against model=1 at EQUAL
+   data-axis size; the rank-sharded two-stage retriever must return
+   identical top-k ids and its per-device factor+catalog bytes at
+   model=4 must be ≤ ~30% of model=1 (``rank_sharded_ratings_per_s``,
+   ``rank_shard_bytes_per_device`` → the multichip regress keys).
+6. A 2-PROCESS LOCAL CLUSTER pass (skippable: ``--no-two-process`` /
    ``LSR_DRYRUN_NO_2PROC=1``): two real processes coordinate over
    localhost (``jax.distributed``), the global 4-device ring spans both
    — proving cross-process global arrays, ppermute across the process
@@ -291,6 +299,88 @@ def main(n_devices: int = 16, two_process: bool = True) -> dict:
     out["als_rows_per_s"] = round(
         (als_nu + als_ni) * als_iters / max(als_wall, 1e-9))
     assert np.isfinite(als_model.rmse(als_ratings))
+
+    # ---- rank-sharded 2-D mesh pass (ISSUE 16) -------------------------
+    # The 'model' axis end-to-end at pod-dryrun device counts: the same
+    # N devices reshaped as (N/2)×2 and (N/4)×4 ('data','model') meshes,
+    # mesh-DSGD training on rank-sharded factor slices (the u·v dot
+    # psums over 'model'), then the rank-sharded two-stage retriever.
+    # Parity is pinned against model=1 at EQUAL data-axis size — blocking
+    # pads tables per k, so (N/4)×4 compares against a k=N/4 1-D mesh,
+    # same padded shapes, same serpentine deal, same minibatch order.
+    rs_nu, rs_ni, rs_rank, rs_mb = 20_480, 8_192, 128, 1024
+    (ru, ri, rr), _, _ = synthetic_like_device(
+        "ml-25m", nnz=1_500_000, rank=16, noise=0.1, seed=3, skew_lam=2.0,
+        num_users=rs_nu, num_items=rs_ni)
+    rs_cfg = MeshDSGDConfig(num_factors=rs_rank, lambda_=0.1, iterations=2,
+                            learning_rate=0.1, lr_schedule="constant",
+                            seed=0, minibatch_size=rs_mb, init_scale=0.08)
+
+    def rs_fit(p2d):
+        t0 = time.perf_counter()
+        mdl = MeshDSGD(rs_cfg, partitioner=p2d).fit_device(
+            ru, ri, rr, rs_nu, rs_ni)
+        jax.block_until_ready((mdl.U, mdl.V))
+        return mdl, time.perf_counter() - t0
+
+    def max_shard_bytes(arr):
+        return max(int(np.asarray(s.data).nbytes)
+                   for s in arr.addressable_shards)
+
+    from large_scale_recommendation_tpu.serving.retrieval import (
+        RetrievalConfig,
+        TwoStageRetriever,
+    )
+
+    def rs_footprint(p2d, mdl):
+        # per-device serving+factor bytes: the rank-sharded two-stage
+        # retriever (int8 stage-1 codes + exact-rescore f32 rows column-
+        # sliced over 'model') plus this device's U factor shard
+        retr = TwoStageRetriever(
+            np.asarray(mdl.V), config=RetrievalConfig(n_clusters=None),
+            partitioner=p2d)
+        return retr, retr.nbytes_per_device() + max_shard_bytes(mdl.U)
+
+    m4 = 4 if n_devices % 4 == 0 else 1
+    part_m1 = Partitioner(num_devices=n_devices // m4)  # k equal to 2-D
+    part_m4 = Partitioner(num_devices=n_devices, model_parallel=m4)
+    model_m1, _ = rs_fit(part_m1)
+    model_m4, wall_m4 = rs_fit(part_m4)
+    # nnz accounting: the train split's visits per sweep
+    rs_nnz_blocked = int(np.shape(ru)[0])
+    out["rank_sharded_ratings_per_s"] = round(
+        rs_nnz_blocked * rs_cfg.iterations / max(wall_m4, 1e-9))
+    delta = float(np.max(np.abs(np.asarray(model_m4.U, np.float32)
+                                - np.asarray(model_m1.U, np.float32))))
+    out["rank_shard_parity_max_abs_delta"] = delta
+    # fp tolerance only: psum reduction order vs a single fused dot
+    assert delta < 1e-4, delta
+
+    retr_m1, bytes_m1 = rs_footprint(part_m1, model_m1)
+    retr_m4, bytes_m4 = rs_footprint(part_m4, model_m4)
+    out["rank_shard_bytes_per_device"] = bytes_m4
+    out["rank_shard_bytes_per_device_m1"] = bytes_m1
+    ratio = bytes_m4 / max(bytes_m1, 1)
+    out["rank_shard_bytes_ratio_vs_m1"] = round(ratio, 3)
+    # footprint acceptance: sharded int8 codes + f32 rescore rows + U
+    # divide by m=4; only per-row scales/weights replicate. ≤ ~30% of
+    # the model=1 per-device bytes at rank 128 (ISSUE 16 acceptance).
+    assert m4 == 1 or ratio <= 0.32, (bytes_m4, bytes_m1)
+    # retrieval parity: same seed, same queries ⇒ same top-k ids
+    q = np.asarray(model_m1.U, np.float32)[:256]
+    empty_excl = (np.zeros(8, np.int32), np.zeros(8, np.int32),
+                  np.full(8, np.inf, np.float32))
+    _, ids_m1 = retr_m1.topk(q, empty_excl, k=10)
+    _, ids_m4 = retr_m4.topk(q, empty_excl, k=10)
+    assert np.array_equal(np.asarray(ids_m1), np.asarray(ids_m4))
+
+    # second mesh shape (N/2)×2 — throughput only (its k differs from
+    # both runs above, so no equal-k parity partner without a third fit)
+    if n_devices % 2 == 0 and n_devices > 2:
+        _, wall_m2 = rs_fit(Partitioner(num_devices=n_devices,
+                                        model_parallel=2))
+        out["rank_sharded_8x2_ratings_per_s"] = round(
+            rs_nnz_blocked * rs_cfg.iterations / max(wall_m2, 1e-9))
 
     # ---- 2-process local cluster -------------------------------------
     if not two_process or os.environ.get("LSR_DRYRUN_NO_2PROC"):

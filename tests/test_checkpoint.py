@@ -378,9 +378,11 @@ class TestShardedManagerGuards:
                                           np.zeros((4, 2), np.float32),
                                           np.zeros((4, 2), np.float32))
 
-    def test_non_row_sharding_refused_on_save(self, tmp_path):
-        """Column sharding would alias every shard to row-offset 0 and
-        silently drop columns — save must refuse it loudly."""
+    def test_column_sharding_round_trips_dim2_refused(self, tmp_path):
+        """Pieces are keyed (row_start, col_start): dim-0 AND dim-1
+        sharding round-trip (the rank-sharded factor layout, ISSUE 16).
+        Sharding over dimensions ≥ 2 would still alias offsets and
+        silently drop slabs — save must refuse it loudly."""
         import jax
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -390,11 +392,19 @@ class TestShardedManagerGuards:
 
         devs = jax.devices("cpu")[:2]
         mesh = Mesh(np.asarray(devs), ("m",))
-        cols = jax.device_put(np.ones((4, 8), np.float32),
-                              NamedSharding(mesh, P(None, "m")))
+        want = np.arange(32, dtype=np.float32).reshape(4, 8)
+        col_shd = NamedSharding(mesh, P(None, "m"))
+        cols = jax.device_put(want, col_shd)
         mgr = ShardedCheckpointManager(str(tmp_path))
-        with pytest.raises(ValueError, match="non-row dimension"):
-            mgr.save(1, {"U": cols}, {})
+        mgr.save(1, {"U": cols}, {})
+        got = mgr.restore_array(1, "U", col_shd, want.shape, want.dtype)
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+        deep = jax.device_put(
+            np.ones((4, 8, 2), np.float32),
+            NamedSharding(mesh, P(None, None, "m")))
+        with pytest.raises(ValueError, match="dim"):
+            mgr.save(2, {"W": deep}, {})
 
     def test_restore_array_only_reads_overlapping_pieces(self, tmp_path):
         """Round-trip on an uneven host stand-in: restore serves each

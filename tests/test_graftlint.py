@@ -81,6 +81,59 @@ class TestShardingFunnel:
 
 
 # ---------------------------------------------------------------------------
+# model-guard
+# ---------------------------------------------------------------------------
+
+class TestModelGuard:
+    VIOLATION = """
+        def build_step(part):
+            part.require_no_model_parallel("mesh foo kernel")
+            return part.spec("users", "rank")
+
+        class MeshFoo:
+            def fit(self):
+                self.partitioner.require_no_model_parallel("foo fit")
+    """
+    CLEAN = """
+        def build_step(part):
+            rank_sharded = part.model_parallel > 1
+            pred_axis = part.model_axis if rank_sharded else None
+            return pred_axis
+    """
+    SUPPRESSED = """
+        def build_step(part):
+            # VMEM staging assumes full-rank rows; rank slices would
+            # halve the tile and break the emitted layout
+            part.require_no_model_parallel(  # graftlint: disable=model-guard
+                "foo pallas kernel")
+    """
+
+    def test_planted_violation(self, tmp_path):
+        res = lint_src(tmp_path, self.VIOLATION, "model-guard")
+        rules = [f.rule for f in res.findings]
+        assert rules == ["model-guard"] * 2
+        assert {f.symbol for f in res.findings} == {"build_step",
+                                                    "MeshFoo.fit"}
+
+    def test_clean_twin(self, tmp_path):
+        res = lint_src(tmp_path, self.CLEAN, "model-guard")
+        assert res.findings == []
+
+    def test_partitioner_module_is_the_definition_site(self, tmp_path):
+        res = lint_src(tmp_path, self.VIOLATION, "model-guard",
+                       name="parallel/partitioner.py")
+        assert res.findings == []
+
+    def test_reasoned_suppression_survives(self, tmp_path):
+        """The contract for the one legitimate caller (the pallas DSGD
+        kernel's build-time refusal): a reasoned inline disable moves
+        the site to ``suppressed``, never to the verdict."""
+        res = lint_src(tmp_path, self.SUPPRESSED, "model-guard")
+        assert res.findings == []
+        assert [f.rule for f in res.suppressed] == ["model-guard"]
+
+
+# ---------------------------------------------------------------------------
 # obs-gate
 # ---------------------------------------------------------------------------
 

@@ -712,3 +712,83 @@ class TestIngestFamily:
         assert regress_main(["--family", "ingest",
                              "--baseline", c, "--current", b,
                              "--key", "serial_fraction_n4=50"]) == 0
+
+
+class TestRankShardDirection:
+    """ISSUE 16: the rank-sharded 2-D mesh keys pod_dryrun emits into
+    the MULTICHIP rounds — throughput higher-is-better, per-device
+    factor+catalog bytes (and the ratio vs model=1) LOWER-is-better.
+    Watched via --key, NOT in MULTICHIP_KEYS: rounds before r07 lack
+    the keys, and a default watch key the baseline can't contain is
+    permanent "missing" noise (the PR 10/13 lesson)."""
+
+    def test_rank_shard_direction_rules(self):
+        from scripts.bench_regress import is_lower_better
+
+        for key in ("rank_shard_bytes_per_device",
+                    "rank_shard_bytes_per_device_m1",
+                    "rank_shard_bytes_ratio_vs_m1"):
+            assert is_lower_better(key, set()), key
+        for key in ("rank_sharded_ratings_per_s",
+                    "rank_sharded_8x2_ratings_per_s"):
+            assert not is_lower_better(key, set()), key
+
+    def test_rank_shard_no_direction_collision(self):
+        """The bytes keys must not match any higher-is-better pattern
+        (DEFAULT_HIGHER wins over DEFAULT_LOWER, so a collision would
+        silently flip the gate's direction), and the throughput keys
+        must not match the new lower pattern — 'rank_shard_bytes' is
+        NOT a substring of 'rank_sharded_*'."""
+        from scripts.bench_regress import DEFAULT_HIGHER, DEFAULT_LOWER
+
+        for key in ("rank_shard_bytes_per_device",
+                    "rank_shard_bytes_ratio_vs_m1"):
+            assert not any(pat in key for pat in DEFAULT_HIGHER), key
+        for key in ("rank_sharded_ratings_per_s",
+                    "rank_sharded_8x2_ratings_per_s"):
+            assert not any(pat in key for pat in DEFAULT_LOWER), key
+        assert "rank_shard_bytes" in DEFAULT_LOWER
+        assert "rank_sharded" in DEFAULT_HIGHER
+
+    def test_rank_shard_keys_not_in_family_watch_set(self):
+        """The PR 10/13 lesson: new keys gate via --key until every
+        committed round in the diff window carries them."""
+        from scripts.bench_regress import MULTICHIP_KEYS
+
+        for key in MULTICHIP_KEYS:
+            assert "rank_shard" not in key, key
+
+    def _round(self, tmp_path, name, **over):
+        base = {"n_devices": 16, "train_ratings_per_s": 450_000.0,
+                "als_rows_per_s": 2_600.0, "max_pad_ratio": 1.104,
+                "layout_mb": 144.0,
+                "rank_sharded_ratings_per_s": 320_000.0,
+                "rank_shard_bytes_per_device": 2_031_616.0,
+                "rank_shard_bytes_ratio_vs_m1": 0.256}
+        base.update(over)
+        p = tmp_path / name
+        p.write_text(json.dumps(base))
+        return str(p)
+
+    def test_footprint_growth_trips_via_key(self, tmp_path):
+        b = self._round(tmp_path, "MULTICHIP_r07.json")
+        c = self._round(tmp_path, "MULTICHIP_r08.json",
+                        rank_shard_bytes_per_device=4_000_000.0)
+        assert regress_main(["--family", "multichip",
+                             "--baseline", b, "--current", c,
+                             "--key", "rank_shard_bytes_per_device=20"
+                             ]) == 1
+        # SHRINKING per-device bytes is the improvement direction
+        assert regress_main(["--family", "multichip",
+                             "--baseline", c, "--current", b,
+                             "--key", "rank_shard_bytes_per_device=20"
+                             ]) == 0
+
+    def test_rank_sharded_throughput_collapse_trips_via_key(self, tmp_path):
+        b = self._round(tmp_path, "MULTICHIP_r07.json")
+        c = self._round(tmp_path, "MULTICHIP_r08.json",
+                        rank_sharded_ratings_per_s=100_000.0)
+        assert regress_main(["--family", "multichip",
+                             "--baseline", b, "--current", c,
+                             "--key", "rank_sharded_ratings_per_s=30"
+                             ]) == 1

@@ -11,6 +11,7 @@ from tools.graftlint.checkers.buffer_aliasing import BufferAliasingChecker
 from tools.graftlint.checkers.host_sync import HostSyncChecker
 from tools.graftlint.checkers.lock_gap import LockGapChecker
 from tools.graftlint.checkers.lock_order import LockOrderChecker
+from tools.graftlint.checkers.model_guard import ModelGuardChecker
 from tools.graftlint.checkers.obs_gate import ObsGateChecker
 from tools.graftlint.checkers.sharding_funnel import ShardingFunnelChecker
 
@@ -22,6 +23,7 @@ ALL_CHECKERS = {
         LockGapChecker,
         BufferAliasingChecker,
         HostSyncChecker,
+        ModelGuardChecker,
     )
 }
 

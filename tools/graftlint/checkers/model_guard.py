@@ -1,0 +1,67 @@
+"""model-guard: ``require_no_model_parallel`` is an escape hatch, not
+a blanket guard.
+
+Incident this descends from (ISSUE 16): the rank-sharding PR activated
+the ``'rank' → 'model'`` rule end-to-end by making mesh DSGD, mesh ALS
+and mesh serving CORRECT on rank-sharded factor slices (prediction dots
+and Gram matrices psum over ``'model'``) and deleting their
+``require_no_model_parallel`` guards. Every such guard that remains is
+a kernel silently opting out of the 2-D mesh — a `model_parallel > 1`
+run hits a hard error at a site nobody re-audited. This rule flags any
+call site of the guard outside ``parallel/partitioner.py`` (where it is
+defined); a surviving caller must carry a reasoned inline
+``# graftlint: disable=model-guard`` suppression explaining WHY the
+kernel cannot insert the reduction collectives (e.g. the pallas DSGD
+kernel's VMEM staging assumes full-rank rows), so new opt-outs are a
+reviewed decision, never a default.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.astutil import call_name
+from tools.graftlint.core import Checker, Finding, ModuleInfo, Project
+
+GUARD = "require_no_model_parallel"
+
+# the defining module: the method body + docstring mention themselves
+ALLOWED_SUFFIXES = ("parallel/partitioner.py",)
+
+
+class ModelGuardChecker(Checker):
+    name = "model-guard"
+    description = (f"no {GUARD} call sites outside "
+                   "parallel/partitioner.py without a reasoned "
+                   "inline suppression")
+
+    def run(self, project: Project) -> list[Finding]:
+        out: list[Finding] = []
+        for mod in project.modules:
+            if mod.rel.endswith(ALLOWED_SUFFIXES):
+                continue
+            out.extend(self._check_module(mod))
+        return out
+
+    def _check_module(self, mod: ModuleInfo) -> list[Finding]:
+        out: list[Finding] = []
+
+        def visit(node, stack):
+            for child in ast.iter_child_nodes(node):
+                child_stack = (stack + [child] if isinstance(
+                    child, (ast.ClassDef, ast.FunctionDef,
+                            ast.AsyncFunctionDef)) else stack)
+                if (isinstance(child, ast.Call)
+                        and call_name(child) == GUARD):
+                    out.append(self.finding(
+                        mod, child, stack,
+                        f"{GUARD} call site — this kernel opts out of "
+                        f"rank (model-axis) sharding; make it correct "
+                        f"on rank slices (psum the reduced terms over "
+                        f"'model') or carry a reasoned "
+                        f"'# graftlint: disable=model-guard' "
+                        f"suppression at the site"))
+                visit(child, child_stack)
+
+        visit(mod.tree, [])
+        return out
