@@ -52,6 +52,13 @@ def _install_rows(table: jax.Array, fresh: jax.Array,
     return jax.lax.dynamic_update_slice(table, fresh, (base, 0))
 
 
+# touched-rows commit for the concurrent-apply path (moved here from
+# models/online.py so the tiered store can override the seam): ``idx``
+# is pow2-padded with REPEATED OWN rows, so duplicate scatter entries
+# carry duplicate values and order cannot matter
+_commit_rows = jax.jit(lambda cur, src, idx: cur.at[idx].set(src[idx]))
+
+
 class GrowableFactorTable:
     """A factor matrix with ``getOrElseUpdate`` semantics on device.
 
@@ -270,6 +277,66 @@ class GrowableFactorTable:
     def ids(self) -> list[int]:
         return self._ids_buf[:self._n].tolist()
 
+    # -- tiering hooks -----------------------------------------------------
+    # The seams ``store.tiered.TieredFactorStore`` overrides. On a plain
+    # table every default is the existing behavior verbatim (acquire IS
+    # ensure, release is free, snapshot is the zero-copy ref slice), so
+    # the untiered paths stay byte-identical — the tiered bit-exactness
+    # invariant is pinned against exactly these defaults.
+
+    def acquire_rows(self, ids: np.ndarray) -> np.ndarray:
+        """Register ``ids`` and return the rows TRAINING should index —
+        table rows here; device SLOT indices on a tiered store (which
+        also faults the rows hot and pins them until ``release_rows``)."""
+        return self.ensure(ids)
+
+    def release_rows(self, rows: np.ndarray) -> None:
+        """Drop the eviction pins ``acquire_rows`` took (no-op here —
+        a plain table has nothing to evict)."""
+
+    def gather_rows(self, rows: np.ndarray) -> np.ndarray:
+        """Host float32 values of ``rows`` — one pow2-padded device
+        gather (the delta-shipping idiom ``StreamingDriver`` uses). A
+        tiered store merges hot slots and cold rows instead."""
+        n = len(rows)
+        if n == 0:
+            return np.zeros((0, self.rank), np.float32)
+        idx = np.zeros(_pow2_pad(n), np.int64)
+        idx[:n] = rows
+        return np.asarray(self.array[jnp.asarray(idx)])[:n]
+
+    def commit_rows(self, updated, idx) -> None:
+        """Concurrent-apply commit: scatter ``updated``'s rows at
+        ``idx`` (pow2-padded, repeated-own-row pads) into the live
+        table. A tiered store takes its lock so a racing prefetch
+        load is never erased by the rebind."""
+        self.array = _commit_rows(self.array, updated, jnp.asarray(idx))
+
+    def install_trained(self, updated, rows: np.ndarray) -> None:
+        """Serial-path install of a trained table. Plain table: the
+        whole-array rebind (``updated`` IS the new table, the existing
+        serial semantics verbatim). A tiered store scatters only
+        ``rows`` into the current pool instead."""
+        self.array = updated
+
+    def snapshot_rows(self, n: int):
+        """The first ``n`` rows for a checkpoint capture. Immutable
+        device arrays can't tear, so the ref slice is the zero-copy
+        consistent snapshot; a tiered store must COPY under its lock
+        (the cold tier is mutable numpy)."""
+        return self.array[:n]
+
+    def load_rows(self, rows: np.ndarray, values: np.ndarray) -> None:
+        """Write restored factor rows (checkpoint restore path)."""
+        self.array = self.array.at[jnp.asarray(rows)].set(
+            jnp.asarray(values))
+
+    def full_table(self):
+        """The whole table as one array — offline/eval consumers only
+        (``predict``/``to_model``). ``.array`` itself on a plain table;
+        a tiered store materializes the hot∪cold merge."""
+        return self.array
+
 
 class HostFactorTable(GrowableFactorTable):
     """Host-resident twin of ``GrowableFactorTable`` — numpy storage, same
@@ -308,3 +375,16 @@ class HostFactorTable(GrowableFactorTable):
         ids_buf[: self._n] = self._ids_buf[: self._n]
         self._ids_buf = ids_buf
         self.capacity = new_cap
+
+    def gather_rows(self, rows: np.ndarray) -> np.ndarray:
+        # host storage: plain numpy fancy-indexing, no device round trip
+        return np.asarray(self.array[np.asarray(rows, np.int64)],
+                          np.float32)
+
+    def commit_rows(self, updated, idx) -> None:
+        idx = np.asarray(idx, np.int64)
+        self.array[idx] = np.asarray(updated, np.float32)[idx]
+
+    def load_rows(self, rows: np.ndarray, values: np.ndarray) -> None:
+        self.array[np.asarray(rows, np.int64)] = np.asarray(
+            values, np.float32)

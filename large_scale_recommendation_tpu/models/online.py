@@ -35,7 +35,6 @@ import threading
 import time
 from typing import Any, Iterable, Iterator
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -57,17 +56,6 @@ from large_scale_recommendation_tpu.obs.registry import get_registry
 from large_scale_recommendation_tpu.obs.trace import get_tracer
 from large_scale_recommendation_tpu.ops import sgd as sgd_ops
 from large_scale_recommendation_tpu.utils.shapes import pow2_pad
-
-
-@jax.jit
-def _commit_rows(cur: jax.Array, src: jax.Array,
-                 idx: jax.Array) -> jax.Array:
-    """Concurrent-apply commit: install ``src``'s rows ``idx`` into the
-    live table ``cur`` — one fused gather+scatter executable instead of
-    two eager dispatches under the apply lock. Compiles once per
-    (capacity, pow2-padded-index) pair, the same bounded shape family
-    as every other table op."""
-    return cur.at[idx].set(src[idx])
 
 
 @dataclasses.dataclass(frozen=True)
@@ -302,8 +290,14 @@ class OnlineMF:
         if ev is not None:  # growth detection costs two attr reads,
             cap_u = self.users.capacity  # journaled runs only
             cap_i = self.items.capacity
-        u_rows = self.users.ensure(ru)
-        i_rows = self.items.ensure(ri)
+        # acquire_rows (data/tables.py tiering seam): a plain table's
+        # acquire IS ensure + no-op release — byte-identical to the
+        # historical path. A TieredFactorStore faults the batch's rows
+        # into its device slot pool, PINS them against eviction for the
+        # train→install window, and returns slot indices; the kernels
+        # below are tier-blind either way.
+        u_rows = self.users.acquire_rows(ru)
+        i_rows = self.items.acquire_rows(ri)
         if ev is not None and (self.users.capacity != cap_u
                                or self.items.capacity != cap_i):
             # capacity doubling is rare and operationally loud (it
@@ -313,29 +307,39 @@ class OnlineMF:
                     users_capacity=int(self.users.capacity),
                     items_capacity=int(self.items.capacity))
 
-        ur, ir, vals, w = sgd_ops.pad_minibatches(
-            u_rows, i_rows, rv, cfg.minibatch_size,
-        )
-
-        # compile-keyed span: each pow2-padded batch length compiles its
-        # own online_train variant — the trace labels that first batch
-        # "compile", steady-state batches "execute"
-        with self._trace.span("online/partial_fit",
-                              key=("online_train", len(ur)),
-                              records=len(ru)) as sp:
-            U, V = sgd_ops.online_train(
-                self.users.array, self.items.array,
-                jnp.asarray(ur), jnp.asarray(ir),
-                jnp.asarray(vals), jnp.asarray(w),
-                updater=self.updater,
-                minibatch=cfg.minibatch_size,
-                iterations=(iterations if iterations is not None
-                            else cfg.iterations_per_batch),
-                collision=cfg.collision_mode,
+        try:
+            ur, ir, vals, w = sgd_ops.pad_minibatches(
+                u_rows, i_rows, rv, cfg.minibatch_size,
             )
-            sp.out = U
-        self.users.array = U
-        self.items.array = V
+
+            # compile-keyed span: each pow2-padded batch length compiles
+            # its own online_train variant — the trace labels that first
+            # batch "compile", steady-state batches "execute"
+            with self._trace.span("online/partial_fit",
+                                  key=("online_train", len(ur)),
+                                  records=len(ru)) as sp:
+                U, V = sgd_ops.online_train(
+                    self.users.array, self.items.array,
+                    jnp.asarray(ur), jnp.asarray(ir),
+                    jnp.asarray(vals), jnp.asarray(w),
+                    updater=self.updater,
+                    minibatch=cfg.minibatch_size,
+                    iterations=(iterations if iterations is not None
+                                else cfg.iterations_per_batch),
+                    collision=cfg.collision_mode,
+                )
+                sp.out = U
+            # install_trained: plain table = whole-array assign (the
+            # historical `self.users.array = U`); tiered store =
+            # scatter of OUR pinned slots into the CURRENT pool binding
+            # (an async prefetch may have rebound the pool since the
+            # snapshot read above — a whole-pool assign would erase its
+            # loads)
+            self.users.install_trained(U, u_rows)
+            self.items.install_trained(V, i_rows)
+        finally:
+            self.users.release_rows(u_rows)
+            self.items.release_rows(i_rows)
         self.step += 1
         if self._obs_on:
             # block so the histogram reads device time, not dispatch
@@ -434,69 +438,82 @@ class OnlineMF:
             if ev is not None:
                 cap_u = self.users.capacity
                 cap_i = self.items.capacity
-            u_rows = self.users.ensure(ru)
-            i_rows = self.items.ensure(ri)
+            # acquire (not ensure): a tiered store faults + PINS the
+            # batch's rows here, so no concurrent eviction can recycle
+            # them between this snapshot and our commit — the slot-pool
+            # analogue of the RowConflictGate's row claim. Lock order:
+            # apply_lock → store lock, everywhere.
+            u_rows = self.users.acquire_rows(ru)
+            i_rows = self.items.acquire_rows(ri)
             grew = ev is not None and (self.users.capacity != cap_u
                                        or self.items.capacity != cap_i)
             U0 = self.users.array  # immutable jax arrays: the snapshot
             V0 = self.items.array  # is two refs, zero copies
-        if grew:
-            ev.emit("online.table_growth", step=self.step,
-                    users_capacity=int(self.users.capacity),
-                    items_capacity=int(self.items.capacity))
+        try:
+            if grew:
+                ev.emit("online.table_growth", step=self.step,
+                        users_capacity=int(self.users.capacity),
+                        items_capacity=int(self.items.capacity))
 
-        ur, ir, vals, w = sgd_ops.pad_minibatches(
-            u_rows, i_rows, rv, cfg.minibatch_size)
+            ur, ir, vals, w = sgd_ops.pad_minibatches(
+                u_rows, i_rows, rv, cfg.minibatch_size)
 
-        with self._trace.span("online/partial_fit",
-                              key=("online_train", len(ur)),
-                              records=len(ru)) as sp:
-            U, V = sgd_ops.online_train(
-                U0, V0,
-                jnp.asarray(ur), jnp.asarray(ir),
-                jnp.asarray(vals), jnp.asarray(w),
-                updater=self.updater,
-                minibatch=cfg.minibatch_size,
-                iterations=(iterations if iterations is not None
-                            else cfg.iterations_per_batch),
-                collision=cfg.collision_mode,
-            )
-            sp.out = U
-        if self.watchdog is not None:
-            # BEFORE the commit and the offset stamp: a tripped batch
-            # never touches the live tables and can never checkpoint
-            self.watchdog.after_batch(self, U, V, u_rows, i_rows)
+            with self._trace.span("online/partial_fit",
+                                  key=("online_train", len(ur)),
+                                  records=len(ru)) as sp:
+                U, V = sgd_ops.online_train(
+                    U0, V0,
+                    jnp.asarray(ur), jnp.asarray(ir),
+                    jnp.asarray(vals), jnp.asarray(w),
+                    updater=self.updater,
+                    minibatch=cfg.minibatch_size,
+                    iterations=(iterations if iterations is not None
+                                else cfg.iterations_per_batch),
+                    collision=cfg.collision_mode,
+                )
+                sp.out = U
+            if self.watchdog is not None:
+                # BEFORE the commit and the offset stamp: a tripped
+                # batch never touches the live tables and can never
+                # checkpoint
+                self.watchdog.after_batch(self, U, V, u_rows, i_rows)
 
-        uniq_u = np.unique(u_rows)
-        uniq_i = np.unique(i_rows)
+            uniq_u = np.unique(u_rows)
+            uniq_i = np.unique(i_rows)
 
-        def touched_idx(rows_uniq: np.ndarray):
-            # pow2-padded with a REPEATED OWN row (never row 0: that
-            # row may belong to another consumer's in-flight claim, and
-            # a duplicate-index scatter of a foreign row's stale value
-            # would corrupt it — duplicates of our own row write our
-            # own value, idempotent)
-            n = len(rows_uniq)
-            idx = np.full(pow2_pad(n), rows_uniq[0], np.int64)
-            idx[:n] = rows_uniq
-            return jnp.asarray(idx)
+            def touched_idx(rows_uniq: np.ndarray):
+                # pow2-padded with a REPEATED OWN row (never row 0:
+                # that row may belong to another consumer's in-flight
+                # claim, and a duplicate-index scatter of a foreign
+                # row's stale value would corrupt it — duplicates of
+                # our own row write our own value, idempotent)
+                n = len(rows_uniq)
+                idx = np.full(pow2_pad(n), rows_uniq[0], np.int64)
+                idx[:n] = rows_uniq
+                return jnp.asarray(idx)
 
-        ju = touched_idx(uniq_u)
-        ji = touched_idx(uniq_i)
-        with self.apply_lock:
-            # fused gather+scatter of OUR rows into the LIVE tables
-            # (maybe grown / maybe carrying other consumers' disjoint
-            # commits since our snapshot) — one executable per table,
-            # dispatched under the lock, drained outside it
-            self.users.array = _commit_rows(self.users.array, U, ju)
-            self.items.array = _commit_rows(self.items.array, V, ji)
-            self.step += 1
-            if offset is not None:
-                # stamped only with the update COMMITTED — the same
-                # invariant the serial path keeps, same checkpoint
-                # contract on top
-                self.consumed_offsets[int(offset[0])] = int(offset[1])
-            committed = self.users.array
+            ju = touched_idx(uniq_u)
+            ji = touched_idx(uniq_i)
+            with self.apply_lock:
+                # fused gather+scatter of OUR rows into the LIVE tables
+                # (maybe grown / maybe carrying other consumers'
+                # disjoint commits since our snapshot) — one executable
+                # per table, dispatched under the lock, drained outside
+                # it. commit_rows is the tiering seam: a plain table
+                # rebinds `.array`; a tiered store scatters into the
+                # CURRENT pool binding under its own lock.
+                self.users.commit_rows(U, ju)
+                self.items.commit_rows(V, ji)
+                self.step += 1
+                if offset is not None:
+                    # stamped only with the update COMMITTED — the same
+                    # invariant the serial path keeps, same checkpoint
+                    # contract on top
+                    self.consumed_offsets[int(offset[0])] = int(offset[1])
+                committed = self.users.array
+        finally:
+            self.users.release_rows(u_rows)
+            self.items.release_rows(i_rows)
         if self._obs_on:
             # graftlint: disable=host-sync  (deliberate, _obs_on-gated)
             committed.block_until_ready()  # outside the lock: blocking
@@ -546,8 +563,11 @@ class OnlineMF:
         seen)`` with the reference's join-drop set exposed."""
         u_rows, u_mask = self.users.rows_for(np.asarray(user_ids))
         i_rows, i_mask = self.items.rows_for(np.asarray(item_ids))
+        # full_table(): a plain table's live array; a tiered store's
+        # merged host view (cold tier + dirty resident slots) — the
+        # rows here are TABLE rows, which only the merged view indexes
         scores = sgd_ops.predict_rows(
-            self.users.array, self.items.array,
+            self.users.full_table(), self.items.full_table(),
             jnp.asarray(u_rows), jnp.asarray(i_rows),
         )
         from large_scale_recommendation_tpu.models.mf import masked_scores
@@ -563,7 +583,7 @@ class OnlineMF:
         if n == 0:
             return float("nan")
         sse = sgd_ops.sse_rows(
-            self.users.array, self.items.array,
+            self.users.full_table(), self.items.full_table(),
             jnp.asarray(u_rows), jnp.asarray(i_rows),
             jnp.asarray(rv), jnp.asarray(mask),
         )
@@ -594,7 +614,7 @@ class OnlineMF:
             n = table.num_rows
             idx = flat_index(table.id_array(),
                              sorted_pair=table.sorted_index())
-            F = jnp.asarray(table.array[:n])
+            F = jnp.asarray(table.full_table()[:n])
             if n == 0:  # flat_index's 1-row empty-vocab shape needs a
                 F = jnp.zeros((1, table.rank), jnp.float32)  # factor row
             return F, idx

@@ -182,6 +182,11 @@ from large_scale_recommendation_tpu.obs.registry import (
     set_registry,
 )
 from large_scale_recommendation_tpu.obs.server import ObsServer
+from large_scale_recommendation_tpu.obs.store import (
+    get_store,
+    set_store,
+    storez,
+)
 from large_scale_recommendation_tpu.obs.trace import (
     NullTracer,
     TraceContext,
@@ -268,6 +273,9 @@ __all__ = [
     "set_disttrace",
     "enable_disttrace",
     "ObsServer",
+    "get_store",
+    "set_store",
+    "storez",
     "OK",
     "DEGRADED",
     "CRITICAL",
@@ -412,6 +420,7 @@ def disable() -> None:
     set_events(None)
     set_lineage(None)
     set_disttrace(None)
+    set_store(None)
     set_registry(_r.NULL_REGISTRY)
     set_tracer(_t.NULL_TRACER)
 

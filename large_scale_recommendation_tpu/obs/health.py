@@ -159,6 +159,23 @@ class HealthMonitor:
 
         self.register(name, MonotonicGrowthCheck(recorder, **kwargs))
 
+    def watch_store_memory(self, recorder, name: str = "store_memory",
+                           **kwargs) -> None:
+        """Register an ``obs.anomaly.MonotonicGrowthCheck`` over the
+        tiered factor store's host-RAM footprint (``tier_host_bytes``,
+        published by ``store.TieredFactorStore`` and auto-sampled into
+        the flight recorder like every registry gauge): the cold tier
+        doubles geometrically with vocabulary, so SUSTAINED unbounded
+        growth — past the log-N doublings a growing id space explains —
+        is the host-side leak/runaway-vocab signature. Absent series
+        (no tiered store) stays OK."""
+        from large_scale_recommendation_tpu.obs.anomaly import (
+            MonotonicGrowthCheck,
+        )
+
+        self.register(name, MonotonicGrowthCheck(
+            recorder, series_prefix="tier_host_bytes", **kwargs))
+
     def watch_quality(self, recorder, source: str = "online",
                       k: int = 10, name_prefix: str = "quality",
                       **kwargs) -> None:

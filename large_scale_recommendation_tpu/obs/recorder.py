@@ -64,19 +64,22 @@ from large_scale_recommendation_tpu.obs.trace import get_tracer
 # model-plane freeze: catalog-swap provenance + the latest quality and
 # data-quality gauge snapshots); version 4 added contention.json (the
 # concurrency-plane freeze: the saturation analyzer's Amdahl window +
-# lock table at incident time). Bundles written before each layer must
+# lock table at incident time); version 5 added store.json (the tiered
+# factor store's freeze: hot/cold occupancy, hit/eviction/write-back
+# counters at incident time). Bundles written before each layer must
 # stay loadable — an ARCHIVED incident bundle is exactly the artifact
 # this module exists to preserve, so the loader validates per the
 # version it finds
-BUNDLE_VERSION = 4
+BUNDLE_VERSION = 5
 BUNDLE_FILES = ("series.json", "events.jsonl", "trace.json", "health.json",
                 "metrics.json", "config.json", "device_memory.json",
-                "lineage.json", "contention.json")
+                "lineage.json", "contention.json", "store.json")
 _BUNDLE_FILES_BY_VERSION = {
-    1: BUNDLE_FILES[:-3],
-    2: BUNDLE_FILES[:-2],
-    3: BUNDLE_FILES[:-1],
-    4: BUNDLE_FILES,
+    1: BUNDLE_FILES[:-4],
+    2: BUNDLE_FILES[:-3],
+    3: BUNDLE_FILES[:-2],
+    4: BUNDLE_FILES[:-1],
+    5: BUNDLE_FILES,
 }
 # env prefixes worth freezing into a bundle — runtime knobs, never secrets
 _ENV_PREFIXES = ("JAX_", "XLA_", "OBS_", "BENCH_", "LIBTPU", "TPU_")
@@ -488,6 +491,19 @@ def write_bundle(directory: str, *, trigger: str, detail: dict | None = None,
     else:
         contention_doc = {"note": "no contention tracker installed",
                           "locks": [], "partitions": {}}
+    # the storage-plane freeze: the tiered factor store's occupancy and
+    # hit/eviction accounting at incident time — "did the working set
+    # thrash?" answerable offline. Same graceful rules as contention.
+    from large_scale_recommendation_tpu.obs.store import get_store
+
+    tiered_store = get_store()
+    if tiered_store is not None:
+        try:
+            store_doc = tiered_store.snapshot()
+        except Exception as e:
+            store_doc = {"note": f"snapshot failed: {e!r}", "tiers": {}}
+    else:
+        store_doc = {"note": "no tiered store installed", "tiers": {}}
     config_doc = {
         "time": created,
         "pid": os.getpid(),
@@ -533,6 +549,7 @@ def write_bundle(directory: str, *, trigger: str, detail: dict | None = None,
         _write_json("device_memory.json", device_memory_doc)
         _write_json("lineage.json", lineage_doc)
         _write_json("contention.json", contention_doc)
+        _write_json("store.json", store_doc)
         _write_json("manifest.json", manifest)
         if os.path.isdir(directory):  # re-dump to the same explicit path
             import shutil
@@ -652,10 +669,22 @@ def load_bundle(directory: str) -> dict:
     else:  # pre-concurrency-plane bundle (version <= 3)
         contention = {"note": f"version-{version} bundle (no contention "
                               "freeze)", "locks": [], "partitions": {}}
+    if "store.json" in required_files:
+        store = _load("store.json")
+        if not isinstance(store, dict):
+            raise ValueError(f"bundle {directory}: store.json is not a "
+                             "JSON object")
+        if "hot" not in store and "note" not in store:
+            raise ValueError(f"bundle {directory}: store.json has "
+                             "neither a hot-tier snapshot nor a note")
+    else:  # pre-storage-plane bundle (version <= 4)
+        store = {"note": f"version-{version} bundle (no store freeze)",
+                 "tiers": {}}
     return {"manifest": manifest, "series": series, "events": events,
             "trace": trace, "health": health, "metrics": metrics,
             "config": config, "device_memory": device_memory,
-            "lineage": lineage, "contention": contention}
+            "lineage": lineage, "contention": contention,
+            "store": store}
 
 
 def validate_bundle(directory: str) -> dict:

@@ -36,6 +36,10 @@ minimal, can expose its live state to a scraper or a ``curl``:
   ``serial_fraction``, projected speedup at 2N), the top contended
   locks, and per-partition busy/blocked shares
   (``scripts/obs_report.py --contention`` renders it).
+- ``/storez`` — the tiered factor store (``store.TieredFactorStore``):
+  hot-tier occupancy (resident/pinned/dirty slots), cold-tier size,
+  hit/miss/eviction/write-back counters and the demand-fault wall —
+  the live answer to "is prefetch keeping the working set hot?".
 - ``/profilez``  — on-demand ``jax.profiler`` capture:
   ``GET /profilez?seconds=N`` records N seconds (capped, default 1)
   of the whole process into an artifact directory (``profile_dir`` or
@@ -303,6 +307,8 @@ class ObsServer(EndpointServerBase):
             return 200, self.criticalpathz()
         if path == "/contentionz":
             return 200, self.contentionz()
+        if path == "/storez":
+            return 200, self.storez()
         if path == "/profilez":
             from urllib.parse import parse_qs
 
@@ -317,7 +323,7 @@ class ObsServer(EndpointServerBase):
                                     "/tracez", "/seriesz", "/eventz",
                                     "/rooflinez", "/lineagez",
                                     "/criticalpathz", "/contentionz",
-                                    "/profilez"]}
+                                    "/storez", "/profilez"]}
         return None
 
     # -- route bodies (shared with tests / in-process callers) --------------
@@ -383,6 +389,15 @@ class ObsServer(EndpointServerBase):
 
         return SaturationAnalyzer(self.contention,
                                   registry=self.registry).snapshot()
+
+    def storez(self) -> dict:
+        """The tiered factor store's live surface (hot/cold occupancy,
+        hit rates, eviction/write-back counters) — the module-default
+        plane (``obs.store``), resolved per request so a store built
+        after the server is still visible."""
+        from large_scale_recommendation_tpu.obs.store import storez
+
+        return storez()
 
     def profilez(self, seconds: float | None = None) -> tuple[int, dict]:
         """(http_status, body) for ``/profilez``: run one N-second

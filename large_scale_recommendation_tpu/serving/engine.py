@@ -145,7 +145,17 @@ class ServingEngine:
     def __init__(self, model: MFModel, k: int = 10, mesh=None,
                  train=None, dtype=None, max_batch: int = 1024,
                  min_bucket: int = 8, slo=None, retrieval=None,
-                 admission: AdmissionController | None = None):
+                 admission: AdmissionController | None = None,
+                 user_store=None):
+        # store-backed user side (store.TieredFactorStore): the engine
+        # holds NO user table — each micro-batch's user rows gather
+        # straight from the tiered store at serve time (serve_rows: hot
+        # rows from the device pool, cold rows from host RAM). A cold
+        # row's transfer wall lands inside the flush, so tier misses
+        # are priced into the SLO tracker like any other serving cost.
+        # The store and the bound model must share one row space (the
+        # store IS the model's user table).
+        self._user_store = user_store
         if max_batch & (max_batch - 1):
             raise ValueError(f"max_batch must be a power of two, "
                              f"got {max_batch}")
@@ -314,15 +324,10 @@ class ServingEngine:
                 model.V, item_mask=item_mask,
                 config=self._retrieval_cfg,
                 partitioner=self.partitioner)
-            U = jnp.asarray(model.U)
-            self._U = (U.astype(jnp.float32)
-                       if U.dtype != jnp.float32 else U)
         else:
             self._catalog = shard_catalog(
                 model.V, self.partitioner, item_mask=item_mask,
                 dtype=self._dtype)
-            U = jnp.asarray(model.U)
-            self._U = U.astype(self._dtype) if U.dtype != self._dtype else U
             n_dev = self.partitioner.num_blocks
             rpb = self._catalog.rows_per_shard
             self._k_local = min(self.k, rpb)
@@ -330,8 +335,20 @@ class ServingEngine:
             self._step = _mesh_topk_step(
                 self.mesh, self._k_local, self._k_out, rpb,
                 donate=mesh_supports_donation(self.mesh))
+        if self._user_store is not None:
+            # store-backed: no engine-held user table at all (the whole
+            # point — the user table may be 10-100× device memory);
+            # _serve_rows gathers each micro-batch through the store
+            self._U = None
+            n_users = int(self._user_store.num_rows)
+        else:
+            U = jnp.asarray(model.U)
+            want = (jnp.float32 if self._retrieval_cfg is not None
+                    else self._dtype)
+            self._U = U.astype(want) if U.dtype != want else U
+            n_users = int(U.shape[0])
         tu, ti = model._train_rows(self._train)
-        self._build_excl = _exclusion_builder(tu, ti, int(U.shape[0]))
+        self._build_excl = _exclusion_builder(tu, ti, n_users)
         self.stats["refreshes"] += 1
         if self._obs_on:
             # version-labeled swap counter: the serving-side proof of
@@ -368,6 +385,11 @@ class ServingEngine:
         coalescing window trades for not thrashing catalog versions);
         the flushed state is bit-equal to applying each delta eagerly
         in arrival order. Returns the (unchanged) current version."""
+        if self._user_store is not None:
+            # the store IS the live user state — serve_rows reads it
+            # directly, so there is nothing to install on the user
+            # side (shipping stale copies could only go backwards)
+            user_rows, U_rows = None, None
         if defer:
             with self._lock:
                 sides = []
@@ -752,12 +774,25 @@ class ServingEngine:
         path). Routes to the exact mesh step or the two-stage fast path
         (``stage1_only`` skips the exact rescore — the admission
         ladder's degraded operating point)."""
+        store = self._user_store
+
+        def gather_users(cu, want_dtype):
+            # store-backed: hot rows from the device pool, cold rows
+            # from the host tier (their transfer wall lands inside this
+            # flush — tier misses price into the SLO automatically);
+            # engine-held table: the historical one-gather path
+            if store is not None:
+                rows = store.serve_rows(cu)
+                return (rows.astype(want_dtype)
+                        if rows.dtype != want_dtype else rows)
+            return self._U[jnp.asarray(cu)]
+
         if self._retriever is not None:
             ret = self._retriever
 
             def base_chunk(cu, c):
                 excl = self._build_excl(cu, c)
-                U_chunk = self._U[jnp.asarray(cu)]
+                U_chunk = gather_users(cu, jnp.float32)
                 return ret.topk(U_chunk, excl, k=self.k,
                                 stage1_only=stage1_only)
 
@@ -772,7 +807,8 @@ class ServingEngine:
 
             def base_chunk(cu, c):
                 excl = self._build_excl(cu, c)
-                return step(self._U[jnp.asarray(cu)], cat.V_sh, cat.w_sh,
+                return step(gather_users(cu, self._dtype),
+                            cat.V_sh, cat.w_sh,
                             jnp.asarray(excl[0]), jnp.asarray(excl[1]),
                             jnp.asarray(excl[2]))
 

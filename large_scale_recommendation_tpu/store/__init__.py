@@ -1,0 +1,17 @@
+"""Tiered factor store: host-RAM cold tier + fixed HBM hot-slot pool.
+
+The user dimension's half of beyond-HBM scale (the rank half is the
+``'model'`` axis, PR 16): the FULL user table lives in host RAM
+(numpy, optionally mmap-backed) and only the hot working set occupies
+a fixed-capacity device slot pool. Training and serving on the tiered
+store are bit-exact with the untiered path at any capacity —
+docs/TIERING.md carries the layout and the argument.
+"""
+
+from large_scale_recommendation_tpu.store.prefetch import StorePrefetcher
+from large_scale_recommendation_tpu.store.tiered import (
+    StoreStats,
+    TieredFactorStore,
+)
+
+__all__ = ["TieredFactorStore", "StoreStats", "StorePrefetcher"]

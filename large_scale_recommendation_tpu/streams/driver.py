@@ -189,6 +189,7 @@ class StreamingDriver:
         # timed telemetry cadence (start_telemetry_export): None until
         # explicitly started — zero threads, zero cost by default
         self._telemetry_task = None
+        self._prefetcher = None
 
     # -- recovery ------------------------------------------------------------
 
@@ -298,8 +299,25 @@ class StreamingDriver:
             self.log, self.partition, start_offset=self.consumed_offset,
             batch_records=cfg.batch_records, follow=follow,
             poll_interval_s=cfg.poll_interval_s)
+        # WAL lookahead for a tiered user store: the feeder announces
+        # each batch's user ids (on_enqueue) and the prefetcher stages
+        # them into the device slot pool while earlier batches train —
+        # the queue's whole lead over the consumer becomes prefetch
+        # distance. Duck-typed on the store's prefetch seam: plain
+        # tables have none, and the wiring collapses to exactly the
+        # historical QueuedSource call.
+        prefetcher = None
+        if hasattr(self._online.users, "prefetch"):
+            from large_scale_recommendation_tpu.store.prefetch import (
+                StorePrefetcher,
+            )
+            prefetcher = StorePrefetcher(self._online.users).start()
+        self._prefetcher = prefetcher
         self._source = QueuedSource(tail, capacity=cfg.queue_capacity,
-                                    policy=cfg.queue_policy)
+                                    policy=cfg.queue_policy,
+                                    on_enqueue=(prefetcher.submit_batch
+                                                if prefetcher is not None
+                                                else None))
         applied = 0
         try:
             for batch in self._source:
@@ -317,9 +335,13 @@ class StreamingDriver:
             # stamped, and persisting it would turn at-least-once into
             # maybe-lost)
             self._source.stop()
+            if prefetcher is not None:
+                prefetcher.stop()
             self._last_stats = self._source.stats.snapshot()
             self._last_stats["dead_letter_buffered"] = len(
                 self._source.dead_letters)
+            if prefetcher is not None:
+                self._last_stats["prefetch"] = prefetcher.snapshot()
         # a feeder fault must surface even when the consume loop exited
         # early (max_batches/stop) before draining to the end-of-stream
         # re-raise inside batches() — and it must land BEFORE the final
@@ -534,8 +556,12 @@ class StreamingDriver:
                   if dirty_items else np.zeros(0, np.int64))
             u_rows, _ = online.users.rows_for(du)
             i_rows, _ = online.items.rows_for(di)
-            U_vals = self._gather_rows(online.users.array, u_rows)
-            V_vals = self._gather_rows(online.items.array, i_rows)
+            # gather_rows (data/tables.py seam): a plain table's
+            # pow2-padded device gather; a tiered store's merged host
+            # gather (pool values win for hot rows) — engine deltas
+            # always ship the LIVE values either way
+            U_vals = online.users.gather_rows(u_rows)
+            V_vals = online.items.gather_rows(i_rows)
             for engine in self._engines:
                 engine.apply_delta(item_rows=i_rows, V_rows=V_vals,
                                    user_rows=u_rows, U_rows=U_vals)

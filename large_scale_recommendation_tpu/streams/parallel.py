@@ -720,16 +720,17 @@ class ParallelIngestRunner:
             ia = (np.fromiter(di, np.int64, len(di)) if di
                   else np.zeros(0, np.int64))
             with self._apply_lock:
-                # id→row mapping AND table refs under the model lock:
+                # id→row mapping AND row values under the model lock:
                 # rows_for reads the sorted-index cache a concurrent
                 # ensure() rebuilds, and the row values gathered must
-                # be the rows the mapping named
+                # be the rows the mapping named. gather_rows is the
+                # tiering seam — a plain table's padded device gather,
+                # a tiered store's merged host gather (apply_lock →
+                # store lock, the fixed order).
                 u_rows, _ = online.users.rows_for(ua)
                 i_rows, _ = online.items.rows_for(ia)
-                U_arr = online.users.array
-                V_arr = online.items.array
-            U_vals = StreamingDriver._gather_rows(U_arr, u_rows)
-            V_vals = StreamingDriver._gather_rows(V_arr, i_rows)
+                U_vals = online.users.gather_rows(u_rows)
+                V_vals = online.items.gather_rows(i_rows)
             for engine in self._engines:
                 engine.apply_delta(item_rows=i_rows, V_rows=V_vals,
                                    user_rows=u_rows, U_rows=U_vals,

@@ -438,11 +438,19 @@ class QueuedSource:
 
     def __init__(self, source, capacity: int = 16, policy: str = "block",
                  validate: bool = True,
-                 dead_letters: DeadLetterBuffer | None = None):
+                 dead_letters: DeadLetterBuffer | None = None,
+                 on_enqueue=None):
         self.source = source
         self.queue = IngestQueue(capacity=capacity, policy=policy,
                                  dead_letters=dead_letters)
         self.validate = validate
+        # on_enqueue(batch): fired on the FEEDER thread after quarantine,
+        # before the (possibly blocking) queue put — the WAL-lookahead
+        # hook (store.StorePrefetcher.submit_batch): the queue's whole
+        # lead over the consumer becomes prefetch distance. Must be
+        # cheap and non-blocking; exceptions are the feeder's death, so
+        # callbacks own their own error handling.
+        self.on_enqueue = on_enqueue
         self._error: BaseException | None = None
         self._thread: threading.Thread | None = None
         # own journal handle (the construction-bind idiom every emitter
@@ -486,6 +494,8 @@ class QueuedSource:
             for batch in self.source:
                 if self.validate:
                     batch = self._quarantine(batch)
+                if self.on_enqueue is not None:
+                    self.on_enqueue(batch)
                 self.queue.put(batch)
                 if self.queue.closed:
                     return

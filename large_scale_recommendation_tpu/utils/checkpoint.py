@@ -647,11 +647,22 @@ def snapshot_online_state(online) -> tuple[dict, dict]:
     arrays = {
         "user_ids": u_ids,
         "item_ids": i_ids,
-        # refs, sliced lazily at write time (np.asarray in
-        # manager.save): immutable device arrays can't tear
-        "U": online.users.array[: len(u_ids)],
-        "V": online.items.array[: len(i_ids)],
+        # snapshot_rows: a plain table returns the immutable device
+        # array's slice ref (can't tear, zero copies, the historical
+        # behavior); a TieredFactorStore returns its merged host view —
+        # cold tier + DIRTY resident slots — under the store lock, so a
+        # dirty slot pool is always durable-complete in the snapshot
+        "U": online.users.snapshot_rows(len(u_ids)),
+        "V": online.items.snapshot_rows(len(i_ids)),
     }
+    # tiered stores also persist their resident set, so a restart
+    # resumes with the hot tier it crashed with (duck-typed: plain
+    # tables have no resident_rows)
+    for key, table in (("user_hot_rows", online.users),
+                       ("item_hot_rows", online.items)):
+        resident = getattr(table, "resident_rows", None)
+        if resident is not None:
+            arrays[key] = np.asarray(resident(), dtype=np.int64)
     return arrays, meta
 
 
@@ -680,18 +691,23 @@ def restore_online_state(manager: CheckpointManager, online,
     in saved order, so row assignment is reproduced exactly), including
     the consumed WAL offsets. Returns the ``Checkpoint`` so drivers can
     read the restored meta (offsets, step) without re-opening it."""
-    import jax.numpy as jnp
-
     ck = manager.restore(step)
-    for key_ids, key_arr, table in (("user_ids", "U", online.users),
-                                    ("item_ids", "V", online.items)):
+    for key_ids, key_arr, key_hot, table in (
+            ("user_ids", "U", "user_hot_rows", online.users),
+            ("item_ids", "V", "item_hot_rows", online.items)):
         ids = ck[key_ids]
         if len(ids) == 0:
             continue
         rows = table.ensure(ids)
-        table.array = table.array.at[jnp.asarray(rows)].set(
-            jnp.asarray(ck[key_arr])
-        )
+        # load_rows: a plain table scatters into the device array (the
+        # historical .at[rows].set); a TieredFactorStore writes the
+        # cold tier and refreshes any already-hot slots
+        table.load_rows(rows, ck[key_arr])
+        # re-warm the snapshot's resident set (tiered stores only, and
+        # only when the checkpoint carries one — older snapshots don't)
+        warm = getattr(table, "warm_rows", None)
+        if warm is not None and key_hot in ck.arrays:
+            warm(ck[key_hot])
     online.step = int(ck.meta.get("step", 0))
     online.consumed_offsets = {
         int(k): int(v) for k, v in ck.meta.get("offsets", {}).items()}

@@ -160,12 +160,28 @@ INGEST_KEYS: dict[str, float] = {
 # per-family round-file prefix + default watch set. The quality family
 # reads the BENCH rounds — quality keys ride inside the bench extras,
 # they just gate under their own watch set (and direction rules).
+# watched keys for the TIERED_r*.json trajectory (the streams_bench
+# tiered-store mode, ISSUE 17): the tiered ingest rate and its
+# fraction of the all-HBM baseline regress when they DROP; the Zipfian
+# hit rate is near-deterministic (same trace, same slot budget), so
+# tight; prefetch stall time and eviction count regress UP — a rising
+# eviction count at fixed capacity means the prefetcher stopped
+# keeping the working set resident.
+TIER_KEYS: dict[str, float] = {
+    "value": 30.0,  # tiered ratings/s headline
+    "tier_hit_rate": 10.0,
+    "tiered_vs_hbm_frac": 30.0,
+    "tier_prefetch_wait_s": 50.0,
+    "tier_evictions": 30.0,
+}
+
 FAMILIES = {
     "bench": ("BENCH", DEFAULT_KEYS),
     "multichip": ("MULTICHIP", MULTICHIP_KEYS),
     "serving": ("SERVING", SERVING_KEYS),
     "quality": ("BENCH", QUALITY_KEYS),
     "ingest": ("INGEST", INGEST_KEYS),
+    "tier": ("TIERED", TIER_KEYS),
 }
 
 # keys where HIGHER is explicitly better (throughputs, achieved
@@ -188,7 +204,12 @@ DEFAULT_HIGHER = ("_ratings_per_s", "_rows_per_s", "_users_per_s",
                   # (already covered by _ratings_per_s — listed so the
                   # direction is pinned even if the key is renamed
                   # without the suffix)
-                  "rank_sharded")
+                  "rank_sharded",
+                  # tiered store (ISSUE 17): the hot-set hit rate
+                  # regresses when it drops. No suffix rule covers it —
+                  # "_hit_rate" shares no pattern with _hr10/_hr_at —
+                  # so the direction is pinned explicitly.
+                  "tier_hit_rate")
 
 # keys where LOWER is better (walls, latencies, pad/layout overheads,
 # compile counts, eval error, ingest→servable critical-path walls)
@@ -220,7 +241,14 @@ DEFAULT_LOWER = ("_wall_s", "_ms_", "time_to_", "_s_p", "_pad_ratio",
                  # device[_m1] and rank_shard_bytes_ratio_vs_m1. Watched
                  # via --key, NOT in MULTICHIP_KEYS: rounds before r07
                  # lack the keys (the PR 10/13 lesson again).
-                 "rank_shard_bytes")
+                 "rank_shard_bytes",
+                 # tiered store (ISSUE 17): time the trainer spends
+                 # stalled on demand faults, and the eviction count at
+                 # fixed slot capacity, both regress UP. Note
+                 # tier_prefetch_wait_s does NOT collide with the
+                 # _per_s HIGHER pattern ("_pre" != "_per") — pinned by
+                 # the direction tests.
+                 "prefetch_wait", "tier_evictions")
 
 _NUM_PAIR = re.compile(
     r'"([A-Za-z_][A-Za-z0-9_]*)":\s*(-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)')

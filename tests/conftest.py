@@ -120,6 +120,10 @@ def null_obs():
         get_registry,
         set_registry,
     )
+    from large_scale_recommendation_tpu.obs.store import (
+        get_store,
+        set_store,
+    )
     from large_scale_recommendation_tpu.obs.trace import (
         get_tracer,
         set_tracer,
@@ -130,6 +134,7 @@ def null_obs():
     prev_ins, prev_lin = get_introspector(), get_lineage()
     prev_dt = get_disttrace()
     prev_ct = get_contention()
+    prev_store = get_store()
     was_running = prev_rec is not None and prev_rec.running
     ins_was_running = prev_ins is not None and prev_ins.running
     ct_was_running = prev_ct is not None and prev_ct.running
@@ -151,6 +156,7 @@ def null_obs():
             prev_ins.start()
     if was_running:
         prev_rec.start()
+    set_store(prev_store)  # a test-built TieredFactorStore must not leak
 
 
 def pytest_sessionfinish(session, exitstatus):

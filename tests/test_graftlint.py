@@ -712,6 +712,79 @@ class TestHostSync:
 
 
 # ---------------------------------------------------------------------------
+# tier-boundary
+# ---------------------------------------------------------------------------
+
+class TestTierBoundary:
+    VIOLATION = """
+        import jax
+        import numpy as np
+        from functools import partial
+
+        @jax.jit
+        def kernel(store, idx):
+            return gather(store, idx)
+
+        def gather(store, idx):
+            return store.cold[idx]
+
+        @partial(jax.jit, static_argnames=("cap",))
+        def opener(cap):
+            return np.memmap("/tmp/x.f32", dtype=np.float32,
+                             mode="w+", shape=(cap, 4))
+    """
+    CLEAN = """
+        import jax
+        import numpy as np
+
+        _scatter = jax.jit(lambda pool, idx, vals: pool.at[idx].set(vals))
+
+        class Store:
+            def serve_rows(self, rows):
+                return np.array(self.cold[rows], np.float32)
+
+            def _alloc(self, cap):
+                return np.memmap("/tmp/x.f32", dtype=np.float32,
+                                 mode="w+", shape=(cap, 4))
+    """
+
+    def test_planted_violation(self, tmp_path):
+        """A jit root reaching ``.cold`` through a helper call, and a
+        partial(jax.jit)-decorated def opening a memmap."""
+        res = lint_src(tmp_path, self.VIOLATION, "tier-boundary")
+        msgs = " | ".join(f.message for f in res.findings)
+        assert len(res.findings) == 2
+        assert "cold-tier" in msgs and "memmap" in msgs
+        assert {f.symbol for f in res.findings} == {"gather", "opener"}
+
+    def test_clean_twin(self, tmp_path):
+        """Host-side cold access (serve path, allocator) is the whole
+        point of the tier — only jit-reachable access is flagged."""
+        res = lint_src(tmp_path, self.CLEAN, "tier-boundary")
+        assert res.findings == []
+
+    def test_jitted_lambda_is_a_root(self, tmp_path):
+        res = lint_src(tmp_path, """
+            import jax
+            _bad = jax.jit(lambda store, i: store.cold[i])
+        """, "tier-boundary")
+        assert len(res.findings) == 1
+        assert res.findings[0].symbol == "<module>"
+
+    def test_inline_suppression(self, tmp_path):
+        res = lint_src(tmp_path, """
+            import jax
+
+            @jax.jit
+            def kernel(store, i):
+                # graftlint: disable=tier-boundary  (fixture)
+                return store.cold[i]
+        """, "tier-boundary")
+        assert res.findings == []
+        assert len(res.suppressed) == 1
+
+
+# ---------------------------------------------------------------------------
 # suppression + baseline workflow
 # ---------------------------------------------------------------------------
 

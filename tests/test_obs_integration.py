@@ -173,6 +173,37 @@ class TestNullPathZeroWork:
         assert null_obs.snapshot()["metrics"] == []
         assert null_obs.to_prometheus() == ""
 
+    def test_tiered_store_binds_null(self, null_obs):
+        """The STORE plane extension of the zero-cost pin: with the
+        null layer installed the tiered store's instruments ARE the
+        shared no-op singletons, `_obs_on` is off (no per-acquire gauge
+        writes), and a full acquire/release/evict cycle records
+        nothing anywhere."""
+        from large_scale_recommendation_tpu.core.initializers import (
+            PseudoRandomFactorInitializer,
+        )
+        from large_scale_recommendation_tpu.obs.store import set_store
+        from large_scale_recommendation_tpu.store import (
+            TieredFactorStore,
+        )
+
+        store = TieredFactorStore(PseudoRandomFactorInitializer(4),
+                                  capacity=32, slot_capacity=8)
+        try:
+            assert store._obs_on is False
+            assert store._m_hit_rate is NULL_INSTRUMENT
+            assert store._m_wait is NULL_INSTRUMENT
+            assert store._m_evictions is NULL_INSTRUMENT
+            assert store._m_host_bytes is NULL_INSTRUMENT
+            for lo in (0, 8):  # second window evicts the first
+                rows = store.acquire_rows(np.arange(lo, lo + 8))
+                store.release_rows(rows)
+            assert store.stats.evictions > 0  # host counters still on
+            assert null_obs.names() == set()
+            assert null_obs.snapshot()["metrics"] == []
+        finally:
+            set_store(None)
+
     def test_driver_and_online_bind_null(self, null_obs, tmp_path):
         log = EventLog(str(tmp_path / "log"))
         _fill_log(log, n_batches=1)

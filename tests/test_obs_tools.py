@@ -792,3 +792,110 @@ class TestRankShardDirection:
                              "--baseline", b, "--current", c,
                              "--key", "rank_sharded_ratings_per_s=30"
                              ]) == 1
+
+
+class TestTierFamily:
+    """``--family tier`` (ISSUE 17): TIERED_r*.json tiered-store
+    rounds gate with the tiered ingest rate / hit rate / fraction-of-
+    HBM higher-is-better and prefetch stall / eviction count
+    LOWER-is-better — the direction/no-collision/not-in-family twins
+    the ingest and rank-shard families carry."""
+
+    BASE = {"tier_hit_rate": 0.93,
+            "tiered_vs_hbm_frac": 0.78,
+            "tier_prefetch_wait_s": 0.4,
+            "tier_evictions": 900.0}
+
+    def _round(self, tmp_path, name, **over):
+        extra = dict(self.BASE, **over)
+        value = extra.pop("value", 400_000.0)
+        p = tmp_path / name
+        p.write_text(json.dumps(  # the real streams_bench line shape
+            {"metric": "tiered ingest ratings/s", "value": value,
+             "unit": "ratings/s", "vs_baseline": 1.0, "extra": extra}))
+        return str(p)
+
+    def test_hit_rate_drop_trips_tight(self, tmp_path, capsys):
+        """Same Zipfian trace + same slot budget → the hit rate is
+        near-deterministic, so its threshold is tight (10%)."""
+        b = self._round(tmp_path, "TIERED_r01.json")
+        c = self._round(tmp_path, "TIERED_r02.json", tier_hit_rate=0.70)
+        rc = regress_main(["--family", "tier",
+                           "--baseline", b, "--current", c])
+        assert rc == 1
+        assert "tier_hit_rate" in capsys.readouterr().out
+
+    def test_prefetch_stall_blowup_trips(self, tmp_path):
+        b = self._round(tmp_path, "TIERED_r01.json")
+        c = self._round(tmp_path, "TIERED_r02.json",
+                        tier_prefetch_wait_s=2.5)
+        assert regress_main(["--family", "tier",
+                             "--baseline", b, "--current", c]) == 1
+
+    def test_eviction_blowup_trips(self, tmp_path):
+        b = self._round(tmp_path, "TIERED_r01.json")
+        c = self._round(tmp_path, "TIERED_r02.json",
+                        tier_evictions=2_000.0)
+        assert regress_main(["--family", "tier",
+                             "--baseline", b, "--current", c]) == 1
+
+    def test_throughput_collapse_trips(self, tmp_path):
+        b = self._round(tmp_path, "TIERED_r01.json")
+        c = self._round(tmp_path, "TIERED_r02.json",
+                        value=200_000.0, tiered_vs_hbm_frac=0.4)
+        assert regress_main(["--family", "tier",
+                             "--baseline", b, "--current", c]) == 1
+
+    def test_across_the_board_improvement_never_trips(self, tmp_path):
+        b = self._round(tmp_path, "TIERED_r01.json")
+        c = self._round(tmp_path, "TIERED_r02.json",
+                        value=600_000.0, tier_hit_rate=0.98,
+                        tiered_vs_hbm_frac=0.95,
+                        tier_prefetch_wait_s=0.05, tier_evictions=100.0)
+        assert regress_main(["--family", "tier",
+                             "--baseline", b, "--current", c]) == 0
+
+    def test_tier_direction_rules(self):
+        from scripts.bench_regress import TIER_KEYS, is_lower_better
+
+        for key in ("tier_prefetch_wait_s", "tier_evictions",
+                    "tier_evictions_total"):
+            assert is_lower_better(key, set()), key
+        for key in ("tier_hit_rate", "tiered_vs_hbm_frac",
+                    "tiered_ratings_per_s"):
+            assert not is_lower_better(key, set()), key
+        assert set(self.BASE) | {"value"} == set(TIER_KEYS)
+
+    def test_tier_no_direction_collision(self):
+        """tier_prefetch_wait_s must not match the _per_s HIGHER
+        pattern ("_pre" != "_per" — DEFAULT_HIGHER wins, so a
+        collision would silently flip the gate's direction), and the
+        higher-is-better tier keys must not match any lower pattern."""
+        from scripts.bench_regress import DEFAULT_HIGHER, DEFAULT_LOWER
+
+        for key in ("tier_prefetch_wait_s", "tier_evictions"):
+            assert not any(pat in key for pat in DEFAULT_HIGHER), key
+        for key in ("tier_hit_rate", "tiered_vs_hbm_frac",
+                    "tiered_ratings_per_s"):
+            assert not any(pat in key for pat in DEFAULT_LOWER), key
+        assert "prefetch_wait" in DEFAULT_LOWER
+        assert "tier_evictions" in DEFAULT_LOWER
+        assert "tier_hit_rate" in DEFAULT_HIGHER
+
+    def test_tier_keys_not_in_other_families(self):
+        """The tier watch set is its own family — tier keys must not
+        leak into the bench/ingest default sets (the PR 10/13 lesson:
+        a default watch key a family's committed rounds can't contain
+        is permanent "missing" noise)."""
+        from scripts.bench_regress import (
+            DEFAULT_KEYS,
+            FAMILIES,
+            INGEST_KEYS,
+            TIER_KEYS,
+        )
+
+        for key in list(DEFAULT_KEYS) + list(INGEST_KEYS):
+            assert "tier" not in key, key
+        prefix, keys = FAMILIES["tier"]
+        assert prefix == "TIERED"
+        assert keys is TIER_KEYS

@@ -14,6 +14,7 @@ from tools.graftlint.checkers.lock_order import LockOrderChecker
 from tools.graftlint.checkers.model_guard import ModelGuardChecker
 from tools.graftlint.checkers.obs_gate import ObsGateChecker
 from tools.graftlint.checkers.sharding_funnel import ShardingFunnelChecker
+from tools.graftlint.checkers.tier_boundary import TierBoundaryChecker
 
 ALL_CHECKERS = {
     c.name: c for c in (
@@ -24,6 +25,7 @@ ALL_CHECKERS = {
         BufferAliasingChecker,
         HostSyncChecker,
         ModelGuardChecker,
+        TierBoundaryChecker,
     )
 }
 

@@ -104,7 +104,14 @@ class HostSyncChecker(Checker):
         queue = [ref for (m, f), ref in funcs.items() if f in HOT_ROOTS]
         queue += [ref for (m, c, f), ref in methods.items()
                   if f in HOT_ROOTS]
+        return self._bfs(index, queue)
+
+    def _bfs(self, index, queue: list[_FuncRef]) -> list[_FuncRef]:
+        """Closure of ``queue`` under same-module, same-class and
+        import-resolved calls — shared by every reachability rule."""
+        funcs, methods = index["funcs"], index["methods"]
         seen = {id(r.node) for r in queue}
+        queue = list(queue)
         out = []
         while queue:
             ref = queue.pop()

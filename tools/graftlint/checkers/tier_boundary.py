@@ -1,0 +1,123 @@
+"""tier-boundary: no cold-tier (host numpy / mmap) access inside
+functions reachable from jit'd kernels.
+
+The tiered factor store (``store/tiered.py``) splits the user table
+into a host-RAM **cold** tier and a device **slot pool**; the pinned
+invariant is that traced code only ever sees the pool. A ``.cold``
+read inside a jit trace would either bake the host array into the
+compiled executable as a constant (silently stale after the next
+write-back) or force a host→device transfer on every dispatch — both
+defeat the tier. Same for ``np.memmap``: a memmap handle captured by a
+trace pins the file mapping for the executable's lifetime.
+
+Roots are everything jit compiles: ``@jax.jit`` / ``@jit`` decorated
+defs, ``@partial(jax.jit, ...)`` decorated defs, and named functions
+or lambdas passed to a ``jax.jit(...)`` call expression. Reachability
+reuses the host-sync BFS (same-module calls, ``self.m()``,
+import-resolved module.attr calls). The fix is always the same: gather
+cold rows into the pool (``acquire_rows`` / ``serve_rows``) on the
+host side, then hand the pool to the kernel.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.graftlint.astutil import expr_key
+from tools.graftlint.checkers.host_sync import HostSyncChecker, _FuncRef
+from tools.graftlint.core import Finding, Project
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """``jit`` / ``jax.jit`` (any dotted tail ending in ``jit``)."""
+    if isinstance(node, ast.Name):
+        return node.id == "jit"
+    if isinstance(node, ast.Attribute):
+        return node.attr == "jit"
+    return False
+
+
+def _is_jit_decorator(node: ast.AST) -> bool:
+    if _is_jit_expr(node):
+        return True
+    if isinstance(node, ast.Call):
+        if _is_jit_expr(node.func):         # @jax.jit(static_argnums=...)
+            return True
+        f = node.func                        # @partial(jax.jit, ...)
+        is_partial = (isinstance(f, ast.Name) and f.id == "partial") or \
+            (isinstance(f, ast.Attribute) and f.attr == "partial")
+        if is_partial and node.args and _is_jit_expr(node.args[0]):
+            return True
+    return False
+
+
+class TierBoundaryChecker(HostSyncChecker):
+    name = "tier-boundary"
+    description = ("cold-tier host array / np.memmap access in functions "
+                   "reachable from jit'd kernels")
+
+    def run(self, project: Project) -> list[Finding]:
+        index = self._index(project)
+        reachable = self._bfs(index, self._jit_roots(project, index))
+        out: list[Finding] = []
+        for ref in reachable:
+            out.extend(self._check_function(ref))
+        return out
+
+    # -- root collection ------------------------------------------------------
+
+    def _jit_roots(self, project: Project, index) -> list[_FuncRef]:
+        funcs, methods = index["funcs"], index["methods"]
+        roots: list[_FuncRef] = []
+        seen: set[int] = set()
+
+        def add(ref: _FuncRef) -> None:
+            if ref is not None and id(ref.node) not in seen:
+                seen.add(id(ref.node))
+                roots.append(ref)
+
+        by_node: dict[int, _FuncRef] = {}
+        for ref in list(funcs.values()) + list(methods.values()):
+            by_node[id(ref.node)] = ref
+
+        for mod in project.modules:
+            mname = mod.rel[:-3].replace("/", ".")
+            for node in ast.walk(mod.tree):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    if any(_is_jit_decorator(d) for d in node.decorator_list):
+                        add(by_node.get(id(node))
+                            or _FuncRef(mod, node, [node]))
+                elif isinstance(node, ast.Call) and _is_jit_expr(node.func):
+                    # jax.jit(fn) / jax.jit(lambda ...): the wrapped
+                    # callable is the compile root
+                    for arg in node.args[:1]:
+                        if isinstance(arg, ast.Lambda):
+                            add(_FuncRef(mod, arg, []))
+                        elif isinstance(arg, ast.Name):
+                            add(funcs.get((mname, arg.id)))
+        return roots
+
+    # -- per-function check ---------------------------------------------------
+
+    def _check_function(self, ref: _FuncRef) -> list[Finding]:
+        out: list[Finding] = []
+        for node in ast.walk(ref.node):
+            if isinstance(node, ast.Attribute) and node.attr == "cold":
+                out.append(self.finding(
+                    ref.mod, node, ref.stack,
+                    "cold-tier host array accessed inside a jit-reachable "
+                    "function — a trace must only see the device slot "
+                    "pool; gather rows on the host first"))
+            elif isinstance(node, ast.Call):
+                f = node.func
+                base = expr_key(f.value) if isinstance(f, ast.Attribute) \
+                    else None
+                if (isinstance(f, ast.Attribute) and f.attr == "memmap"
+                        and base in ("np", "numpy")) or \
+                        (isinstance(f, ast.Name) and f.id == "memmap"):
+                    out.append(self.finding(
+                        ref.mod, node, ref.stack,
+                        "np.memmap opened inside a jit-reachable function "
+                        "— a traced memmap pins the file mapping for the "
+                        "executable's lifetime"))
+        return out
