@@ -40,6 +40,7 @@ from large_scale_recommendation_tpu.core.updaters import (
 from large_scale_recommendation_tpu.core.types import Ratings
 from large_scale_recommendation_tpu.data import blocking
 from large_scale_recommendation_tpu.models.mf import MFModel
+from large_scale_recommendation_tpu.obs.transfers import guard_scope
 from large_scale_recommendation_tpu.ops import sgd as sgd_ops
 
 
@@ -230,7 +231,11 @@ class DSGD:
         while done < cfg.iterations:
             seg = min(segment, cfg.iterations - done)
             with timer.segment(seg) as h:
-                U, V = train(U, V, iterations=seg, t0=done, k=k)
+                # the segment is one jitted superstep loop: every operand
+                # already lives on device, so an armed transfer guard
+                # flags any implicit host round-trip sneaking in
+                with guard_scope("dsgd.fit"):
+                    U, V = train(U, V, iterations=seg, t0=done, k=k)
                 h.out = (U, V)
             done += seg
             if self.watchdog is not None:

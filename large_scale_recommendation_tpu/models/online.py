@@ -54,6 +54,10 @@ from large_scale_recommendation_tpu.obs.contention import named_rlock
 from large_scale_recommendation_tpu.obs.events import get_events
 from large_scale_recommendation_tpu.obs.registry import get_registry
 from large_scale_recommendation_tpu.obs.trace import get_tracer
+from large_scale_recommendation_tpu.obs.transfers import (
+    get_transfers,
+    guard_scope,
+)
 from large_scale_recommendation_tpu.ops import sgd as sgd_ops
 from large_scale_recommendation_tpu.utils.shapes import pow2_pad
 
@@ -311,6 +315,16 @@ class OnlineMF:
             ur, ir, vals, w = sgd_ops.pad_minibatches(
                 u_rows, i_rows, rv, cfg.minibatch_size,
             )
+            ledger = get_transfers()
+            if ledger is not None:
+                # the staged minibatch rides the async dispatch: bytes
+                # counted, wait 0.0 (the caller never blocks on it);
+                # the signature record is what a later retrace diffs
+                ledger.note_transfer("online.minibatch_stage", "h2d",
+                                     int(ur.nbytes + ir.nbytes
+                                         + vals.nbytes + w.nbytes))
+                ledger.observe_call("online_train", self.users.array,
+                                    self.items.array, ur, ir, vals, w)
 
             # compile-keyed span: each pow2-padded batch length compiles
             # its own online_train variant — the trace labels that first
@@ -318,16 +332,20 @@ class OnlineMF:
             with self._trace.span("online/partial_fit",
                                   key=("online_train", len(ur)),
                                   records=len(ru)) as sp:
-                U, V = sgd_ops.online_train(
-                    self.users.array, self.items.array,
-                    jnp.asarray(ur), jnp.asarray(ir),
-                    jnp.asarray(vals), jnp.asarray(w),
-                    updater=self.updater,
-                    minibatch=cfg.minibatch_size,
-                    iterations=(iterations if iterations is not None
-                                else cfg.iterations_per_batch),
-                    collision=cfg.collision_mode,
-                )
+                # armed in debug/CI, shared null context otherwise:
+                # every crossing in the apply body must be an explicit
+                # device_put (the jnp.asarray ships above/below)
+                with guard_scope("online.partial_fit"):
+                    U, V = sgd_ops.online_train(
+                        self.users.array, self.items.array,
+                        jnp.asarray(ur), jnp.asarray(ir),
+                        jnp.asarray(vals), jnp.asarray(w),
+                        updater=self.updater,
+                        minibatch=cfg.minibatch_size,
+                        iterations=(iterations if iterations is not None
+                                    else cfg.iterations_per_batch),
+                        collision=cfg.collision_mode,
+                    )
                 sp.out = U
             # install_trained: plain table = whole-array assign (the
             # historical `self.users.array = U`); tiered store =
@@ -379,8 +397,14 @@ class OnlineMF:
             # callers asked for host vectors — one bulk pull per side)
             return np.asarray(table[jnp.asarray(idx)])[:n]
 
+        ledger = get_transfers()
+        t0 = time.perf_counter() if ledger is not None else 0.0
         u_vecs = gather(U, u_rows[first_u])
         i_vecs = gather(V, i_rows[first_i])
+        if ledger is not None:  # logical bytes: the [:n] truncated pull
+            ledger.note_transfer("online.emit_updates", "d2h",
+                                 int(u_vecs.nbytes + i_vecs.nbytes),
+                                 time.perf_counter() - t0)
         return BatchUpdates(
             user_arrays=(uniq_u.astype(np.int64), u_vecs),
             item_arrays=(uniq_i.astype(np.int64), i_vecs),
@@ -457,20 +481,29 @@ class OnlineMF:
 
             ur, ir, vals, w = sgd_ops.pad_minibatches(
                 u_rows, i_rows, rv, cfg.minibatch_size)
+            ledger = get_transfers()
+            if ledger is not None:  # same staging ledger note as the
+                # serial path: async ship, bytes counted, wait 0.0
+                ledger.note_transfer("online.minibatch_stage", "h2d",
+                                     int(ur.nbytes + ir.nbytes
+                                         + vals.nbytes + w.nbytes))
+                ledger.observe_call("online_train", U0, V0,
+                                    ur, ir, vals, w)
 
             with self._trace.span("online/partial_fit",
                                   key=("online_train", len(ur)),
                                   records=len(ru)) as sp:
-                U, V = sgd_ops.online_train(
-                    U0, V0,
-                    jnp.asarray(ur), jnp.asarray(ir),
-                    jnp.asarray(vals), jnp.asarray(w),
-                    updater=self.updater,
-                    minibatch=cfg.minibatch_size,
-                    iterations=(iterations if iterations is not None
-                                else cfg.iterations_per_batch),
-                    collision=cfg.collision_mode,
-                )
+                with guard_scope("online.partial_fit"):
+                    U, V = sgd_ops.online_train(
+                        U0, V0,
+                        jnp.asarray(ur), jnp.asarray(ir),
+                        jnp.asarray(vals), jnp.asarray(w),
+                        updater=self.updater,
+                        minibatch=cfg.minibatch_size,
+                        iterations=(iterations if iterations is not None
+                                    else cfg.iterations_per_batch),
+                        collision=cfg.collision_mode,
+                    )
                 sp.out = U
             if self.watchdog is not None:
                 # BEFORE the commit and the offset stamp: a tripped
@@ -535,9 +568,18 @@ class OnlineMF:
             pos = np.searchsorted(rows_uniq, rows[first])
             return uniq_ids.astype(np.int64), vals[pos]
 
+        ledger = get_transfers()
+        t0 = time.perf_counter() if ledger is not None else 0.0
+        user_arrays = updates_for(ru, u_rows, uniq_u, U, ju)
+        item_arrays = updates_for(ri, i_rows, uniq_i, V, ji)
+        if ledger is not None:  # logical bytes: the emitted vectors
+            ledger.note_transfer("online.emit_updates", "d2h",
+                                 int(user_arrays[1].nbytes
+                                     + item_arrays[1].nbytes),
+                                 time.perf_counter() - t0)
         return BatchUpdates(
-            user_arrays=updates_for(ru, u_rows, uniq_u, U, ju),
-            item_arrays=updates_for(ri, i_rows, uniq_i, V, ji),
+            user_arrays=user_arrays,
+            item_arrays=item_arrays,
         )
 
     def run(

@@ -72,6 +72,19 @@ package is that instrumentation layer, shared by every runtime tier:
   reconcile against the lineage freshness histogram
   (``/criticalpathz``).
 
+- ``obs.transfers`` — the TRANSFER plane: a named-site device↔host
+  ledger (``transfer_bytes_total{site,dir}`` /
+  ``transfer_wait_s{site}`` at every deliberate crossing — tiered
+  prefetch/write-back/cold gathers, checkpoint pulls/pushes, delta
+  ships, minibatch staging — with per-site effective GB/s joining
+  ``/rooflinez``), scoped ``jax.transfer_guard`` wrappers attributing
+  implicit transfers to sites (``implicit_transfers_total`` — the
+  runtime twin of graftlint's static ``host-sync`` rule), and a
+  retrace watch over the hot jitted kernels (``retrace_total{fn}`` +
+  a bounded ring of signature diffs) feeding a steady-state
+  ``HealthMonitor`` gate (``/transferz``;
+  ``scripts/obs_report.py --transfers``).
+
 Zero-cost when disabled — the design invariant every instrumented hot
 path relies on: the module-level defaults are a ``NullRegistry`` and
 ``NullTracer`` whose instruments are shared stateless singletons (no
@@ -196,6 +209,12 @@ from large_scale_recommendation_tpu.obs.trace import (
     set_tracer,
     validate_chrome_trace,
 )
+from large_scale_recommendation_tpu.obs.transfers import (
+    TransferLedger,
+    get_transfers,
+    set_transfers,
+    transferz,
+)
 
 __all__ = [
     "MetricsRegistry",
@@ -276,6 +295,11 @@ __all__ = [
     "get_store",
     "set_store",
     "storez",
+    "TransferLedger",
+    "get_transfers",
+    "set_transfers",
+    "transferz",
+    "enable_transfers",
     "OK",
     "DEGRADED",
     "CRITICAL",
@@ -397,6 +421,35 @@ def enable_contention(interval_s: float = 1.0, start: bool = True,
     return tracker
 
 
+def enable_transfers(guard: str = "off", watch_hot: bool = True,
+                     **ledger_kwargs) -> TransferLedger:
+    """Install a ``TransferLedger`` as the module-level default — the
+    host↔device TRANSFER plane every deliberate boundary crossing
+    notes into, the implicit-transfer guard the hot paths scope, and
+    the retrace watch over the hot jitted kernels. ``guard`` arms the
+    ``jax.transfer_guard`` scopes (``"off"`` production default /
+    ``"log"`` / ``"disallow"`` for debug+CI); ``watch_hot`` registers
+    the repo's hot jitted functions (``online_train``, ``dsgd_train``,
+    the tiered store's scatter/commit kernels) for retrace watching.
+    Call AFTER ``enable()`` (the ledger binds the live registry for
+    its ``transfer_*``/``retrace_*``/``implicit_*`` instruments;
+    under the null layer it still keeps its own totals and publishes
+    nothing). Returns the ledger (served at ``/transferz`` by any
+    subsequently built ``ObsServer``)."""
+    ledger = TransferLedger(guard_mode=guard, **ledger_kwargs)
+    set_transfers(ledger)
+    if watch_hot:
+        # lazy: obs must not pull the kernel modules at import time
+        from large_scale_recommendation_tpu.ops import sgd as _sgd
+        from large_scale_recommendation_tpu.store import tiered as _tiered
+
+        ledger.watch("online_train", _sgd.online_train)
+        ledger.watch("dsgd_train", _sgd.dsgd_train)
+        ledger.watch("store_scatter_slots", _tiered._scatter_slots)
+        ledger.watch("store_commit_slots", _tiered._commit_slots)
+    return ledger
+
+
 def disable() -> None:
     """Restore the zero-cost defaults: null registry/tracer, no flight
     recorder, event journal, lineage journal or contention tracker,
@@ -421,6 +474,7 @@ def disable() -> None:
     set_lineage(None)
     set_disttrace(None)
     set_store(None)
+    set_transfers(None)
     set_registry(_r.NULL_REGISTRY)
     set_tracer(_t.NULL_TRACER)
 

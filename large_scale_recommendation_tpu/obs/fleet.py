@@ -324,6 +324,72 @@ class FleetAggregator:
             "lock_wait_s_total": sum(r["wait_s"] for r in merged),
         }
 
+    def transfers(self) -> dict:
+        """Scrape every target's ``/transferz`` into one pod transfer
+        view: the site table merged BY SITE NAME (byte/count/wait
+        totals summed — the processes run the same code, so a site
+        names the same crossing fleet-wide; effective GB/s re-derived
+        from the summed totals), pod-total implicit-transfer and
+        retrace counters, and per-host summaries with each host's
+        steady-state window. Targets with no ledger enabled report
+        their note and contribute nothing; unreachable targets are
+        listed — a partial pod view beats none."""
+        per_target = []
+        skipped: list[str] = []
+        site_rows: dict[str, dict] = {}
+        implicit_total = 0
+        retrace_total = 0
+        for url in self.targets:
+            host = _host_of(url)
+            code, body = http_get(url + "/transferz",
+                                  timeout=self.timeout_s)
+            if code != 200:
+                skipped.append(host)
+                continue
+            try:
+                doc = json.loads(body)
+            except json.JSONDecodeError:
+                skipped.append(host)
+                continue
+            retraces = doc.get("retraces") or {}
+            per_target.append({
+                "host": host, "url": url,
+                "note": doc.get("note"),
+                "guard_mode": doc.get("guard_mode"),
+                "implicit_transfers_total":
+                    doc.get("implicit_transfers_total"),
+                "retrace_total": retraces.get("total"),
+                "steady": doc.get("steady"),
+            })
+            implicit_total += doc.get("implicit_transfers_total") or 0
+            retrace_total += retraces.get("total") or 0
+            for site, row in (doc.get("sites") or {}).items():
+                agg = site_rows.setdefault(
+                    site, {"site": site,
+                           "h2d_bytes": 0, "d2h_bytes": 0,
+                           "h2d_count": 0, "d2h_count": 0,
+                           "wait_s": 0.0, "hosts": 0})
+                agg["h2d_bytes"] += row.get("h2d_bytes", 0)
+                agg["d2h_bytes"] += row.get("d2h_bytes", 0)
+                agg["h2d_count"] += row.get("h2d_count", 0)
+                agg["d2h_count"] += row.get("d2h_count", 0)
+                agg["wait_s"] += row.get("wait_s", 0.0)
+                agg["hosts"] += 1
+        for agg in site_rows.values():
+            total = agg["h2d_bytes"] + agg["d2h_bytes"]
+            agg["effective_gbs"] = (total / agg["wait_s"] / 1e9
+                                    if agg["wait_s"] > 0 else None)
+        merged = sorted(site_rows.values(),
+                        key=lambda r: -(r["h2d_bytes"] + r["d2h_bytes"]))
+        return {
+            "time": time.time(),
+            "targets": per_target,
+            "unreachable": skipped,
+            "sites": merged,
+            "implicit_transfers_total": implicit_total,
+            "retrace_total": retrace_total,
+        }
+
     def healthz(self) -> tuple[int, dict]:
         """(http_status, pod report) — 503 iff the pod aggregate is
         CRITICAL (including any unreachable member), the same contract
@@ -350,7 +416,9 @@ class FleetServer(EndpointServerBase):
     CRITICAL — ``/healthz``-only scrape), ``/fleetz`` (full per-target
     view), ``/podtracez`` (the assembled pod timeline — load it at
     https://ui.perfetto.dev), ``/contentionz`` (the pod saturation
-    view: per-host Amdahl summaries + the lock table merged by name).
+    view: per-host Amdahl summaries + the lock table merged by name),
+    ``/transferz`` (the pod transfer view: the site table merged by
+    name + pod implicit/retrace totals).
     Rides ``obs.server.EndpointServerBase``
     — the SAME lifecycle/handler plumbing as the per-process
     ``ObsServer``, so the HTTP semantics cannot drift between the
@@ -381,8 +449,11 @@ class FleetServer(EndpointServerBase):
                 limit=8192 if limit is None else limit)
         if path == "/contentionz":
             return 200, self.aggregator.contention()
+        if path == "/transferz":
+            return 200, self.aggregator.transfers()
         if path == "/":
             return 200, {"routes": ["/metrics", "/healthz", "/fleetz",
-                                    "/podtracez", "/contentionz"],
+                                    "/podtracez", "/contentionz",
+                                    "/transferz"],
                          "targets": self.aggregator.targets}
         return None

@@ -217,6 +217,19 @@ class HealthMonitor:
         self.register(name, FreshnessCheck(lineage, degraded_after_s,
                                            critical_after_s))
 
+    def watch_transfers(self, ledger, name: str = "transfers") -> None:
+        """Register the steady-state transfer/retrace gate
+        (``obs.transfers.TransferSteadyCheck``) over a
+        ``TransferLedger``: OK through warmup, DEGRADED the moment any
+        post-``mark_steady()`` retrace or implicit host↔device
+        transfer lands — both are bug-class events in a correctly
+        pow2-bucketed, explicitly-staged steady state."""
+        from large_scale_recommendation_tpu.obs.transfers import (
+            TransferSteadyCheck,
+        )
+
+        self.register(name, TransferSteadyCheck(ledger))
+
     # -- evaluation ----------------------------------------------------------
 
     def run(self) -> dict:

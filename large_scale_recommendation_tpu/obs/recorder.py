@@ -66,20 +66,25 @@ from large_scale_recommendation_tpu.obs.trace import get_tracer
 # concurrency-plane freeze: the saturation analyzer's Amdahl window +
 # lock table at incident time); version 5 added store.json (the tiered
 # factor store's freeze: hot/cold occupancy, hit/eviction/write-back
-# counters at incident time). Bundles written before each layer must
+# counters at incident time); version 6 added transfers.json (the
+# TRANSFER-plane freeze: per-site host↔device byte/wait totals,
+# implicit-transfer attribution, retrace counts + the signature-diff
+# ring at incident time). Bundles written before each layer must
 # stay loadable — an ARCHIVED incident bundle is exactly the artifact
 # this module exists to preserve, so the loader validates per the
 # version it finds
-BUNDLE_VERSION = 5
+BUNDLE_VERSION = 6
 BUNDLE_FILES = ("series.json", "events.jsonl", "trace.json", "health.json",
                 "metrics.json", "config.json", "device_memory.json",
-                "lineage.json", "contention.json", "store.json")
+                "lineage.json", "contention.json", "store.json",
+                "transfers.json")
 _BUNDLE_FILES_BY_VERSION = {
-    1: BUNDLE_FILES[:-4],
-    2: BUNDLE_FILES[:-3],
-    3: BUNDLE_FILES[:-2],
-    4: BUNDLE_FILES[:-1],
-    5: BUNDLE_FILES,
+    1: BUNDLE_FILES[:-5],
+    2: BUNDLE_FILES[:-4],
+    3: BUNDLE_FILES[:-3],
+    4: BUNDLE_FILES[:-2],
+    5: BUNDLE_FILES[:-1],
+    6: BUNDLE_FILES,
 }
 # env prefixes worth freezing into a bundle — runtime knobs, never secrets
 _ENV_PREFIXES = ("JAX_", "XLA_", "OBS_", "BENCH_", "LIBTPU", "TPU_")
@@ -504,6 +509,22 @@ def write_bundle(directory: str, *, trigger: str, detail: dict | None = None,
             store_doc = {"note": f"snapshot failed: {e!r}", "tiers": {}}
     else:
         store_doc = {"note": "no tiered store installed", "tiers": {}}
+    # the transfer-plane freeze: per-site host↔device byte/wait totals,
+    # implicit-transfer attribution, retrace counts + the diff ring —
+    # "was the stall the boundary?" answerable offline. Same graceful
+    # rules as contention/store.
+    from large_scale_recommendation_tpu.obs.transfers import get_transfers
+
+    transfer_ledger = get_transfers()
+    if transfer_ledger is not None:
+        try:
+            transfers_doc = transfer_ledger.snapshot()
+        except Exception as e:
+            transfers_doc = {"note": f"snapshot failed: {e!r}",
+                             "sites": {}}
+    else:
+        transfers_doc = {"note": "transfer ledger not enabled",
+                         "sites": {}}
     config_doc = {
         "time": created,
         "pid": os.getpid(),
@@ -550,6 +571,7 @@ def write_bundle(directory: str, *, trigger: str, detail: dict | None = None,
         _write_json("lineage.json", lineage_doc)
         _write_json("contention.json", contention_doc)
         _write_json("store.json", store_doc)
+        _write_json("transfers.json", transfers_doc)
         _write_json("manifest.json", manifest)
         if os.path.isdir(directory):  # re-dump to the same explicit path
             import shutil
@@ -680,11 +702,22 @@ def load_bundle(directory: str) -> dict:
     else:  # pre-storage-plane bundle (version <= 4)
         store = {"note": f"version-{version} bundle (no store freeze)",
                  "tiers": {}}
+    if "transfers.json" in required_files:
+        transfers = _load("transfers.json")
+        if not isinstance(transfers, dict):
+            raise ValueError(f"bundle {directory}: transfers.json is not "
+                             "a JSON object")
+        if "sites" not in transfers and "note" not in transfers:
+            raise ValueError(f"bundle {directory}: transfers.json has "
+                             "neither a site table nor a note")
+    else:  # pre-transfer-plane bundle (version <= 5)
+        transfers = {"note": f"version-{version} bundle (no transfer "
+                             "freeze)", "sites": {}}
     return {"manifest": manifest, "series": series, "events": events,
             "trace": trace, "health": health, "metrics": metrics,
             "config": config, "device_memory": device_memory,
             "lineage": lineage, "contention": contention,
-            "store": store}
+            "store": store, "transfers": transfers}
 
 
 def validate_bundle(directory: str) -> dict:

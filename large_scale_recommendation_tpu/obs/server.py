@@ -40,6 +40,12 @@ minimal, can expose its live state to a scraper or a ``curl``:
   hot-tier occupancy (resident/pinned/dirty slots), cold-tier size,
   hit/miss/eviction/write-back counters and the demand-fault wall —
   the live answer to "is prefetch keeping the working set hot?".
+- ``/transferz`` — the host↔device TRANSFER plane
+  (``obs.transfers.TransferLedger``): per-site transfer byte totals
+  and measured effective GB/s, implicit-transfer attribution from the
+  armed guard, retrace counts + the signature-diff ring, and the
+  steady-state window (``scripts/obs_report.py --transfers`` renders
+  it).
 - ``/profilez``  — on-demand ``jax.profiler`` capture:
   ``GET /profilez?seconds=N`` records N seconds (capped, default 1)
   of the whole process into an artifact directory (``profile_dir`` or
@@ -309,6 +315,8 @@ class ObsServer(EndpointServerBase):
             return 200, self.contentionz()
         if path == "/storez":
             return 200, self.storez()
+        if path == "/transferz":
+            return 200, self.transferz()
         if path == "/profilez":
             from urllib.parse import parse_qs
 
@@ -323,7 +331,8 @@ class ObsServer(EndpointServerBase):
                                     "/tracez", "/seriesz", "/eventz",
                                     "/rooflinez", "/lineagez",
                                     "/criticalpathz", "/contentionz",
-                                    "/storez", "/profilez"]}
+                                    "/storez", "/transferz",
+                                    "/profilez"]}
         return None
 
     # -- route bodies (shared with tests / in-process callers) --------------
@@ -361,9 +370,22 @@ class ObsServer(EndpointServerBase):
 
     def rooflinez(self) -> dict:
         if self.introspector is None:
-            return {"note": "no introspector installed "
-                            "(obs.enable_introspection())", "rows": []}
-        return self.introspector.roofline()
+            doc = {"note": "no introspector installed "
+                           "(obs.enable_introspection())", "rows": []}
+        else:
+            doc = self.introspector.roofline()
+        # join the TRANSFER plane's measured per-site GB/s as its own
+        # key: the tier's transfer wall belongs on the same page as
+        # the kernel rooflines, and it is measurable on any backend —
+        # with or without an introspector installed
+        from large_scale_recommendation_tpu.obs.transfers import (
+            get_transfers,
+        )
+
+        ledger = get_transfers()
+        if ledger is not None:
+            doc["transfer_site_gbs"] = ledger.site_gbs()
+        return doc
 
     def lineagez(self) -> dict:
         if self.lineage is None:
@@ -398,6 +420,16 @@ class ObsServer(EndpointServerBase):
         from large_scale_recommendation_tpu.obs.store import storez
 
         return storez()
+
+    def transferz(self) -> dict:
+        """The host↔device transfer plane (per-site byte totals +
+        effective GB/s, implicit-transfer attribution, retrace
+        counts/diffs, the steady-state window) — the module-default
+        plane (``obs.transfers``), resolved per request so a ledger
+        enabled after the server is still visible."""
+        from large_scale_recommendation_tpu.obs.transfers import transferz
+
+        return transferz()
 
     def profilez(self, seconds: float | None = None) -> tuple[int, dict]:
         """(http_status, body) for ``/profilez``: run one N-second

@@ -329,9 +329,13 @@ def run_pipelined_topk(user_rows, *, k: int, k_out: int, n_rows: int,
     pending = None  # (c0, c, v_top, r_top) — one chunk in flight
 
     def drain(p):
+        # pull first, clamp the pad rows host-side: slicing the device
+        # array (pr[:pc]) dispatches dynamic_slice eagerly, which ships
+        # its scalar start indices host->device and trips an armed
+        # transfer guard
         p0, pc, pv, pr = p
-        out_rows[p0:p0 + pc, :k_out] = np.asarray(pr[:pc])
-        out_scores[p0:p0 + pc, :k_out] = np.asarray(pv[:pc])
+        out_rows[p0:p0 + pc, :k_out] = np.asarray(pr)[:pc]
+        out_scores[p0:p0 + pc, :k_out] = np.asarray(pv)[:pc]
 
     for c0 in range(0, n, slice_size):
         cu = user_rows[c0:c0 + slice_size]

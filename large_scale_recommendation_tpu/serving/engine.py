@@ -74,6 +74,10 @@ from large_scale_recommendation_tpu.obs.events import get_events
 from large_scale_recommendation_tpu.obs.lineage import get_lineage
 from large_scale_recommendation_tpu.obs.registry import get_registry
 from large_scale_recommendation_tpu.obs.trace import get_tracer
+from large_scale_recommendation_tpu.obs.transfers import (
+    get_transfers,
+    guard_scope,
+)
 from large_scale_recommendation_tpu.parallel.partitioner import (
     as_partitioner,
 )
@@ -429,8 +433,14 @@ class ServingEngine:
                         f"delta item row {int(item_rows.max())} outside "
                         f"catalog of {n_items} rows — vocab grew; use "
                         f"refresh()")
+                ledger = get_transfers()
+                t0 = time.perf_counter() if ledger is not None else 0.0
                 vals = jnp.asarray(V_rows)
                 idx = jnp.asarray(item_rows)
+                if ledger is not None:  # the delta ship crosses h2d
+                    ledger.note_transfer("serving.delta", "h2d",
+                                         int(vals.nbytes),
+                                         time.perf_counter() - t0)
                 V = jnp.asarray(model.V)
                 model.V = V.at[idx].set(vals.astype(V.dtype))
                 version = catalog_version(model.V)
@@ -446,8 +456,14 @@ class ServingEngine:
                         f"delta user row {int(user_rows.max())} outside "
                         f"table of {n_users} rows — vocab grew; use "
                         f"refresh()")
+                ledger = get_transfers()
+                t0 = time.perf_counter() if ledger is not None else 0.0
                 uvals = jnp.asarray(U_rows)
                 uidx = jnp.asarray(user_rows)
+                if ledger is not None:
+                    ledger.note_transfer("serving.delta", "h2d",
+                                         int(uvals.nbytes),
+                                         time.perf_counter() - t0)
                 U = jnp.asarray(model.U)
                 model.U = U.at[uidx].set(uvals.astype(U.dtype))
                 self._U = self._U.at[uidx].set(
@@ -785,7 +801,11 @@ class ServingEngine:
                 rows = store.serve_rows(cu)
                 return (rows.astype(want_dtype)
                         if rows.dtype != want_dtype else rows)
-            return self._U[jnp.asarray(cu)]
+            # jnp.take (internally jitted) instead of eager advanced
+            # indexing: U[idx] normalizes the index op-by-op, shipping
+            # a scalar constant host→device per chunk — the armed
+            # transfer guard caught exactly that
+            return jnp.take(self._U, jnp.asarray(cu), axis=0)
 
         if self._retriever is not None:
             ret = self._retriever
@@ -842,9 +862,14 @@ class ServingEngine:
                 self._obs.counter("serving_microbatches_total",
                                   bucket=bucket).inc()
 
-        return run_pipelined_topk(
-            user_rows, k=self.k, k_out=k_out, n_rows=n_rows,
-            slice_size=slice_size,
-            bucket_fn=lambda c: min(pow2_pad(c, self.min_bucket),
-                                    slice_size),
-            score_chunk=score_chunk, on_batch=on_batch)
+        # armed in debug/CI, a shared null context otherwise: every
+        # host→device crossing inside the scoring pipeline must be an
+        # explicit device_put (store cold gathers, exclusion ships) —
+        # an implicit one is attributed to this site and counted
+        with guard_scope("serving.serve_rows"):
+            return run_pipelined_topk(
+                user_rows, k=self.k, k_out=k_out, n_rows=n_rows,
+                slice_size=slice_size,
+                bucket_fn=lambda c: min(pow2_pad(c, self.min_bucket),
+                                        slice_size),
+                score_chunk=score_chunk, on_batch=on_batch)

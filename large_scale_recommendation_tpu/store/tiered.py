@@ -49,6 +49,7 @@ from large_scale_recommendation_tpu.data.tables import GrowableFactorTable
 from large_scale_recommendation_tpu.obs.contention import named_rlock
 from large_scale_recommendation_tpu.obs.registry import get_registry
 from large_scale_recommendation_tpu.obs.store import set_store
+from large_scale_recommendation_tpu.obs.transfers import get_transfers
 from large_scale_recommendation_tpu.utils.shapes import (
     next_pow2 as _next_pow2,
     pow2_pad as _pow2_pad,
@@ -216,7 +217,13 @@ class TieredFactorStore(GrowableFactorTable):
         dirty = self._slot_dirty[victims]
         if dirty.any():
             dv = victims[dirty]
+            ledger = get_transfers()
+            t0 = time.perf_counter() if ledger is not None else 0.0
             self.cold[self._slot_row[dv]] = self._gather_pool(dv)
+            if ledger is not None:  # logical bytes: len(dv) == writebacks
+                ledger.note_transfer("store.writeback", "d2h",
+                                     len(dv) * self.rank * 4,
+                                     time.perf_counter() - t0)
             self.stats.writebacks += int(dirty.sum())
         self._row_slot[self._slot_row[victims]] = -1
         self._slot_row[victims] = -1
@@ -299,7 +306,16 @@ class TieredFactorStore(GrowableFactorTable):
                 self._evict(cand[order[:shortfall]])
                 free = np.nonzero(self._slot_row < 0)[0]
         take = free[:need]
+        ledger = get_transfers()
+        t0 = time.perf_counter() if ledger is not None else 0.0
         self._load_slots(take, miss_rows)
+        if ledger is not None:
+            # logical bytes, never pow2-padded: need == misses+installs
+            # on the demand path, == prefetched on the lookahead path,
+            # so the per-site totals reconcile exactly with StoreStats
+            ledger.note_transfer(
+                "store.demand_fault" if demand else "store.prefetch",
+                "h2d", need * self.rank * 4, time.perf_counter() - t0)
         if pin:
             self._slot_pin[take] += 1
         self._slot_dirty[take] = dirty
@@ -431,8 +447,14 @@ class TieredFactorStore(GrowableFactorTable):
         p = _pow2_pad(n)
         sidx = np.zeros(p, np.int64)
         sidx[:n] = np.where(miss, 0, slots)
-        out = pool[jnp.asarray(sidx)]
+        # jnp.take (internally jitted) instead of eager pool[idx]: the
+        # eager gather normalizes the index op-by-op, shipping a scalar
+        # constant host->device per call, which an armed transfer guard
+        # rightly flags
+        out = jnp.take(pool, jnp.asarray(sidx), axis=0)
         if cold_vals is not None:
+            ledger = get_transfers()
+            t0 = time.perf_counter() if ledger is not None else 0.0
             midx = np.nonzero(miss)[0]
             m = len(midx)
             mp = _pow2_pad(m)
@@ -442,6 +464,10 @@ class TieredFactorStore(GrowableFactorTable):
             mv[:m] = cold_vals
             mv[m:] = cold_vals[0]
             out = _scatter_slots(out, jnp.asarray(mi), jnp.asarray(mv))
+            if ledger is not None:  # logical bytes: m == serve_misses
+                ledger.note_transfer("store.serve_cold", "h2d",
+                                     m * self.rank * 4,
+                                     time.perf_counter() - t0)
         return out[:n]
 
     # -- whole-table views (offline/eval + checkpoint) -------------------------

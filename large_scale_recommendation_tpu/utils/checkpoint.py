@@ -29,8 +29,11 @@ import json
 import os
 import re
 import tempfile
+import time
 
 import numpy as np
+
+from large_scale_recommendation_tpu.obs.transfers import get_transfers
 
 # -- non-native dtype round-tripping ------------------------------------------
 # ``np.savez`` silently degrades ml_dtypes arrays (bfloat16 → a void
@@ -644,16 +647,24 @@ def snapshot_online_state(online) -> tuple[dict, dict]:
     meta = {"kind": "online_state", "step": int(online.step),
             "offsets": {str(k): int(v)
                         for k, v in online.consumed_offsets.items()}}
+    # snapshot_rows: a plain table returns the immutable device
+    # array's slice ref (can't tear, zero copies, the historical
+    # behavior); a TieredFactorStore returns its merged host view —
+    # cold tier + DIRTY resident slots — under the store lock, so a
+    # dirty slot pool is always durable-complete in the snapshot
+    ledger = get_transfers()
+    t0 = time.perf_counter() if ledger is not None else 0.0
+    U = online.users.snapshot_rows(len(u_ids))
+    V = online.items.snapshot_rows(len(i_ids))
+    if ledger is not None:  # the snapshot pull crosses device→host
+        ledger.note_transfer("checkpoint.snapshot", "d2h",
+                             int(U.nbytes) + int(V.nbytes),
+                             time.perf_counter() - t0)
     arrays = {
         "user_ids": u_ids,
         "item_ids": i_ids,
-        # snapshot_rows: a plain table returns the immutable device
-        # array's slice ref (can't tear, zero copies, the historical
-        # behavior); a TieredFactorStore returns its merged host view —
-        # cold tier + DIRTY resident slots — under the store lock, so a
-        # dirty slot pool is always durable-complete in the snapshot
-        "U": online.users.snapshot_rows(len(u_ids)),
-        "V": online.items.snapshot_rows(len(i_ids)),
+        "U": U,
+        "V": V,
     }
     # tiered stores also persist their resident set, so a restart
     # resumes with the hot tier it crashed with (duck-typed: plain
@@ -702,7 +713,13 @@ def restore_online_state(manager: CheckpointManager, online,
         # load_rows: a plain table scatters into the device array (the
         # historical .at[rows].set); a TieredFactorStore writes the
         # cold tier and refreshes any already-hot slots
+        ledger = get_transfers()
+        t0 = time.perf_counter() if ledger is not None else 0.0
         table.load_rows(rows, ck[key_arr])
+        if ledger is not None:  # the restore push crosses host→device
+            ledger.note_transfer("checkpoint.restore", "h2d",
+                                 int(ck[key_arr].nbytes),
+                                 time.perf_counter() - t0)
         # re-warm the snapshot's resident set (tiered stores only, and
         # only when the checkpoint carries one — older snapshots don't)
         warm = getattr(table, "warm_rows", None)

@@ -248,7 +248,19 @@ DEFAULT_LOWER = ("_wall_s", "_ms_", "time_to_", "_s_p", "_pad_ratio",
                  # tier_prefetch_wait_s does NOT collide with the
                  # _per_s HIGHER pattern ("_pre" != "_per") — pinned by
                  # the direction tests.
-                 "prefetch_wait", "tier_evictions")
+                 "prefetch_wait", "tier_evictions",
+                 # transfer plane (ISSUE 18): steady-state retraces,
+                 # implicit hot-path transfers, and blocked device↔host
+                 # wait all regress UP — any of them growing means the
+                 # pow2-padding/compile-cache or explicit-staging
+                 # contract broke. Watched via --key on rounds that
+                 # carry them, NOT in any family default set: committed
+                 # rounds predating ISSUE 18 lack the keys (the
+                 # PR 10/13 lesson). "transfer_wait" shares no pattern
+                 # with the _per_s HIGHER rule; "retrace" and
+                 # "implicit_transfers" collide with nothing — pinned
+                 # by the direction tests.
+                 "retrace", "implicit_transfers", "transfer_wait")
 
 _NUM_PAIR = re.compile(
     r'"([A-Za-z_][A-Za-z0-9_]*)":\s*(-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)')

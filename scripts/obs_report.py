@@ -44,6 +44,14 @@ summary (queue wait / train apply / swap lag / flush wait) and the
 newest completed samples. ``src`` is a ``/criticalpathz`` URL or a
 dumped snapshot JSON.
 
+``--transfers <src>`` renders the device↔host transfer plane
+(``obs.transfers.TransferLedger``): the per-site ledger (bytes and
+counts per direction, blocked wait, derived effective GB/s), the
+implicit-transfer attribution, and the retrace ring with its
+human-readable signature diffs. ``src`` is a ``/transferz`` URL, a
+dumped snapshot JSON (the CI steady-state gate writes one), a bundle
+``transfers.json``, or a fleet ``/transferz`` pod aggregate.
+
 ``--contention <src>`` renders the concurrency & saturation plane
 (``obs.contention.SaturationAnalyzer``): the Amdahl window summary
 (consumers, efficiency, Karp–Flatt serial fraction, projected speedup
@@ -520,6 +528,79 @@ def render_contention(doc: dict, tail: int = 20) -> str:
     return "\n".join(out).rstrip()
 
 
+def render_transfers(doc: dict, tail: int = 12) -> str:
+    """Render a ``/transferz`` body (or dumped snapshot / bundle
+    ``transfers.json`` / fleet pod aggregate): the per-site transfer
+    ledger (bytes/counts/wait per direction + derived effective GB/s),
+    the implicit-transfer attribution, and the retrace ring with its
+    signature diffs."""
+    head = ["# device↔host transfers & retraces"]
+    if doc.get("note"):
+        head[0] += f" — note: {doc['note']}"
+    if doc.get("guard_mode"):
+        head.append(f"guard mode: {doc['guard_mode']}")
+    steady = doc.get("steady") or {}
+    if steady:
+        head.append(
+            f"steady state: "
+            f"{'marked' if steady.get('marked') else 'warmup (unmarked)'}"
+            f"; retraces {_fmt(steady.get('retraces'))}, implicit "
+            f"transfers {_fmt(steady.get('implicit_transfers'))}")
+    out = head + [""]
+    sites = doc.get("sites") or {}
+    if sites:
+        rows = [(name,
+                 _fmt(s.get("h2d_bytes")), _fmt(s.get("h2d_count")),
+                 _fmt(s.get("d2h_bytes")), _fmt(s.get("d2h_count")),
+                 _fmt(s.get("wait_s")), _fmt(s.get("effective_gbs")),
+                 _fmt(s.get("hosts")) if "hosts" in s else "-")
+                for name, s in sorted(
+                    sites.items(),
+                    key=lambda kv: -((kv[1].get("h2d_bytes") or 0)
+                                     + (kv[1].get("d2h_bytes") or 0)))]
+        out.extend(format_table(("site", "h2d_B", "h2d_n", "d2h_B",
+                                 "d2h_n", "wait_s", "GB/s", "hosts"),
+                                rows))
+        out.append("")
+    else:
+        out.append("(no transfers recorded — arm "
+                   "obs.enable_transfers() before building the "
+                   "stores/drivers/engines)")
+        out.append("")
+    imp = doc.get("implicit_by_site") or {}
+    out.append(f"implicit transfers: "
+               f"{_fmt(doc.get('implicit_transfers_total'))}"
+               + (" — " + ", ".join(f"{k}={v}"
+                                    for k, v in sorted(imp.items()))
+                  if imp else ""))
+    retr = doc.get("retraces") or {}
+    by_fn = retr.get("by_fn") or {}
+    out.append(f"retraces: {_fmt(retr.get('total', doc.get('retrace_total')))}"
+               + (" — " + ", ".join(f"{k}={v}"
+                                    for k, v in sorted(by_fn.items()))
+                  if by_fn else ""))
+    ring = retr.get("ring") or []
+    if ring:
+        out.append("")
+        rows = [(time.strftime("%H:%M:%S", time.localtime(r["time"])),
+                 r["fn"], str(r["traces"]), str(r["new"]),
+                 "; ".join(r.get("diff", []))[:80])
+                for r in ring[-tail:]]
+        out.extend(format_table(("time", "fn", "traces", "new",
+                                 "signature diff"), rows))
+    targets = doc.get("targets")
+    if targets:  # a fleet pod aggregate: per-host summaries ride along
+        out.append("")
+        rows = [(str(t.get("host")), str(t.get("guard_mode") or "-"),
+                 _fmt(t.get("implicit_transfers_total")),
+                 _fmt(t.get("retrace_total")),
+                 str(t.get("note") or "-"))
+                for t in targets]
+        out.extend(format_table(("host", "guard", "implicit", "retraces",
+                                 "note"), rows))
+    return "\n".join(out).rstrip()
+
+
 QUALITY_PREFIXES = ("eval_", "dataq_", "lineage_")
 
 
@@ -604,6 +685,12 @@ def main(argv=None) -> int:
                          "/contentionz URL, a dumped snapshot JSON, a "
                          "bundle contention.json, or a fleet pod "
                          "aggregate")
+    ap.add_argument("--transfers", default=None, metavar="SRC",
+                    help="render the device↔host transfer ledger "
+                         "(per-site bytes/wait/GB/s + implicit-transfer "
+                         "attribution + retrace ring) from a /transferz "
+                         "URL, a dumped snapshot JSON, a bundle "
+                         "transfers.json, or a fleet pod aggregate")
     args = ap.parse_args(argv)
     if args.bundle is not None:
         print(render_bundle(args.bundle, args.name))
@@ -622,6 +709,9 @@ def main(argv=None) -> int:
         return 0
     if args.contention is not None:
         print(render_contention(fetch_snapshot(args.contention)))
+        return 0
+    if args.transfers is not None:
+        print(render_transfers(fetch_snapshot(args.transfers)))
         return 0
     if args.path is None:
         ap.error("path is required unless --bundle is given")

@@ -81,6 +81,18 @@ gate's keys. The simulated-budget caveat is ALWAYS stamped in
 ``error``: the slot pool caps rows on a CPU host, so the overhead is
 real but HBM pressure is not.
 
+Every mode stamps ``retrace_total`` / ``implicit_transfers_total``
+from the transfer plane (``obs.transfers``, ISSUE 18) into the result
+header, measured over the round's post-warmup streamed phase (the
+ledger resets at each warm/stream boundary — steady state should be
+ZERO on both). TIERED mode additionally stamps measured per-site
+transfer GB/s for both legs (h2d stage-in sites like
+``transfer_store_prefetch_gbs``, the d2h
+``transfer_store_writeback_gbs`` leg) plus the h2d/d2h byte totals —
+honest on CPU: the rates price the host↔"device" copy machinery on
+this backend, not a real PCIe/ICI link (the simulated-budget caveat
+above still rides ``error``).
+
 Env knobs: STREAMS_USERS, STREAMS_ITEMS, STREAMS_RANK, STREAMS_BATCHES,
 STREAMS_BATCH (records per micro-batch), STREAMS_CHECKPOINT_EVERY,
 STREAMS_FSYNC (=1 to fsync appends), STREAMS_FORCE_CPU (=0 for the
@@ -88,9 +100,14 @@ default jax backend). Parallel mode adds: STREAMS_CONSUMERS (the N
 curve; presence selects the mode), STREAMS_FRESHNESS_S (sustained-pass
 duration, 0 skips), STREAMS_RECOVERY (=0 skips the kill/restart pass),
 STREAMS_CONTENTION_OUT (path for the sustained pass's /contentionz
-dump). Tiered mode is selected by STREAMS_TIER_SLOTS (the device slot
-pool size; takes precedence over STREAMS_CONSUMERS) and adds
-STREAMS_TIER_ZIPF_S (the Zipf exponent, default 1.25).
+dump), STREAMS_TRANSFERS_OUT (path for its /transferz dump — fetched
+over the same real socket). Tiered mode is selected by
+STREAMS_TIER_SLOTS (the device slot pool size; takes precedence over
+STREAMS_CONSUMERS) and adds STREAMS_TIER_ZIPF_S (the Zipf exponent,
+default 1.25). STREAMS_TRANSFER_GUARD (off|log|disallow, default off)
+arms the implicit-transfer guard around the hot paths in every mode —
+CI runs the ingest smoke with ``disallow`` so any unplanned host
+round-trip aborts the round instead of hiding in the wall time.
 """
 
 from __future__ import annotations
@@ -121,6 +138,7 @@ def run(num_users=20_000, num_items=5_000, rank=32, n_batches=10,
         seed=0) -> dict:
     import jax
 
+    from large_scale_recommendation_tpu import obs
     from large_scale_recommendation_tpu.core.generators import (
         SyntheticMFGenerator,
     )
@@ -156,6 +174,13 @@ def run(num_users=20_000, num_items=5_000, rank=32, n_batches=10,
         "checkpoint_every": checkpoint_every, "fsync": fsync,
     }
 
+    # the transfer plane rides the round (ISSUE 18): registry stays
+    # NULL (the ledger keeps its own totals), the reset at the durable
+    # warm/stream boundary makes the stamped retrace count a
+    # steady-state number
+    ledger = obs.enable_transfers(
+        guard=os.environ.get("STREAMS_TRANSFER_GUARD", "off"))
+
     with tempfile.TemporaryDirectory() as tmp:
         # ---- log append leg (host-only) -------------------------------
         log = EventLog(os.path.join(tmp, "log"), fsync=fsync)
@@ -190,6 +215,8 @@ def run(num_users=20_000, num_items=5_000, rank=32, n_batches=10,
         # the warm batch occupies [0, warm_end) of the log; skip it so
         # both timed paths train the identical stream
         model.consumed_offsets[0] = warm_end
+        ledger.reset()  # warm/stream boundary: stamps cover the
+        # durable leg only (the headline)
         t0 = time.perf_counter()
         applied = drv.run()
         jax.block_until_ready(model.users.array)
@@ -202,8 +229,12 @@ def run(num_users=20_000, num_items=5_000, rank=32, n_batches=10,
         extra["checkpoints_written"] = tele["checkpoints_written"]
         extra["queue_depth_high_water"] = (
             tele["queue"].get("depth_high_water", 0))
+        ledger.poll_retraces()
+        extra["retrace_total"] = int(ledger.retrace_total)
+        extra["implicit_transfers_total"] = int(ledger.implicit_total)
         log.close()
 
+    obs.disable()
     retention = (total / durable_wall) / (total / bare_wall)
     return {
         "metric": (f"durable ingest ratings/s (log→queue→online_train, "
@@ -269,6 +300,7 @@ def run_tiered(num_users=1_000_000, num_items=4_000, rank=32,
     simulated-budget caveat is stamped in ``error``."""
     import jax
 
+    from large_scale_recommendation_tpu import obs
     from large_scale_recommendation_tpu.core.initializers import (
         PseudoRandomFactorInitializer,
     )
@@ -304,6 +336,13 @@ def run_tiered(num_users=1_000_000, num_items=4_000, rank=32,
                 slot_capacity=slot_capacity)
         return m
 
+    # the transfer plane rides the round (ISSUE 18): registry stays
+    # NULL (the ledger keeps its own totals); each leg's drive resets
+    # the ledger at its warm/stream boundary, so the per-site GB/s
+    # stamps below cover exactly the tiered streamed phase
+    ledger = obs.enable_transfers(
+        guard=os.environ.get("STREAMS_TRANSFER_GUARD", "off"))
+
     def drive(model, log, tmp, name, warm_end) -> float:
         model.partial_fit(warm, emit_updates=False)  # compile warm-up
         drv = StreamingDriver(
@@ -321,6 +360,8 @@ def run_tiered(num_users=1_000_000, num_items=4_000, rank=32,
                 # (the default) ≈ 0.77 on the default geometry
                 queue_capacity=2))
         model.consumed_offsets[0] = warm_end  # both paths skip warm
+        ledger.reset()  # warm/stream boundary (ISSUE 18): cold-start
+        # faults and compile traces are warm-up, not steady state
         t0 = time.perf_counter()
         drv.run()
         jax.block_until_ready(model.users.array)
@@ -372,6 +413,24 @@ def run_tiered(num_users=1_000_000, num_items=4_000, rank=32,
         extra["tier_prefetched_rows"] = int(st.stats.prefetched)
         extra["bit_exact"] = bit_exact
 
+        # measured per-site transfer GB/s for both legs (h2d stage-in
+        # sites, the d2h write-back site) over the tiered streamed
+        # phase, plus the steady-state retrace/guard stamps. CPU
+        # caveat unchanged: the rates price the host<->"device"
+        # copy machinery on this backend, not a real PCIe/ICI link.
+        snap = ledger.snapshot()
+        for site, s in snap["sites"].items():
+            if s["effective_gbs"] is not None:
+                extra["transfer_" + site.replace(".", "_") + "_gbs"] = (
+                    round(s["effective_gbs"], 3))
+        extra["transfer_h2d_bytes"] = sum(
+            s["h2d_bytes"] for s in snap["sites"].values())
+        extra["transfer_d2h_bytes"] = sum(
+            s["d2h_bytes"] for s in snap["sites"].values())
+        extra["retrace_total"] = int(snap["retraces"]["total"])
+        extra["implicit_transfers_total"] = int(
+            snap["implicit_transfers_total"])
+
         # ---- serve both sides over identical requests ----------------
         rng = np.random.default_rng(seed + 1)
         requests = [rng.integers(0, rows, 64).astype(np.int64)
@@ -392,6 +451,7 @@ def run_tiered(num_users=1_000_000, num_items=4_000, rank=32,
         extra["tier_serve_hits"] = int(st.stats.serve_hits)
         extra["tier_serve_misses"] = int(st.stats.serve_misses)
 
+    obs.disable()
     return {
         "metric": (f"tiered ingest ratings/s (user table {rows} rows "
                    f"over {slot_capacity} device slots, "
@@ -500,6 +560,11 @@ def run_parallel(curve=(1, 2, 4, 8), total_users=32_000,
     # keeps its own stats, so the rungs pay only the (µs-scale)
     # wrapped-lock accounting, not the full obs stack.
     tracker = obs.enable_contention(interval_s=0.2)
+    # the transfer plane rides the rungs the same way (ISSUE 18): null
+    # registry, own totals; reset alongside each rung's window so the
+    # round-header stamps cover the largest-N rung's timed drain
+    ledger = obs.enable_transfers(
+        guard=os.environ.get("STREAMS_TRANSFER_GUARD", "off"))
 
     rates: dict[int, float] = {}
     with tempfile.TemporaryDirectory() as tmp:
@@ -517,6 +582,7 @@ def run_parallel(curve=(1, 2, 4, 8), total_users=32_000,
             runner.run(max_batches=1)
             total = n * bpp * batch_records
             tracker.reset_window()
+            ledger.reset()  # warm/stream boundary per rung
             t0 = time.perf_counter()
             applied = runner.run()
             jax.block_until_ready(model.users.array)
@@ -553,6 +619,13 @@ def run_parallel(curve=(1, 2, 4, 8), total_users=32_000,
                   file=sys.stderr)
 
         n_max = max(curve)
+
+        # round-header stamps (ISSUE 18): the largest-N rung's timed
+        # drain, captured BEFORE the recovery/sustained passes (the
+        # sustained pass tears the whole obs stack down in its finally)
+        ledger.poll_retraces()
+        extra["retrace_total"] = int(ledger.retrace_total)
+        extra["implicit_transfers_total"] = int(ledger.implicit_total)
 
         # ---- recovery after a mid-stream kill at N=max --------------
         if recovery:
@@ -736,6 +809,18 @@ def _sustained_pass(tmp, n, total_users, total_items, rank,
         if out_path:
             with open(out_path, "w") as f:
                 _json.dump(contention_doc, f, indent=2)
+        # /transferz over the SAME real socket (ISSUE 18): the round's
+        # ledger survives the obs.enable() above (only disable() clears
+        # it), so the served body carries the sustained pass's live
+        # site totals + the retrace ring — the CI smoke's
+        # transferz_ci.json artifact
+        tout = os.environ.get("STREAMS_TRANSFERS_OUT")
+        if tout:
+            code, tbody = http_get(server.url + "/transferz")
+            with open(tout, "w") as f:
+                f.write(tbody if code == 200
+                        else _json.dumps({"note": f"fetch failed: {code}",
+                                          "sites": {}}))
         stop.set()
         producer.join()
         runner.stop()

@@ -64,6 +64,12 @@ if _OBS_OUT:
     # short-lived driver/server threads; the table is still bounded)
     _OBS_CONTENTION = _obs.enable_contention(interval_s=1.0,
                                              max_threads=512)
+    # transfer plane for the whole session: every deliberate
+    # device<->host crossing the suite drives lands in the per-site
+    # ledger, and the hot jitted fns are watched for retraces. Guard
+    # stays OFF: a tier-1 session legitimately runs eager paths the
+    # hot-loop disallow contract does not cover
+    _OBS_TRANSFERS = _obs.enable_transfers(guard="off")
     _OBS_MONITOR = _health.HealthMonitor()
 
     def _session_check():
@@ -128,12 +134,17 @@ def null_obs():
         get_tracer,
         set_tracer,
     )
+    from large_scale_recommendation_tpu.obs.transfers import (
+        get_transfers,
+        set_transfers,
+    )
 
     prev_r, prev_t = get_registry(), get_tracer()
     prev_j, prev_rec = get_events(), get_recorder()
     prev_ins, prev_lin = get_introspector(), get_lineage()
     prev_dt = get_disttrace()
     prev_ct = get_contention()
+    prev_tf = get_transfers()
     prev_store = get_store()
     was_running = prev_rec is not None and prev_rec.running
     ins_was_running = prev_ins is not None and prev_ins.running
@@ -156,6 +167,7 @@ def null_obs():
             prev_ins.start()
     if was_running:
         prev_rec.start()
+    set_transfers(prev_tf)
     set_store(prev_store)  # a test-built TieredFactorStore must not leak
 
 
@@ -238,6 +250,24 @@ def pytest_sessionfinish(session, exitstatus):
                       indent=2, default=repr)
     except Exception as e:
         with open(os.path.join(_OBS_OUT, "tier1_contention_error.txt"),
+                  "w") as f:
+            f.write(repr(e))
+    # the transfer plane's artifact (ISSUE 18): the suite-long per-site
+    # device<->host ledger plus retrace attribution — which sites moved
+    # how many bytes at what effective rate across the whole tier-1 run
+    try:
+        from large_scale_recommendation_tpu.obs.transfers import (
+            get_transfers as _get_tf,
+        )
+
+        _tf = _get_tf()  # tests swap ledgers; freeze the current one
+        with open(os.path.join(_OBS_OUT, "tier1_transfers.json"),
+                  "w") as f:
+            json.dump(_tf.snapshot() if _tf is not None
+                      else {"note": "no transfer ledger", "sites": {}},
+                      f, indent=2)
+    except Exception as e:
+        with open(os.path.join(_OBS_OUT, "tier1_transfers_error.txt"),
                   "w") as f:
             f.write(repr(e))
     # scrape the session's endpoint server for real: the artifacts below

@@ -237,6 +237,58 @@ class TestObsGate:
         assert res.findings[0].line_text.strip() \
             == "self._lineage.record_swap(2)"
 
+    def test_transfers_getter_planted(self, tmp_path):
+        """``get_transfers`` joined NONE_GETTERS with the transfer
+        plane (PR 18): an ungated ``note_transfer`` is the exact
+        seam-site regression the rule exists to catch."""
+        res = lint_src(tmp_path, """
+            from large_scale_recommendation_tpu.obs.transfers import (
+                get_transfers,
+            )
+
+            def stage_in(slots, rows, rank):
+                ledger = get_transfers()
+                ledger.note_transfer("store.prefetch", "h2d",
+                                     len(rows) * rank * 4)
+        """, "obs-gate")
+        assert [f.rule for f in res.findings] == ["obs-gate"]
+        assert "ledger" in res.findings[0].message
+
+    def test_transfers_seam_site_shape_is_clean(self, tmp_path):
+        """The canonical wired-site shape (resolve once, skip the clock
+        when absent, note after the crossing) must lint clean — this is
+        the exact pattern every production crossing uses."""
+        res = lint_src(tmp_path, """
+            import time
+
+            from large_scale_recommendation_tpu.obs.transfers import (
+                get_transfers,
+            )
+
+            def stage_in(load, slots, rows, rank):
+                ledger = get_transfers()
+                t0 = time.perf_counter() if ledger is not None else 0.0
+                load(slots, rows)
+                if ledger is not None:
+                    ledger.note_transfer("store.prefetch", "h2d",
+                                         len(rows) * rank * 4,
+                                         time.perf_counter() - t0)
+        """, "obs-gate")
+        assert res.findings == []
+
+    def test_transfers_reasoned_suppression_survives(self, tmp_path):
+        res = lint_src(tmp_path, """
+            from large_scale_recommendation_tpu.obs.transfers import (
+                get_transfers,
+            )
+
+            def debug_dump():
+                # debug-only path: a crash here is acceptable
+                get_transfers().snapshot()  # graftlint: disable=obs-gate
+        """, "obs-gate")
+        assert res.findings == []
+        assert [f.rule for f in res.suppressed] == ["obs-gate"]
+
 
 # ---------------------------------------------------------------------------
 # lock-order

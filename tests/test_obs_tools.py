@@ -899,3 +899,70 @@ class TestTierFamily:
         prefix, keys = FAMILIES["tier"]
         assert prefix == "TIERED"
         assert keys is TIER_KEYS
+
+
+class TestTransferDirections:
+    """Transfer-plane keys (ISSUE 18): ``retrace`` /
+    ``implicit_transfers`` / ``transfer_wait`` joined DEFAULT_LOWER —
+    the direction/no-collision/not-in-family twins the tier and ingest
+    families carry. CI watches these via explicit ``--key`` only:
+    committed rounds predating ISSUE 18 lack the keys, and a default
+    watch key the baseline can't contain is permanent "missing" noise
+    (the PR 10/13 lesson)."""
+
+    TRANSFER_KEYS = ("retrace_total", "implicit_transfers_total",
+                     "transfer_wait_s_total")
+
+    def test_transfer_direction_rules(self):
+        from scripts.bench_regress import is_lower_better
+
+        for key in self.TRANSFER_KEYS + ("retraces_steady",
+                                         "transfer_wait_s"):
+            assert is_lower_better(key, set()), key
+
+    def test_transfer_no_direction_collision(self):
+        """None of the transfer keys may match a HIGHER pattern
+        (DEFAULT_HIGHER wins, so a collision silently flips the gate's
+        direction). In particular "transfer_wait" vs the _per_s HIGHER
+        rule: "wait" != "_per_s", pinned here like tier's "_pre"."""
+        from scripts.bench_regress import DEFAULT_HIGHER, DEFAULT_LOWER
+
+        for key in self.TRANSFER_KEYS:
+            assert not any(pat in key for pat in DEFAULT_HIGHER), key
+        for pat in ("retrace", "implicit_transfers", "transfer_wait"):
+            assert pat in DEFAULT_LOWER
+
+    def test_transfer_keys_not_in_family_watch_sets(self):
+        """Explicit --key only — no family default set may carry a
+        transfer key."""
+        from scripts.bench_regress import FAMILIES
+
+        for fam, (_, keys) in FAMILIES.items():
+            for key in keys:
+                for pat in ("retrace", "implicit_transfer",
+                            "transfer_wait"):
+                    assert pat not in key, (fam, key)
+
+    def test_retrace_blowup_trips_via_key(self, tmp_path):
+        """A steady-state retrace regression on a round that carries
+        the key trips through the LOWER direction rule."""
+        for name, retraces in (("TIERED_r01.json", 1.0),
+                               ("TIERED_r02.json", 8.0)):
+            (tmp_path / name).write_text(json.dumps(
+                {"metric": "tiered ingest ratings/s", "value": 400_000.0,
+                 "unit": "ratings/s",
+                 "extra": {"tier_hit_rate": 0.93,
+                           "tiered_vs_hbm_frac": 0.78,
+                           "tier_prefetch_wait_s": 0.4,
+                           "tier_evictions": 900.0,
+                           "retrace_total": retraces,
+                           "implicit_transfers_total": 0.0}}))
+        b = str(tmp_path / "TIERED_r01.json")
+        c = str(tmp_path / "TIERED_r02.json")
+        assert regress_main(["--family", "tier",
+                             "--baseline", b, "--current", c,
+                             "--key", "retrace_total=50"]) == 1
+        # the improvement direction (fewer retraces) never trips
+        assert regress_main(["--family", "tier",
+                             "--baseline", c, "--current", b,
+                             "--key", "retrace_total=50"]) == 0

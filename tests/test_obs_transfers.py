@@ -1,0 +1,655 @@
+"""TRANSFER plane (``obs/transfers.py``, ISSUE 18): the host↔device
+boundary, measured at runtime.
+
+The acceptance pin everything here defends: a REAL tiered
+``StreamingDriver`` run serves ``/transferz`` over a REAL socket with
+per-site transfer byte totals that reconcile EXACTLY against the
+store's own ``StoreStats`` host counters — bytes are logical
+``rows × rank × 4``, never pow2-padded, so the two independently
+maintained ledgers must agree to the byte. Covered: ledger math +
+instrument publication, the implicit-transfer guard in all three modes
+(an armed ``disallow`` scope catches an eager device slice, attributes
+it to the site, counts it, log-onces the stack and re-raises), the
+``allow()`` deliberate-crossing window, retrace watching with
+signature-diff attribution, the steady-state window +
+``HealthMonitor.watch_transfers`` gate, the zero-retrace-after-warmup
+pin on a tiered ingest loop (with a planted non-pow2 positive
+control), ``/transferz`` + the ``/rooflinez`` GB/s join over a real
+``ObsServer``, fleet aggregation, postmortem bundles (v6 write/load,
+archived v5 synthesized), and the zero-cost disabled path.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from large_scale_recommendation_tpu import obs
+from large_scale_recommendation_tpu.core.initializers import (
+    PseudoRandomFactorInitializer,
+)
+from large_scale_recommendation_tpu.core.types import Ratings
+from large_scale_recommendation_tpu.models.online import (
+    OnlineMF,
+    OnlineMFConfig,
+)
+from large_scale_recommendation_tpu.obs.server import ObsServer, http_get
+from large_scale_recommendation_tpu.obs.transfers import (
+    _NULL_CONTEXT,
+    TransferLedger,
+    TransferSteadyCheck,
+    allow_scope,
+    arg_signature,
+    get_transfers,
+    guard_scope,
+    set_transfers,
+    transferz,
+)
+from large_scale_recommendation_tpu.store import TieredFactorStore
+
+RANK = 4
+
+
+@pytest.fixture(autouse=True)
+def _reset_planes():
+    """Tests install ledgers and (via OnlineMF+TieredFactorStore) the
+    STORE plane — never leak either into the next test."""
+    from large_scale_recommendation_tpu.obs.store import (
+        get_store,
+        set_store,
+    )
+
+    prev_tf, prev_store = get_transfers(), get_store()
+    yield
+    set_transfers(prev_tf)
+    set_store(prev_store)
+
+
+def _tiered_model(slots, capacity=64, minibatch=64):
+    cfg = OnlineMFConfig(num_factors=RANK, minibatch_size=minibatch)
+    m = OnlineMF(cfg)
+    m.users = TieredFactorStore(
+        PseudoRandomFactorInitializer(cfg.num_factors,
+                                      scale=cfg.init_scale),
+        capacity=capacity, slot_capacity=slots)
+    return m
+
+
+def _batch_over(users, items=16, seed=0):
+    """One batch touching EXACTLY ``users`` (2 ratings each) — the
+    shape-deterministic unit the steady-state pin alternates."""
+    rng = np.random.default_rng(seed)
+    u = np.repeat(np.asarray(users, np.int64), 2)
+    i = rng.integers(0, items, u.size).astype(np.int64)
+    return Ratings.from_arrays(u, i, rng.random(u.size).astype(np.float32))
+
+
+# --------------------------------------------------------------------------
+# Ledger math + instrument publication
+# --------------------------------------------------------------------------
+
+
+class TestLedgerMath:
+    def test_site_totals_counts_and_effective_gbs(self, null_obs):
+        led = TransferLedger()
+        led.note_transfer("a", "h2d", 1000, 0.25)
+        led.note_transfer("a", "d2h", 500, 0.75)
+        led.note_transfer("b", "h2d", 64)  # async: no measured wait
+        snap = led.snapshot()
+        a = snap["sites"]["a"]
+        assert a["h2d_bytes"] == 1000 and a["d2h_bytes"] == 500
+        assert a["h2d_count"] == 1 and a["d2h_count"] == 1
+        assert a["wait_s"] == pytest.approx(1.0)
+        assert a["effective_gbs"] == pytest.approx(1500 / 1.0 / 1e9)
+        b = snap["sites"]["b"]
+        assert b["h2d_bytes"] == 64 and b["wait_s"] == 0.0
+        assert b["effective_gbs"] is None  # no wait, no rate claim
+        # the /rooflinez join key carries measured sites only
+        assert set(led.site_gbs()) == {"a"}
+
+    def test_direction_and_mode_validation(self, null_obs):
+        led = TransferLedger()
+        with pytest.raises(ValueError, match="direction"):
+            led.note_transfer("a", "sideways", 1)
+        with pytest.raises(ValueError, match="guard_mode"):
+            TransferLedger(guard_mode="bogus")
+
+    def test_counters_publish_to_live_registry(self, null_obs):
+        obs.enable()
+        try:
+            led = TransferLedger()
+            led.note_transfer("tier.x", "h2d", 256, 0.1)
+            led.note_transfer("tier.x", "h2d", 256, 0.1)
+            reg = obs.get_registry()
+            vals = {tuple(sorted(dict(c.labels).items())): c.value
+                    for c in reg.find("transfer_bytes_total")}
+            assert vals[(("dir", "h2d"), ("site", "tier.x"))] == 512
+            assert any(dict(h.labels) == {"site": "tier.x"}
+                       and h.count == 2
+                       for h in reg.find("transfer_wait_s"))
+        finally:
+            obs.disable()
+
+    def test_null_registry_still_totals(self, null_obs):
+        """Under the null layer the ledger keeps its own Python-side
+        totals (benches reconcile against these with obs disabled)."""
+        led = TransferLedger()
+        led.note_transfer("a", "d2h", 128, 0.01)
+        assert led.snapshot()["sites"]["a"]["d2h_bytes"] == 128
+        assert null_obs.snapshot()["metrics"] == []
+
+    def test_reset_zeroes_the_reconciliation_surface(self, null_obs):
+        led = TransferLedger()
+        led.note_transfer("a", "h2d", 100, 0.1)
+        led.mark_steady()
+        led.reset()
+        snap = led.snapshot()
+        assert snap["sites"] == {}
+        assert snap["implicit_transfers_total"] == 0
+        assert snap["retraces"]["total"] == 0
+        assert snap["retraces"]["ring"] == []
+        assert snap["steady"]["retraces"] == 0
+
+
+# --------------------------------------------------------------------------
+# Implicit-transfer guard
+# --------------------------------------------------------------------------
+
+
+class TestGuard:
+    def test_off_mode_hands_out_the_shared_null_context(self, null_obs):
+        led = TransferLedger(guard_mode="off")
+        assert led.guard("x") is _NULL_CONTEXT
+        assert led.allow("x") is _NULL_CONTEXT
+        with led.guard("x"):
+            pass  # no jax import, no allocation, nothing
+
+    def test_disallow_catches_attributes_counts_and_reraises(
+            self, null_obs, capsys):
+        """The trip everything in this PR was armed against: an eager
+        slice of a device array dispatches ``dynamic_slice`` with its
+        scalar start indices shipped host→device — exactly the
+        implicit-transfer bug class the guard exists to catch (it
+        found three real ones in the serving fast path)."""
+        import jax.numpy as jnp
+
+        led = TransferLedger(guard_mode="disallow")
+        x = jnp.arange(8)  # built OUTSIDE the armed scope
+        for _ in range(2):
+            with pytest.raises(Exception, match="transfer"):
+                with led.guard("hot.loop"):
+                    _ = x[:3]
+        assert led.implicit_total == 2
+        snap = led.snapshot()
+        assert snap["implicit_by_site"] == {"hot.loop": 2}
+        # the stack is logged ONCE per site, not per trip
+        err = capsys.readouterr().err
+        assert err.count("logged once per site") == 1
+        assert "hot.loop" in err
+
+    def test_allow_window_opens_a_deliberate_crossing(self, null_obs):
+        import jax.numpy as jnp
+
+        led = TransferLedger(guard_mode="disallow")
+        x = jnp.arange(8)
+        with led.guard("hot.loop"):
+            with led.allow("hot.loop"):  # innermost guard wins
+                _ = x[:3]
+        assert led.implicit_total == 0
+
+    def test_log_mode_defers_to_jax_uncounted(self, null_obs):
+        import jax.numpy as jnp
+
+        led = TransferLedger(guard_mode="log")
+        x = jnp.arange(8)
+        with led.guard("hot.loop"):
+            _ = x[:3]  # jax logs to stderr; nothing raises or counts
+        assert led.implicit_total == 0
+
+    def test_disallow_counts_to_live_registry(self, null_obs):
+        import jax.numpy as jnp
+
+        obs.enable()
+        try:
+            led = TransferLedger(guard_mode="disallow")
+            x = jnp.arange(8)
+            with pytest.raises(Exception, match="transfer"):
+                with led.guard("hot.loop"):
+                    _ = x[:3]
+            hits = [c for c in obs.get_registry().find(
+                "implicit_transfers_total")
+                if dict(c.labels) == {"site": "hot.loop"}]
+            assert hits and hits[0].value == 1
+        finally:
+            obs.disable()
+
+
+# --------------------------------------------------------------------------
+# Retrace watch
+# --------------------------------------------------------------------------
+
+
+class TestRetraceWatch:
+    def _watched(self):
+        import jax
+
+        @jax.jit
+        def f(a):
+            return a * 2
+
+        return f
+
+    def test_baseline_then_new_shape_counts_with_diff(self, null_obs):
+        import jax.numpy as jnp
+
+        f = self._watched()
+        f(jnp.ones(4))  # existing trace: baselined, not a retrace
+        led = TransferLedger()
+        led.watch("toy", f)
+        led.observe_call("toy", jnp.ones(4))
+        assert led.poll_retraces() == 0
+        f(jnp.ones(4))  # cache hit
+        assert led.poll_retraces() == 0
+        led.observe_call("toy", jnp.ones(8))
+        f(jnp.ones(8))  # NEW shape -> retrace
+        assert led.poll_retraces() == 1
+        assert led.retrace_total == 1
+        snap = led.snapshot()
+        assert snap["retraces"]["by_fn"]["toy"] == 1
+        (entry,) = snap["retraces"]["ring"]
+        assert entry["fn"] == "toy" and entry["new"] == 1
+        # the diff names WHICH arg changed, old -> new
+        assert any("arg[0]" in d and "[4]" in d and "[8]" in d
+                   for d in entry["diff"])
+
+    def test_unwatchable_fn_is_skipped_not_fatal(self, null_obs):
+        led = TransferLedger()
+        led.watch("plain", lambda a: a)  # no _cache_size probe
+        assert led.poll_retraces() == 0
+        assert "plain" in led.watched()
+
+    def test_arg_signature_forms(self, null_obs):
+        assert arg_signature(np.zeros((3, 4), np.float32)) == "float32[3,4]"
+        assert arg_signature(7) == "7"
+        assert len(arg_signature("x" * 200)) <= 48
+
+    def test_mark_steady_forgives_warmup_then_gates(self, null_obs):
+        import jax.numpy as jnp
+
+        f = self._watched()
+        led = TransferLedger()
+        led.watch("toy", f)
+        f(jnp.ones(3))  # warmup trace, pending at mark time
+        led.mark_steady()  # polls first: pending traces forgiven
+        st = led.steady_state()
+        assert st["marked"] and st["retraces"] == 0
+        f(jnp.ones(5))  # post-warmup retrace
+        led.poll_retraces()
+        assert led.steady_state()["retraces"] == 1
+
+
+# --------------------------------------------------------------------------
+# Plane lifecycle + the zero-cost disabled path
+# --------------------------------------------------------------------------
+
+
+class TestPlaneLifecycle:
+    def test_default_is_none_and_transferz_notes(self, null_obs):
+        assert get_transfers() is None
+        doc = transferz()
+        assert "enable_transfers" in doc["note"] and doc["sites"] == {}
+
+    def test_disabled_scopes_are_the_shared_singleton(self, null_obs):
+        """The TestNullPathZeroWork pin for this plane: with no ledger
+        installed BOTH hot-path helpers hand out the one module-level
+        null context — no allocation, no jax import, per call."""
+        assert guard_scope("a") is _NULL_CONTEXT
+        assert allow_scope("b") is _NULL_CONTEXT
+        with guard_scope("a"):
+            pass
+
+    def test_enable_transfers_installs_watches_and_disable_clears(
+            self, null_obs):
+        led = obs.enable_transfers()
+        assert led is get_transfers()
+        assert led.guard_mode == "off"
+        # the repo's hot jitted fns are watched by default
+        assert led.watched() == ["dsgd_train", "online_train",
+                                 "store_commit_slots",
+                                 "store_scatter_slots"]
+        obs.disable()
+        assert get_transfers() is None
+
+    def test_enable_without_watch_hot_watches_nothing(self, null_obs):
+        led = obs.enable_transfers(watch_hot=False)
+        assert led.watched() == []
+
+
+# --------------------------------------------------------------------------
+# Server routes, roofline join, health gate
+# --------------------------------------------------------------------------
+
+
+class TestServerAndHealth:
+    def test_transferz_route_and_index(self, null_obs):
+        obs.enable()
+        try:
+            led = obs.enable_transfers(watch_hot=False)
+            led.note_transfer("tier.demo", "h2d", 4096, 0.01)
+            with ObsServer() as server:
+                code, body = http_get(server.url + "/transferz")
+                icode, ibody = http_get(server.url + "/")
+        finally:
+            obs.disable()
+        assert code == 200
+        doc = json.loads(body)
+        assert doc["sites"]["tier.demo"]["h2d_bytes"] == 4096
+        assert doc["guard_mode"] == "off"
+        assert "/transferz" in json.loads(ibody)["routes"]
+
+    def test_transferz_without_ledger_is_a_note(self, null_obs):
+        obs.enable()
+        try:
+            with ObsServer() as server:
+                code, body = http_get(server.url + "/transferz")
+        finally:
+            obs.disable()
+        assert code == 200
+        assert "enable_transfers" in json.loads(body)["note"]
+
+    def test_rooflinez_joins_measured_site_gbs(self, null_obs):
+        obs.enable()
+        try:
+            led = obs.enable_transfers(watch_hot=False)
+            led.note_transfer("tier.demo", "h2d", 10_000_000, 0.01)
+            with ObsServer() as server:
+                code, body = http_get(server.url + "/rooflinez")
+        finally:
+            obs.disable()
+        assert code == 200
+        doc = json.loads(body)
+        assert doc["transfer_site_gbs"]["tier.demo"] == pytest.approx(
+            10_000_000 / 0.01 / 1e9)
+
+    def test_health_monitor_gates_on_the_steady_window(self, null_obs):
+        import jax
+
+        from large_scale_recommendation_tpu.obs.health import (
+            HealthMonitor,
+        )
+
+        @jax.jit
+        def f(a):
+            return a + 1
+
+        led = TransferLedger()
+        led.watch("toy", f)
+        mon = HealthMonitor()
+        mon.watch_transfers(led)
+        report = mon.run()  # warmup: mark_steady() not called yet
+        assert report["checks"]["transfers"]["status"] == "ok"
+        f(np.ones(2, np.float32))
+        led.mark_steady()
+        assert mon.run()["checks"]["transfers"]["status"] == "ok"
+        f(np.ones(6, np.float32))  # post-warmup retrace
+        report = mon.run()
+        assert report["checks"]["transfers"]["status"] == "degraded"
+        assert report["status"] == "degraded"
+
+    def test_steady_check_degrades_on_implicit_transfer(self, null_obs):
+        import jax.numpy as jnp
+
+        led = TransferLedger(guard_mode="disallow")
+        led.mark_steady()
+        x = jnp.arange(8)
+        with pytest.raises(Exception, match="transfer"):
+            with led.guard("hot.loop"):
+                _ = x[:3]
+        assert TransferSteadyCheck(led)().status == "degraded"
+
+
+# --------------------------------------------------------------------------
+# Fleet aggregation
+# --------------------------------------------------------------------------
+
+
+class TestFleet:
+    def test_pod_view_merges_sites_by_name(self, null_obs):
+        from large_scale_recommendation_tpu.obs.fleet import (
+            FleetAggregator,
+            FleetServer,
+        )
+
+        obs.enable()
+        try:
+            led = obs.enable_transfers(watch_hot=False)
+            led.note_transfer("tier.demo", "h2d", 100, 0.5)
+            with ObsServer() as s1, ObsServer() as s2:
+                # two real sockets over the one process ledger: the
+                # merge-by-site-name contract is what's under test
+                view = FleetAggregator([s1.url, s2.url]).transfers()
+                with FleetServer(FleetAggregator([s1.url])) as fleet:
+                    code, body = http_get(fleet.url + "/transferz")
+        finally:
+            obs.disable()
+        (row,) = [r for r in view["sites"] if r["site"] == "tier.demo"]
+        assert row["hosts"] == 2
+        assert row["h2d_bytes"] == 200  # summed across members
+        assert row["effective_gbs"] == pytest.approx(200 / 1.0 / 1e9)
+        assert view["implicit_transfers_total"] == 0
+        assert [t["guard_mode"] for t in view["targets"]] == ["off", "off"]
+        assert code == 200
+        assert json.loads(body)["sites"][0]["site"] == "tier.demo"
+
+    def test_unreachable_member_is_listed_not_fatal(self, null_obs):
+        from large_scale_recommendation_tpu.obs.fleet import (
+            FleetAggregator,
+        )
+
+        obs.enable()
+        try:
+            obs.enable_transfers(watch_hot=False)
+            with ObsServer() as s1:
+                dead = "http://127.0.0.1:1"
+                view = FleetAggregator([s1.url, dead],
+                                       timeout_s=3.0).transfers()
+        finally:
+            obs.disable()
+        assert view["unreachable"] == ["127.0.0.1:1"]
+        assert len(view["targets"]) == 1
+
+
+# --------------------------------------------------------------------------
+# Postmortem bundles: v6 round-trip, archived v5 synthesized
+# --------------------------------------------------------------------------
+
+
+class TestBundle:
+    def test_v6_bundle_carries_transfers_and_v5_stays_loadable(
+            self, null_obs, tmp_path):
+        import os
+
+        from large_scale_recommendation_tpu.obs.recorder import (
+            BUNDLE_VERSION,
+            load_bundle,
+            write_bundle,
+        )
+
+        obs.enable()
+        obs.enable_flight_recorder(interval_s=0.05)
+        try:
+            led = obs.enable_transfers(watch_hot=False)
+            led.note_transfer("tier.demo", "d2h", 2048, 0.02)
+            path = write_bundle(str(tmp_path / "b"), trigger="manual")
+            docs = load_bundle(path)
+            assert BUNDLE_VERSION == 6
+            assert docs["manifest"]["bundle_version"] == 6
+            assert docs["transfers"]["sites"]["tier.demo"][
+                "d2h_bytes"] == 2048
+            # an archived version-5 bundle (pre-transfer-plane) stays
+            # loadable with the note synthesized
+            manifest_path = str(tmp_path / "b" / "manifest.json")
+            with open(manifest_path) as f:
+                manifest = json.load(f)
+            manifest["bundle_version"] = 5
+            manifest["files"] = [x for x in manifest["files"]
+                                 if x != "transfers.json"]
+            with open(manifest_path, "w") as f:
+                json.dump(manifest, f)
+            os.unlink(str(tmp_path / "b" / "transfers.json"))
+            docs5 = load_bundle(path)
+            assert docs5["transfers"]["sites"] == {}
+            assert "version-5" in docs5["transfers"]["note"]
+        finally:
+            obs.disable()
+
+
+# --------------------------------------------------------------------------
+# The acceptance pins: e2e reconciliation + steady-state zero-retrace
+# --------------------------------------------------------------------------
+
+
+class TestE2EReconciliation:
+    def test_tiered_driver_run_reconciles_transferz_against_store_stats(
+            self, null_obs, tmp_path):
+        """THE tentpole pin: a real tiered StreamingDriver run (demand
+        faults, evictions with write-back, periodic checkpoints), then
+        ``/transferz`` fetched over a real socket must carry per-site
+        byte totals that reconcile EXACTLY — to the byte — against the
+        store's own ``StoreStats`` host counters. Both ledgers count
+        logical ``rows × rank × 4``; any drift means a seam site is
+        missing, double-counting, or counting padded bytes."""
+        from large_scale_recommendation_tpu.core.generators import (
+            SyntheticMFGenerator,
+        )
+        from large_scale_recommendation_tpu.streams import (
+            EventLog,
+            GeneratorSource,
+            StreamingDriver,
+            StreamingDriverConfig,
+            pump_to_log,
+        )
+
+        obs.enable()
+        try:
+            led = obs.enable_transfers()
+            log = EventLog(str(tmp_path / "log"), fsync=False)
+            gen = SyntheticMFGenerator(num_users=200, num_items=40,
+                                       rank=RANK, seed=3)
+            pump_to_log(GeneratorSource(gen, 80, num_batches=6), log)
+            # 96 slots: >= any micro-batch's <=80-row working set,
+            # << the 200-row universe -> evictions + write-backs real
+            m = _tiered_model(slots=96, capacity=256)
+            drv = StreamingDriver(m, log, str(tmp_path / "ckpt"),
+                                  config=StreamingDriverConfig(
+                                      batch_records=80,
+                                      checkpoint_every=2))
+            drv.resume()
+            assert drv.run() == 6
+            st = m.users
+            assert st.stats.evictions > 0 and st.stats.writebacks > 0
+            # exercise the two remaining store seams with KNOWN deltas
+            st.prefetch(np.arange(50))
+            st.serve_rows(np.arange(min(60, st.num_rows)))
+            with ObsServer() as server:
+                code, body = http_get(server.url + "/transferz")
+        finally:
+            obs.disable()
+        assert code == 200
+        sites = json.loads(body)["sites"]
+        row_bytes = RANK * 4
+        s = st.stats
+        assert sites["store.demand_fault"]["h2d_bytes"] == (
+            (s.misses + s.installs) * row_bytes)
+        assert sites["store.writeback"]["d2h_bytes"] == (
+            s.writebacks * row_bytes)
+        assert sites["store.prefetch"]["h2d_bytes"] == (
+            s.prefetched * row_bytes)
+        assert sites["store.serve_cold"]["h2d_bytes"] == (
+            s.serve_misses * row_bytes)
+        # the checkpoint seam fired (cadence 2 over 6 batches) and the
+        # staging seam saw every micro-batch
+        assert sites["checkpoint.snapshot"]["d2h_bytes"] > 0
+        assert sites["checkpoint.snapshot"]["d2h_count"] >= 3
+        assert sites["online.minibatch_stage"]["h2d_count"] == 6
+
+    def test_checkpoint_restore_notes_the_push(self, null_obs,
+                                               tmp_path):
+        from large_scale_recommendation_tpu.streams import (
+            EventLog,
+            StreamingDriver,
+            StreamingDriverConfig,
+        )
+        from large_scale_recommendation_tpu.streams import (
+            GeneratorSource,
+            pump_to_log,
+        )
+        from large_scale_recommendation_tpu.core.generators import (
+            SyntheticMFGenerator,
+        )
+
+        led = obs.enable_transfers()
+        log = EventLog(str(tmp_path / "log"), fsync=False)
+        gen = SyntheticMFGenerator(num_users=40, num_items=16,
+                                   rank=RANK, seed=1)
+        pump_to_log(GeneratorSource(gen, 60, num_batches=2), log)
+        d1 = StreamingDriver(_tiered_model(slots=64), log,
+                             str(tmp_path / "ckpt"),
+                             config=StreamingDriverConfig(
+                                 batch_records=60))
+        d1.resume()
+        d1.run()
+        led.reset()  # only the restore below may note from here on
+        d2 = StreamingDriver(_tiered_model(slots=64), log,
+                             str(tmp_path / "ckpt"),
+                             config=StreamingDriverConfig(
+                                 batch_records=60))
+        assert d2.resume()
+        snap = led.snapshot()
+        assert snap["sites"]["checkpoint.restore"]["h2d_bytes"] > 0
+
+
+class TestSteadyStateZeroRetrace:
+    def test_tiered_ingest_is_retrace_free_after_warmup(self, null_obs):
+        """Satellite-1 pin: an alternating two-set tiered ingest loop
+        (every batch faults EXACTLY 32 rows, evicting the other set,
+        pow2 pads constant) compiles everything during warmup — after
+        ``mark_steady()`` the SAME loop must trace nothing new and the
+        armed ``disallow`` guard must stay silent. Then the planted
+        positive control: one NON-pow2 call into the watched scatter
+        kernel, which the detector must count and attribute."""
+        import jax.numpy as jnp
+
+        from large_scale_recommendation_tpu.store.tiered import (
+            _scatter_slots,
+        )
+
+        led = obs.enable_transfers(guard="disallow")
+        m = _tiered_model(slots=32)
+        set_a = np.arange(0, 32)
+        set_b = np.arange(32, 64)
+        for k in range(4):  # warmup: install, evict, and re-fault paths
+            m.partial_fit(_batch_over(set_a if k % 2 == 0 else set_b,
+                                      seed=k), emit_updates=False)
+        led.mark_steady()
+        for k in range(4, 10):  # steady: identical shapes, armed guard
+            m.partial_fit(_batch_over(set_a if k % 2 == 0 else set_b,
+                                      seed=k), emit_updates=False)
+        led.poll_retraces()
+        st = led.steady_state()
+        assert st["retraces"] == 0, led.recent_retraces()
+        assert st["implicit_transfers"] == 0
+        assert led.implicit_total == 0
+        # eviction churn really happened under the steady window
+        assert m.users.stats.evictions > 0
+        # planted positive control: a 17-row (non-pow2) scatter is a
+        # shape no pow2-disciplined caller ever dispatches -- the
+        # detector must count it as a steady-state retrace
+        pool = m.users._pool
+        _scatter_slots(pool, jnp.asarray(np.zeros(17, np.int64)),
+                       jnp.asarray(np.zeros((17, RANK), np.float32)))
+        assert led.poll_retraces() >= 1
+        assert led.steady_state()["retraces"] >= 1
+        snap = led.snapshot()
+        assert snap["retraces"]["by_fn"]["store_scatter_slots"] >= 1
+        assert snap["retraces"]["ring"][-1]["fn"] == "store_scatter_slots"

@@ -37,7 +37,7 @@ from tools.graftlint.core import Checker, Finding, ModuleInfo, Project
 # return null objects and need no gate)
 NONE_GETTERS = {
     "get_events", "get_recorder", "get_lineage", "get_disttrace",
-    "get_contention", "get_introspector",
+    "get_contention", "get_introspector", "get_transfers",
 }
 
 
