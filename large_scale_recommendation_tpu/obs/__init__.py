@@ -85,6 +85,18 @@ package is that instrumentation layer, shared by every runtime tier:
   ``HealthMonitor`` gate (``/transferz``;
   ``scripts/obs_report.py --transfers``).
 
+- ``obs.budget`` — the ROLLOUT plane: a multi-window error-budget
+  engine (the SRE fast/slow burn-rate pair,
+  ``slo_burn_rate{window=}``, ``error_budget_remaining``), a
+  per-``catalog_version`` attribution ledger (every served request's
+  latency/shed/degraded outcome plus the ``OnlineEvaluator``'s shadow
+  scores land in the cohort of the *deploy* that served it), and a
+  ``CanaryVerdictEngine`` comparing canary-vs-incumbent cohorts under
+  minimum-sample and effect-size thresholds into PROMOTE/HOLD/ROLLBACK
+  verdicts — stamped into lineage, paged by
+  ``HealthMonitor.watch_rollout`` while a ROLLBACK sits un-acted-on
+  (``/budgetz``; ``scripts/obs_report.py --budget``).
+
 Zero-cost when disabled — the design invariant every instrumented hot
 path relies on: the module-level defaults are a ``NullRegistry`` and
 ``NullTracer`` whose instruments are shared stateless singletons (no
@@ -113,6 +125,15 @@ from large_scale_recommendation_tpu.obs.anomaly import (
     MonotonicGrowthCheck,
     ewma_zscore,
     rate_of_change,
+)
+from large_scale_recommendation_tpu.obs.budget import (
+    CanaryVerdictEngine,
+    RolloutBudget,
+    RolloutCheck,
+    budgetz,
+    get_budget,
+    serve_scope,
+    set_budget,
 )
 from large_scale_recommendation_tpu.obs.contention import (
     ContentionTracker,
@@ -300,6 +321,14 @@ __all__ = [
     "set_transfers",
     "transferz",
     "enable_transfers",
+    "RolloutBudget",
+    "CanaryVerdictEngine",
+    "RolloutCheck",
+    "get_budget",
+    "set_budget",
+    "serve_scope",
+    "budgetz",
+    "enable_budget",
     "OK",
     "DEGRADED",
     "CRITICAL",
@@ -450,6 +479,26 @@ def enable_transfers(guard: str = "off", watch_hot: bool = True,
     return ledger
 
 
+def enable_budget(target_s: float, objective: float = 0.99,
+                  **budget_kwargs) -> RolloutBudget:
+    """Install a ``RolloutBudget`` as the module-level default — the
+    ROLLOUT plane the serving seams note version-keyed outcomes into
+    and the canary verdict engine decides over. ``target_s`` /
+    ``objective`` define the latency SLO the budget burns against;
+    ``budget_kwargs`` pass through to ``RolloutBudget`` (window sizes,
+    cohort bounds, and the verdict thresholds — ``min_samples``,
+    ``sample_budget``, ``burn_ratio``, ``p99_ratio``, ``shed_tol``,
+    ``eval_tol``). Call AFTER ``enable()`` (the budget binds the live
+    registry for its ``slo_*``/``rollout_*`` instruments) and BEFORE
+    building the engines whose outcomes you want attributed — the
+    noting handle binds at construction, same as every other plane.
+    Returns the budget (served at ``/budgetz`` by any subsequently
+    built ``ObsServer``)."""
+    budget = RolloutBudget(target_s, objective=objective, **budget_kwargs)
+    set_budget(budget)
+    return budget
+
+
 def disable() -> None:
     """Restore the zero-cost defaults: null registry/tracer, no flight
     recorder, event journal, lineage journal or contention tracker,
@@ -475,6 +524,7 @@ def disable() -> None:
     set_disttrace(None)
     set_store(None)
     set_transfers(None)
+    set_budget(None)
     set_registry(_r.NULL_REGISTRY)
     set_tracer(_t.NULL_TRACER)
 

@@ -390,6 +390,87 @@ class FleetAggregator:
             "retrace_total": retrace_total,
         }
 
+    def budget(self) -> dict:
+        """Scrape every target's ``/budgetz`` into one pod rollout
+        view: cohorts merged BY CATALOG VERSION (outcome totals summed
+        — one deploy's cohort is one row however many replicas served
+        it; attainment/burn re-derived from the summed totals, while
+        the windowed fast burn and remaining budget keep the
+        WORST-host reading so a one-replica canary regression cannot
+        be averaged away by its healthy peers), plus every host's
+        pending ROLLBACK verdicts keyed by version. Targets with no
+        budget enabled report their note and contribute nothing;
+        unreachable targets are listed."""
+        per_target = []
+        skipped: list[str] = []
+        cohort_rows: dict[int, dict] = {}
+        pending: dict[str, list] = {}
+        objective = None
+        for url in self.targets:
+            host = _host_of(url)
+            code, body = http_get(url + "/budgetz", timeout=self.timeout_s)
+            if code != 200:
+                skipped.append(host)
+                continue
+            try:
+                doc = json.loads(body)
+            except json.JSONDecodeError:
+                skipped.append(host)
+                continue
+            verdicts = doc.get("verdicts") or {}
+            host_pending = verdicts.get("pending_rollbacks") or {}
+            per_target.append({
+                "host": host, "url": url,
+                "note": doc.get("note"),
+                "name": doc.get("name"),
+                "objective": doc.get("objective"),
+                "evaluations": verdicts.get("evaluations"),
+                "pending_rollbacks": sorted(host_pending),
+            })
+            if doc.get("objective") is not None and objective is None:
+                objective = doc["objective"]
+            for version, rec in host_pending.items():
+                pending.setdefault(str(version), []).append(
+                    {"host": host, "reason": rec.get("reason")})
+            for version, row in (doc.get("cohorts") or {}).items():
+                v = int(version)
+                agg = cohort_rows.setdefault(
+                    v, {"version": v, "served": 0, "shed": 0,
+                        "violations": 0, "degraded": 0, "hosts": 0,
+                        "burn_rate_fast_max": 0.0, "p99_ms_max": 0.0,
+                        "error_budget_remaining_min": 1.0, "evals": {}})
+                agg["served"] += row.get("served", 0)
+                agg["shed"] += row.get("shed", 0)
+                agg["violations"] += row.get("violations", 0)
+                agg["degraded"] += row.get("degraded", 0)
+                agg["hosts"] += 1
+                agg["burn_rate_fast_max"] = max(
+                    agg["burn_rate_fast_max"],
+                    row.get("burn_rate_fast") or 0.0)
+                agg["p99_ms_max"] = max(agg["p99_ms_max"],
+                                        row.get("p99_ms") or 0.0)
+                agg["error_budget_remaining_min"] = min(
+                    agg["error_budget_remaining_min"],
+                    row.get("error_budget_remaining", 1.0))
+                agg["evals"].update(row.get("evals") or {})
+        for agg in cohort_rows.values():
+            offered = agg["served"] + agg["shed"]
+            agg["shed_frac"] = (agg["shed"] / offered) if offered else 0.0
+            frac = (agg["violations"] / agg["served"]
+                    if agg["served"] else 0.0)
+            agg["attainment"] = 1.0 - frac
+            agg["burn_rate"] = (frac / (1.0 - objective)
+                                if objective is not None else None)
+        merged = sorted(cohort_rows.values(), key=lambda r: r["version"])
+        return {
+            "time": time.time(),
+            "targets": per_target,
+            "unreachable": skipped,
+            "objective": objective,
+            "cohorts": merged,
+            "pending_rollbacks": pending,
+        }
+
     def healthz(self) -> tuple[int, dict]:
         """(http_status, pod report) — 503 iff the pod aggregate is
         CRITICAL (including any unreachable member), the same contract
@@ -418,7 +499,9 @@ class FleetServer(EndpointServerBase):
     https://ui.perfetto.dev), ``/contentionz`` (the pod saturation
     view: per-host Amdahl summaries + the lock table merged by name),
     ``/transferz`` (the pod transfer view: the site table merged by
-    name + pod implicit/retrace totals).
+    name + pod implicit/retrace totals), ``/budgetz`` (the pod rollout
+    view: cohorts merged by catalog version + pending ROLLBACK
+    verdicts across hosts).
     Rides ``obs.server.EndpointServerBase``
     — the SAME lifecycle/handler plumbing as the per-process
     ``ObsServer``, so the HTTP semantics cannot drift between the
@@ -451,9 +534,11 @@ class FleetServer(EndpointServerBase):
             return 200, self.aggregator.contention()
         if path == "/transferz":
             return 200, self.aggregator.transfers()
+        if path == "/budgetz":
+            return 200, self.aggregator.budget()
         if path == "/":
             return 200, {"routes": ["/metrics", "/healthz", "/fleetz",
                                     "/podtracez", "/contentionz",
-                                    "/transferz"],
+                                    "/transferz", "/budgetz"],
                          "targets": self.aggregator.targets}
         return None

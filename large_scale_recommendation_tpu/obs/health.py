@@ -230,6 +230,16 @@ class HealthMonitor:
 
         self.register(name, TransferSteadyCheck(ledger))
 
+    def watch_rollout(self, budget, name: str = "rollout") -> None:
+        """Register the canary-verdict gate (``obs.budget.RolloutCheck``)
+        over a ``RolloutBudget``: OK while no verdict is outstanding,
+        DEGRADED the moment a ROLLBACK verdict sits un-acted-on — a
+        poisoned deploy the operator has not yet pulled back is a page,
+        not a dashboard curiosity."""
+        from large_scale_recommendation_tpu.obs.budget import RolloutCheck
+
+        self.register(name, RolloutCheck(budget))
+
     # -- evaluation ----------------------------------------------------------
 
     def run(self) -> dict:
@@ -301,6 +311,45 @@ class HealthMonitor:
 # --------------------------------------------------------------------------
 
 
+class _WindowReservoir:
+    """One sliding violation window: a bounded deque of booleans plus a
+    running violation count. The whole SLO plane is built from these —
+    ``SLOTracker`` holds one *primary* reservoir (the pre-multi-window
+    behaviour, bit-compatible) plus any number of named extras
+    (fast/slow SRE pairs), and ``obs.budget`` gives every catalog
+    version's cohort its own tracker. Not thread-safe on its own: the
+    owner serializes ``push`` under its lock."""
+
+    __slots__ = ("size", "violations", "_win")
+
+    def __init__(self, size: int):
+        if size < 1:
+            raise ValueError(f"window must be >= 1, got {size}")
+        self.size = int(size)
+        self.violations = 0  # violations inside the window
+        self._win: deque[bool] = deque()
+
+    def push(self, viol: bool) -> None:
+        if len(self._win) == self.size:
+            self.violations -= self._win.popleft()
+        self._win.append(viol)
+        self.violations += viol
+
+    @property
+    def fill(self) -> int:
+        return len(self._win)
+
+    def stats(self, objective: float) -> tuple[float, float, float]:
+        """(attainment, burn_rate, error_budget_remaining) over the
+        current fill; the empty reservoir reads as a full budget."""
+        n = len(self._win)
+        if n == 0:
+            return 1.0, 0.0, 1.0
+        frac = self.violations / n
+        burn = frac / (1.0 - objective)
+        return 1.0 - frac, burn, max(0.0, 1.0 - burn)
+
+
 class SLOTracker:
     """Sliding-window latency-target attainment and error-budget burn.
 
@@ -313,15 +362,22 @@ class SLOTracker:
       (``1 - objective``); 1.0 = burning exactly the budget, >1 = over
     - ``error_budget_remaining`` — ``max(0, 1 - burn_rate)``
 
-    The window math is pinned against a numpy reference in
-    ``tests/test_obs_health.py``. Gauges (``slo_attainment{slo=}``,
+    ``windows`` adds named secondary reservoirs on the same sample
+    stream — the SRE fast/slow pair (a short window that catches a
+    cliff in seconds, a long one that catches a slow leak) is
+    ``windows={"fast": 64, "slow": 1024}``-style; ``burn_rates()``
+    reads every pair at once and each extra publishes
+    ``slo_burn_rate{slo=,window=}``. The primary window's math and
+    gauges are untouched by extras — pinned against a numpy reference
+    in ``tests/test_obs_health.py``. Gauges (``slo_attainment{slo=}``,
     ``slo_burn_rate{slo=}``, ``slo_error_budget_remaining{slo=}``) and
     counters (``slo_requests_total`` / ``slo_violations_total``) publish
     on every record — no-op singletons under the null registry.
     """
 
     def __init__(self, target_s: float, objective: float = 0.99,
-                 window: int = 512, name: str = "serving", registry=None):
+                 window: int = 512, name: str = "serving", registry=None,
+                 windows: dict[str, int] | None = None):
         if not 0.0 < objective < 1.0:
             raise ValueError(f"objective must be in (0, 1), got {objective}")
         if window < 1:
@@ -331,8 +387,9 @@ class SLOTracker:
         self.window = int(window)
         self.name = name
         self._lock = threading.Lock()
-        self._violations_w = 0  # violations inside the window
-        self._win: deque[bool] = deque()
+        self._primary = _WindowReservoir(window)
+        self._extras: dict[str, _WindowReservoir] = {
+            str(w): _WindowReservoir(n) for w, n in (windows or {}).items()}
         self.count = 0  # lifetime samples
         self.violations = 0  # lifetime violations
         obs = registry or get_registry()
@@ -341,62 +398,79 @@ class SLOTracker:
         self._m_att = obs.gauge("slo_attainment", slo=name)
         self._m_burn = obs.gauge("slo_burn_rate", slo=name)
         self._m_budget = obs.gauge("slo_error_budget_remaining", slo=name)
+        self._m_extras = {
+            w: obs.gauge("slo_burn_rate", slo=name, window=w)
+            for w in self._extras}
 
     def record(self, latency_s: float) -> None:
         viol = not (latency_s <= self.target_s)  # NaN counts as violated
+        extra_burns = {}
         with self._lock:
-            if len(self._win) == self.window:
-                self._violations_w -= self._win.popleft()
-            self._win.append(viol)
-            self._violations_w += viol
+            self._primary.push(viol)
+            for w, res in self._extras.items():
+                res.push(viol)
+                extra_burns[w] = res.stats(self.objective)[1]
             self.count += 1
             self.violations += viol
-            att, burn, budget = self._stats_locked()
+            att, burn, budget = self._primary.stats(self.objective)
         self._m_req.inc()
         if viol:
             self._m_viol.inc()
         self._m_att.set(att)
         self._m_burn.set(burn)
         self._m_budget.set(budget)
-
-    def _stats_locked(self) -> tuple[float, float, float]:
-        n = len(self._win)
-        if n == 0:
-            return 1.0, 0.0, 1.0
-        frac = self._violations_w / n
-        burn = frac / (1.0 - self.objective)
-        return 1.0 - frac, burn, max(0.0, 1.0 - burn)
+        for w, b in extra_burns.items():
+            self._m_extras[w].set(b)
 
     @property
     def attainment(self) -> float:
         with self._lock:
-            return self._stats_locked()[0]
+            return self._primary.stats(self.objective)[0]
 
     @property
     def burn_rate(self) -> float:
         with self._lock:
-            return self._stats_locked()[1]
+            return self._primary.stats(self.objective)[1]
 
     @property
     def error_budget_remaining(self) -> float:
         with self._lock:
-            return self._stats_locked()[2]
+            return self._primary.stats(self.objective)[2]
+
+    def burn_rates(self) -> dict[str, float]:
+        """Every window's burn rate in one locked read: the primary
+        under its configured size (key ``"primary"``) plus each named
+        extra — the fast/slow pair a multi-window alert reads
+        together."""
+        with self._lock:
+            rates = {"primary": self._primary.stats(self.objective)[1]}
+            for w, res in self._extras.items():
+                rates[w] = res.stats(self.objective)[1]
+            return rates
 
     def snapshot(self) -> dict:
         with self._lock:
-            att, burn, budget = self._stats_locked()
-            return {
+            att, burn, budget = self._primary.stats(self.objective)
+            snap = {
                 "name": self.name,
                 "target_s": self.target_s,
                 "objective": self.objective,
                 "window": self.window,
-                "window_fill": len(self._win),
+                "window_fill": self._primary.fill,
                 "count": self.count,
                 "violations": self.violations,
                 "attainment": att,
                 "burn_rate": burn,
                 "error_budget_remaining": budget,
             }
+            if self._extras:
+                snap["windows"] = {
+                    w: {"size": res.size, "fill": res.fill,
+                        "burn_rate": res.stats(self.objective)[1],
+                        "error_budget_remaining":
+                            res.stats(self.objective)[2]}
+                    for w, res in self._extras.items()}
+            return snap
 
 
 class ServingHealthCheck:

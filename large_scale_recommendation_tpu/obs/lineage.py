@@ -54,7 +54,11 @@ from large_scale_recommendation_tpu.obs.registry import get_registry
 # (which keeps the flat max for the single-partition reading)
 PROVENANCE_FIELDS = ("catalog_version", "wal_offset_watermark",
                      "watermarks", "train_step", "retrain_id",
-                     "wall_time", "source")
+                     "wall_time", "source", "verdict", "verdict_reason",
+                     "verdict_time", "rolled_back")
+
+# the rollout verdicts obs.budget stamps (plus bookkeeping on the act)
+VERDICTS = ("PROMOTE", "HOLD", "ROLLBACK")
 
 
 class LineageJournal:
@@ -82,6 +86,7 @@ class LineageJournal:
         obs = registry or get_registry()
         self._obs = obs
         self._m_swaps = obs.counter("lineage_swaps_total")
+        self._m_verdicts = obs.counter("lineage_verdicts_total")
         self._m_staleness = obs.gauge("lineage_staleness_s")
         self._m_freshness = obs.histogram("lineage_ingest_to_servable_s")
         self._m_joins = {
@@ -162,6 +167,45 @@ class LineageJournal:
         self._m_swaps.inc()
         if freshness_s is not None:
             self._m_freshness.observe(freshness_s)
+        return out
+
+    def record_verdict(self, catalog_version: int, verdict: str, *,
+                       reason: str | None = None,
+                       acted: bool | None = None,
+                       wall_time: float | None = None) -> dict:
+        """Stamp a rollout verdict (``obs.budget.CanaryVerdictEngine``)
+        onto the version's provenance record — the postmortem join
+        "which build was rolled back, and why" reads straight off
+        ``/lineagez``. Upserts like ``record_swap`` (a verdict can land
+        before the serving host's own swap stamp); ``acted=True`` marks
+        the rollback as executed (``rolled_back``), which is what
+        clears the ``RolloutCheck`` page."""
+        if verdict not in VERDICTS:
+            raise ValueError(
+                f"verdict must be one of {VERDICTS}, got {verdict!r}")
+        now = time.time() if wall_time is None else float(wall_time)
+        version = int(catalog_version)
+        with self._lock:
+            rec = self._records.get(version)
+            if rec is None:
+                self._seq += 1
+                rec = {"catalog_version": version, "wall_time": now,
+                       "wal_offset_watermark": None, "watermarks": {},
+                       "train_step": None, "retrain_id": None,
+                       "source": None, "seq": self._seq}
+                self._records[version] = rec
+                while len(self._records) > self.capacity:
+                    self._records.popitem(last=False)
+                    self.evicted += 1
+            rec["verdict"] = verdict
+            if reason is not None:
+                rec["verdict_reason"] = reason
+            rec["verdict_time"] = now
+            if acted is not None:
+                rec["rolled_back"] = bool(acted)
+            out = dict(rec)
+            out["watermarks"] = dict(rec["watermarks"])
+        self._m_verdicts.inc()
         return out
 
     def note_ingest(self, end_offset: int, partition: int = 0,

@@ -69,22 +69,27 @@ from large_scale_recommendation_tpu.obs.trace import get_tracer
 # counters at incident time); version 6 added transfers.json (the
 # TRANSFER-plane freeze: per-site host↔device byte/wait totals,
 # implicit-transfer attribution, retrace counts + the signature-diff
-# ring at incident time). Bundles written before each layer must
-# stay loadable — an ARCHIVED incident bundle is exactly the artifact
-# this module exists to preserve, so the loader validates per the
-# version it finds
-BUNDLE_VERSION = 6
+# ring at incident time); version 7 added budget.json (the ROLLOUT-
+# plane freeze: service-level fast/slow burn rates, per-catalog-version
+# outcome cohorts and the canary verdict state at incident time — the
+# postmortem answer to "which deploy was burning the budget, and had
+# the verdict engine already said so"). Bundles written before each
+# layer must stay loadable — an ARCHIVED incident bundle is exactly
+# the artifact this module exists to preserve, so the loader validates
+# per the version it finds
+BUNDLE_VERSION = 7
 BUNDLE_FILES = ("series.json", "events.jsonl", "trace.json", "health.json",
                 "metrics.json", "config.json", "device_memory.json",
                 "lineage.json", "contention.json", "store.json",
-                "transfers.json")
+                "transfers.json", "budget.json")
 _BUNDLE_FILES_BY_VERSION = {
-    1: BUNDLE_FILES[:-5],
-    2: BUNDLE_FILES[:-4],
-    3: BUNDLE_FILES[:-3],
-    4: BUNDLE_FILES[:-2],
-    5: BUNDLE_FILES[:-1],
-    6: BUNDLE_FILES,
+    1: BUNDLE_FILES[:-6],
+    2: BUNDLE_FILES[:-5],
+    3: BUNDLE_FILES[:-4],
+    4: BUNDLE_FILES[:-3],
+    5: BUNDLE_FILES[:-2],
+    6: BUNDLE_FILES[:-1],
+    7: BUNDLE_FILES,
 }
 # env prefixes worth freezing into a bundle — runtime knobs, never secrets
 _ENV_PREFIXES = ("JAX_", "XLA_", "OBS_", "BENCH_", "LIBTPU", "TPU_")
@@ -525,6 +530,21 @@ def write_bundle(directory: str, *, trigger: str, detail: dict | None = None,
     else:
         transfers_doc = {"note": "transfer ledger not enabled",
                          "sites": {}}
+    # the rollout-plane freeze: fast/slow burn rates, per-version
+    # outcome cohorts + the canary verdict state — "which deploy was
+    # burning the budget?" answerable offline. Same graceful rules.
+    from large_scale_recommendation_tpu.obs.budget import get_budget
+
+    rollout_budget = get_budget()
+    if rollout_budget is not None:
+        try:
+            budget_doc = rollout_budget.snapshot()
+        except Exception as e:
+            budget_doc = {"note": f"snapshot failed: {e!r}",
+                          "cohorts": {}}
+    else:
+        budget_doc = {"note": "rollout budget not enabled",
+                      "cohorts": {}}
     config_doc = {
         "time": created,
         "pid": os.getpid(),
@@ -572,6 +592,7 @@ def write_bundle(directory: str, *, trigger: str, detail: dict | None = None,
         _write_json("contention.json", contention_doc)
         _write_json("store.json", store_doc)
         _write_json("transfers.json", transfers_doc)
+        _write_json("budget.json", budget_doc)
         _write_json("manifest.json", manifest)
         if os.path.isdir(directory):  # re-dump to the same explicit path
             import shutil
@@ -713,11 +734,22 @@ def load_bundle(directory: str) -> dict:
     else:  # pre-transfer-plane bundle (version <= 5)
         transfers = {"note": f"version-{version} bundle (no transfer "
                              "freeze)", "sites": {}}
+    if "budget.json" in required_files:
+        budget = _load("budget.json")
+        if not isinstance(budget, dict):
+            raise ValueError(f"bundle {directory}: budget.json is not "
+                             "a JSON object")
+        if "cohorts" not in budget and "note" not in budget:
+            raise ValueError(f"bundle {directory}: budget.json has "
+                             "neither a cohort table nor a note")
+    else:  # pre-rollout-plane bundle (version <= 6)
+        budget = {"note": f"version-{version} bundle (no budget "
+                          "freeze)", "cohorts": {}}
     return {"manifest": manifest, "series": series, "events": events,
             "trace": trace, "health": health, "metrics": metrics,
             "config": config, "device_memory": device_memory,
             "lineage": lineage, "contention": contention,
-            "store": store, "transfers": transfers}
+            "store": store, "transfers": transfers, "budget": budget}
 
 
 def validate_bundle(directory: str) -> dict:

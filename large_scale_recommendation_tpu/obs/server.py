@@ -317,6 +317,8 @@ class ObsServer(EndpointServerBase):
             return 200, self.storez()
         if path == "/transferz":
             return 200, self.transferz()
+        if path == "/budgetz":
+            return 200, self.budgetz()
         if path == "/profilez":
             from urllib.parse import parse_qs
 
@@ -332,7 +334,7 @@ class ObsServer(EndpointServerBase):
                                     "/rooflinez", "/lineagez",
                                     "/criticalpathz", "/contentionz",
                                     "/storez", "/transferz",
-                                    "/profilez"]}
+                                    "/budgetz", "/profilez"]}
         return None
 
     # -- route bodies (shared with tests / in-process callers) --------------
@@ -430,6 +432,15 @@ class ObsServer(EndpointServerBase):
         from large_scale_recommendation_tpu.obs.transfers import transferz
 
         return transferz()
+
+    def budgetz(self) -> dict:
+        """The ROLLOUT plane (service-level fast/slow burn rates,
+        per-catalog-version outcome cohorts, canary verdict state) —
+        the module-default plane (``obs.budget``), resolved per request
+        so a budget enabled after the server is still visible."""
+        from large_scale_recommendation_tpu.obs.budget import budgetz
+
+        return budgetz()
 
     def profilez(self, seconds: float | None = None) -> tuple[int, dict]:
         """(http_status, body) for ``/profilez``: run one N-second

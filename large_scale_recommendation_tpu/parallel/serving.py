@@ -43,6 +43,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import threading
+import time
 import weakref
 from functools import partial
 
@@ -380,6 +381,16 @@ def mesh_top_k_recommend(U, V, user_rows, k: int = 10,
     )
     from large_scale_recommendation_tpu.utils.shapes import pow2_pad
 
+    # version-keyed outcome attribution (obs.budget): the bare mesh
+    # serving path has no engine flush to note for it, so the call
+    # itself lands its wall in the cohort of the catalog version that
+    # scored it. One `is not None` test when the plane is off — no
+    # clock reads on the null path.
+    from large_scale_recommendation_tpu.obs.budget import get_budget
+
+    budget = get_budget()
+    t_serve = time.perf_counter() if budget is not None else 0.0
+
     if catalog is None:
         catalog = shard_catalog(V, mesh, item_mask)
     mesh = catalog.mesh
@@ -409,6 +420,10 @@ def mesh_top_k_recommend(U, V, user_rows, k: int = 10,
                     jnp.asarray(excl_w))
 
     chunk = min(chunk, pow2_pad(n))
-    return run_pipelined_topk(
+    out = run_pipelined_topk(
         user_rows, k=k, k_out=k_out, n_rows=n_rows, slice_size=chunk,
         bucket_fn=lambda c: chunk, score_chunk=score_chunk)
+    if budget is not None:
+        budget.note_result(catalog.version,
+                           time.perf_counter() - t_serve)
+    return out

@@ -68,6 +68,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from large_scale_recommendation_tpu.models.mf import MFModel, _assemble_topk
+from large_scale_recommendation_tpu.obs.budget import get_budget
 from large_scale_recommendation_tpu.obs.contention import named_rlock
 from large_scale_recommendation_tpu.obs.disttrace import get_disttrace
 from large_scale_recommendation_tpu.obs.events import get_events
@@ -235,6 +236,11 @@ class ServingEngine:
         # one `is not None` test per flush, and the analyzer side is
         # non-blocking, same rule as the lineage join below
         self._disttrace = get_disttrace()
+        # rollout budget (obs.budget): every flush attributes each
+        # request's latency to the cohort of the catalog_version that
+        # served it, every shed submit notes the rejection against the
+        # live version — one `is not None` test per seam
+        self._budget = get_budget()
         self._m_qwait = obs.histogram("serving_queue_wait_s")
         self._m_assembly = obs.histogram("serving_batch_assembly_s")
         self._m_flush = obs.histogram("serving_flush_s")
@@ -598,7 +604,15 @@ class ServingEngine:
         queued requests still flush (shedding bounds the queue, it
         never drops accepted work)."""
         if self._admission is not None:
-            self._admission.check_admit()  # raises when shedding
+            try:
+                self._admission.check_admit()  # raises when shedding
+            except Exception:
+                if self._budget is not None:
+                    # the shed outcome is attributed to the version that
+                    # WOULD have served — overload during a canary
+                    # charges the canary's cohort, not a wall-clock bin
+                    self._budget.note_shed(self.version)
+                raise
         with self._lock:
             self._pending.append(np.asarray(user_ids))
             self._pending_t.append(time.perf_counter())
@@ -780,6 +794,16 @@ class ServingEngine:
             # awaiting this build — non-blocking on the analyzer lock,
             # same rule as observe_serve above
             self._disttrace.note_serve(version)
+        if self._budget is not None:
+            # version-keyed outcome attribution (obs.budget): the same
+            # per-request latencies the SLO priced, landed in the
+            # cohort of the catalog_version that served them — a
+            # regression names the deploy, not the minute. Outside
+            # flush's own lock hold; the budget holds its short
+            # internal lock only, never a scrape's.
+            self._budget.note_results(
+                version, [end - ts for ts in stamps],
+                degraded=len(requests) if degraded else 0)
         return results
 
     def _serve_rows(self, user_rows: np.ndarray,

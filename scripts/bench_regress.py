@@ -209,7 +209,13 @@ DEFAULT_HIGHER = ("_ratings_per_s", "_rows_per_s", "_users_per_s",
                   # regresses when it drops. No suffix rule covers it —
                   # "_hit_rate" shares no pattern with _hr10/_hr_at —
                   # so the direction is pinned explicitly.
-                  "tier_hit_rate")
+                  "tier_hit_rate",
+                  # rollout budget plane (ISSUE 19): the remaining
+                  # error budget regresses when it DROPS (burn eats
+                  # it). No LOWER pattern matches the key — "_rmse"
+                  # does not occur in "error_budget_remaining" — and
+                  # the HIGHER rule wins precedence regardless.
+                  "error_budget_remaining")
 
 # keys where LOWER is better (walls, latencies, pad/layout overheads,
 # compile counts, eval error, ingest→servable critical-path walls)
@@ -260,7 +266,18 @@ DEFAULT_LOWER = ("_wall_s", "_ms_", "time_to_", "_s_p", "_pad_ratio",
                  # with the _per_s HIGHER rule; "retrace" and
                  # "implicit_transfers" collide with nothing — pinned
                  # by the direction tests.
-                 "retrace", "implicit_transfers", "transfer_wait")
+                 "retrace", "implicit_transfers", "transfer_wait",
+                 # rollout budget plane (ISSUE 19): the multi-window
+                 # SLO burn pair (slo_burn_rate_fast/_slow) and the
+                 # canary verdict latency (batches-to-ROLLBACK on a
+                 # poisoned leg) both regress UP. Watched via --key on
+                 # rounds that carry them, NOT in SERVING_KEYS:
+                 # SERVING_r01 predates the plane (the PR 10/13
+                 # lesson). "burn_rate" and "verdict_latency" collide
+                 # with no HIGHER pattern — error_budget_remaining
+                 # (higher-better) contains neither — pinned by the
+                 # direction tests.
+                 "burn_rate", "verdict_latency")
 
 _NUM_PAIR = re.compile(
     r'"([A-Za-z_][A-Za-z0-9_]*)":\s*(-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)')

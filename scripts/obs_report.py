@@ -52,6 +52,14 @@ human-readable signature diffs. ``src`` is a ``/transferz`` URL, a
 dumped snapshot JSON (the CI steady-state gate writes one), a bundle
 ``transfers.json``, or a fleet ``/transferz`` pod aggregate.
 
+``--budget <src>`` renders the rollout plane
+(``obs.budget.RolloutBudget``): service-level multi-window burn
+rates, the per-catalog-version cohort attribution table (served /
+shed / attainment / fast burn / remaining budget per version), and
+the canary verdict tail with any un-acted-on ROLLBACKs. ``src`` is a
+``/budgetz`` URL, a dumped snapshot JSON, a bundle ``budget.json``,
+or a fleet ``/budgetz`` pod aggregate.
+
 ``--contention <src>`` renders the concurrency & saturation plane
 (``obs.contention.SaturationAnalyzer``): the Amdahl window summary
 (consumers, efficiency, Karp–Flatt serial fraction, projected speedup
@@ -601,6 +609,88 @@ def render_transfers(doc: dict, tail: int = 12) -> str:
     return "\n".join(out).rstrip()
 
 
+def render_budget(doc: dict, tail: int = 12) -> str:
+    """Render a ``/budgetz`` body (or dumped snapshot / bundle
+    ``budget.json`` / fleet pod aggregate): service-level multi-window
+    burn rates, the per-catalog-version cohort attribution table, and
+    the canary verdict tail with any un-acted-on ROLLBACKs."""
+    head = ["# rollout error budget & canary verdicts"]
+    if doc.get("note"):
+        head[0] += f" — note: {doc['note']}"
+    if doc.get("objective") is not None:
+        slo_bits = [f"objective {_fmt(doc['objective'])}"]
+        if doc.get("target_s") is not None:
+            slo_bits.insert(0, f"target {_fmt(doc['target_s'] * 1e3)} ms")
+        head.append("slo: " + ", ".join(slo_bits))
+    burns = doc.get("burn_rates") or {}
+    if burns:
+        head.append("burn rates: " + ", ".join(
+            f"{w}={_fmt(b)}" for w, b in sorted(burns.items())))
+    out = head + [""]
+
+    cohorts = doc.get("cohorts")
+    # A local snapshot keys cohorts by version string; a fleet pod
+    # aggregate ships a pre-merged, version-sorted row list.
+    if isinstance(cohorts, dict):
+        rows_in = [dict(row, version=v) for v, row in sorted(
+            cohorts.items(), key=lambda kv: int(kv[0]))]
+    else:
+        rows_in = list(cohorts or [])
+    if rows_in:
+        rows = [(str(r.get("version")), _fmt(r.get("served")),
+                 _fmt(r.get("shed")), _fmt(r.get("shed_frac")),
+                 _fmt(r.get("attainment")),
+                 _fmt(r.get("burn_rate_fast",
+                            r.get("burn_rate_fast_max"))),
+                 _fmt(r.get("p99_ms", r.get("p99_ms_max"))),
+                 _fmt(r.get("error_budget_remaining",
+                            r.get("error_budget_remaining_min"))),
+                 _fmt(r.get("hosts")) if "hosts" in r else "-")
+                for r in rows_in]
+        out.extend(format_table(("version", "served", "shed", "shed%",
+                                 "attain", "burn_fast", "p99_ms",
+                                 "budget", "hosts"), rows))
+        out.append("")
+    else:
+        out.append("(no cohorts recorded — arm obs.enable_budget() "
+                   "before constructing the serving engines)")
+        out.append("")
+
+    verdicts = doc.get("verdicts") or {}
+    pending = (verdicts.get("pending_rollbacks")
+               or doc.get("pending_rollbacks") or {})
+    if pending:
+        for version, rec in sorted(pending.items()):
+            if isinstance(rec, list):  # fleet form: one entry per host
+                for entry in rec:
+                    out.append(f"PENDING ROLLBACK v{version} "
+                               f"[{entry.get('host')}]: "
+                               f"{entry.get('reason')}")
+            else:
+                out.append(f"PENDING ROLLBACK v{version}: "
+                           f"{rec.get('reason')}")
+        out.append("")
+    history = verdicts.get("history") or []
+    if history:
+        rows = [(time.strftime("%H:%M:%S", time.localtime(h["time"])),
+                 str(h.get("canary_version")),
+                 str(h.get("incumbent_version")),
+                 str(h.get("verdict")), str(h.get("reason"))[:70])
+                for h in history[-tail:]]
+        out.extend(format_table(("time", "canary", "incumbent",
+                                 "verdict", "reason"), rows))
+    targets = doc.get("targets")
+    if targets:  # a fleet pod aggregate: per-host summaries ride along
+        out.append("")
+        rows = [(str(t.get("host")), _fmt(t.get("evaluations")),
+                 ",".join(t.get("pending_rollbacks") or []) or "-",
+                 str(t.get("note") or "-"))
+                for t in targets]
+        out.extend(format_table(("host", "evals", "pending", "note"),
+                                rows))
+    return "\n".join(out).rstrip()
+
+
 QUALITY_PREFIXES = ("eval_", "dataq_", "lineage_")
 
 
@@ -691,6 +781,12 @@ def main(argv=None) -> int:
                          "attribution + retrace ring) from a /transferz "
                          "URL, a dumped snapshot JSON, a bundle "
                          "transfers.json, or a fleet pod aggregate")
+    ap.add_argument("--budget", default=None, metavar="SRC",
+                    help="render the rollout error-budget plane "
+                         "(multi-window burn rates + per-catalog-version "
+                         "cohort attribution + canary verdict tail) from "
+                         "a /budgetz URL, a dumped snapshot JSON, a "
+                         "bundle budget.json, or a fleet pod aggregate")
     args = ap.parse_args(argv)
     if args.bundle is not None:
         print(render_bundle(args.bundle, args.name))
@@ -712,6 +808,9 @@ def main(argv=None) -> int:
         return 0
     if args.transfers is not None:
         print(render_transfers(fetch_snapshot(args.transfers)))
+        return 0
+    if args.budget is not None:
+        print(render_budget(fetch_snapshot(args.budget)))
         return 0
     if args.path is None:
         ap.error("path is required unless --bundle is given")

@@ -28,7 +28,13 @@ way). It emits:
   armed (``serving.admission``) — p99 of ACCEPTED requests stays
   bounded while load sheds (``overload_fast_p99_ms``,
   ``overload_shed_frac``, ``admission_transitions``), vs the
-  admissionless exact baseline saturating (``overload_exact_p99_ms``).
+  admissionless exact baseline saturating (``overload_exact_p99_ms``);
+- a rollout canary pass (``obs.budget``): a deliberately poisoned
+  catalog version (row-shuffled item factors) served next to the
+  healthy incumbent — per-version cohort rows, the service-level
+  ``slo_burn_rate_fast`` / ``slo_burn_rate_slow`` pair, and
+  ``verdict_latency_batches`` (canary batches until the verdict
+  engine returns ROLLBACK on the poisoned leg).
 
 Arrivals are open-loop (scheduled independently of completions — the
 only shape that exposes saturation); the *control* loop is closed: the
@@ -382,6 +388,7 @@ def run_traffic(num_users=20_000, num_items=262_144, rank=64,
                 overload_mult=3.0, seed=0) -> dict:
     import jax
 
+    from large_scale_recommendation_tpu import obs
     from large_scale_recommendation_tpu.obs import health
     from large_scale_recommendation_tpu.serving import (
         AdmissionConfig,
@@ -390,6 +397,14 @@ def run_traffic(num_users=20_000, num_items=262_144, rank=64,
         ServingEngine,
         recall_at_k,
     )
+
+    # the rollout budget plane must exist BEFORE the engines are built
+    # (each engine binds its handle at construction): every traffic
+    # pass below is then attributed to the catalog version that served
+    # it, and the canary pass at the end exercises the verdict engine
+    budget = obs.enable_budget(
+        slo_ms / 1e3, objective=0.9, fast_window=32, slow_window=256,
+        min_samples=8, sample_budget=64)
 
     model = build_structured_model(num_users, num_items, rank,
                                    n_centers=n_centers, seed=seed)
@@ -507,6 +522,59 @@ def run_traffic(num_users=20_000, num_items=262_144, rank=64,
                                    deadline_s=deadline_ms / 1e3,
                                    slo_ms=slo_ms)
     extra["overload_exact_p99_ms"] = over_exact["p99_ms"]
+
+    # ---- rollout canary: poisoned catalog version, verdict latency ---
+    # The canary serves a deliberately poisoned catalog (item factors
+    # row-shuffled: identical latency, garbage answers) against the
+    # healthy exact incumbent. Shadow recall of the canary against the
+    # incumbent's answers feeds the budget plane as the shared eval
+    # key, the verdict engine attributes the regression to the
+    # canary's catalog version, and the verdict latency is the number
+    # of canary batches until ROLLBACK.
+    from large_scale_recommendation_tpu.models.mf import MFModel
+
+    traffic_snap = budget.snapshot()
+    extra["rollout_traffic_cohorts"] = traffic_snap["cohorts"]
+    # service-level multi-window burn pair from the traffic phase (the
+    # overload pass is what moves it); the canary pass below resets
+    extra["slo_burn_rate_fast"] = round(
+        traffic_snap["burn_rates"].get("fast", 0.0), 4)
+    extra["slo_burn_rate_slow"] = round(
+        traffic_snap["burn_rates"].get("slow", 0.0), 4)
+    budget.reset()
+    poisoned = MFModel(U=model.U,
+                       V=model.V[rng.permutation(num_items)],
+                       users=model.users, items=model.items)
+    canary = ServingEngine(poisoned, k=k, max_batch=max_batch)
+    inc_ver, can_ver = exact.version, canary.version
+    verdict_batches = None
+    last = None
+    for b in range(1, 17):
+        reqs = [rng.integers(0, num_users, 8).astype(np.int64)
+                for _ in range(4)]
+        inc_res = exact.serve(reqs)
+        can_res = canary.serve(reqs)
+        shadow = float(np.mean([recall_at_k(c[0], i[0])
+                                for c, i in zip(can_res, inc_res)]))
+        budget.note_eval(inc_ver, {"shadow_recall": 1.0})
+        budget.note_eval(can_ver, {"shadow_recall": shadow})
+        last = budget.verdicts.evaluate(can_ver, inc_ver)
+        if last["verdict"] == "ROLLBACK":
+            verdict_batches = b
+            break
+    if verdict_batches is not None:
+        budget.verdicts.mark_rolled_back(can_ver)
+    snap = budget.snapshot()
+    extra["verdict_latency_batches"] = verdict_batches
+    extra["rollout"] = {
+        "incumbent_version": inc_ver,
+        "canary_version": can_ver,
+        "burn_rates": snap["burn_rates"],
+        "cohorts": snap["cohorts"],
+        "verdict": None if last is None else last["verdict"],
+        "verdict_reason": None if last is None else last["reason"],
+        "verdict_latency_batches": verdict_batches,
+    }
 
     return {
         "metric": (f"two-stage quantized serving users/s vs exact "

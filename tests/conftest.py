@@ -134,6 +134,10 @@ def null_obs():
         get_tracer,
         set_tracer,
     )
+    from large_scale_recommendation_tpu.obs.budget import (
+        get_budget,
+        set_budget,
+    )
     from large_scale_recommendation_tpu.obs.transfers import (
         get_transfers,
         set_transfers,
@@ -146,6 +150,7 @@ def null_obs():
     prev_ct = get_contention()
     prev_tf = get_transfers()
     prev_store = get_store()
+    prev_budget = get_budget()
     was_running = prev_rec is not None and prev_rec.running
     ins_was_running = prev_ins is not None and prev_ins.running
     ct_was_running = prev_ct is not None and prev_ct.running
@@ -169,6 +174,7 @@ def null_obs():
         prev_rec.start()
     set_transfers(prev_tf)
     set_store(prev_store)  # a test-built TieredFactorStore must not leak
+    set_budget(prev_budget)
 
 
 def pytest_sessionfinish(session, exitstatus):

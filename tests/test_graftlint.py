@@ -289,6 +289,57 @@ class TestObsGate:
         assert res.findings == []
         assert [f.rule for f in res.suppressed] == ["obs-gate"]
 
+    def test_budget_getter_planted(self, tmp_path):
+        """``get_budget`` joined NONE_GETTERS with the rollout plane
+        (PR 19): an ungated ``note_shed`` at the admission seam is the
+        exact regression the rule exists to catch."""
+        res = lint_src(tmp_path, """
+            from large_scale_recommendation_tpu.obs.budget import (
+                get_budget,
+            )
+
+            def shed(version):
+                budget = get_budget()
+                budget.note_shed(version)
+        """, "obs-gate")
+        assert [f.rule for f in res.findings] == ["obs-gate"]
+        assert "budget" in res.findings[0].message
+
+    def test_budget_seam_site_shape_is_clean(self, tmp_path):
+        """The canonical wired-site shape (bind once, skip the clock
+        when absent, note after serving) must lint clean — the
+        mesh_top_k_recommend crossing uses exactly this."""
+        res = lint_src(tmp_path, """
+            import time
+
+            from large_scale_recommendation_tpu.obs.budget import (
+                get_budget,
+            )
+
+            def serve(run, version):
+                budget = get_budget()
+                t0 = time.perf_counter() if budget is not None else 0.0
+                out = run()
+                if budget is not None:
+                    budget.note_result(version,
+                                       time.perf_counter() - t0)
+                return out
+        """, "obs-gate")
+        assert res.findings == []
+
+    def test_budget_reasoned_suppression_survives(self, tmp_path):
+        res = lint_src(tmp_path, """
+            from large_scale_recommendation_tpu.obs.budget import (
+                get_budget,
+            )
+
+            def debug_dump():
+                # debug-only path: a crash here is acceptable
+                get_budget().snapshot()  # graftlint: disable=obs-gate
+        """, "obs-gate")
+        assert res.findings == []
+        assert [f.rule for f in res.suppressed] == ["obs-gate"]
+
 
 # ---------------------------------------------------------------------------
 # lock-order

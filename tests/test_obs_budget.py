@@ -1,0 +1,659 @@
+"""ROLLOUT plane (``obs/budget.py``, ISSUE 19): error budgets,
+per-catalog-version attribution, canary verdicts.
+
+The acceptance pin everything here defends: a REAL two-``ServingEngine``
+run over a REAL socket with a deliberately poisoned catalog version
+shipped to one engine only — the attribution ledger pins the regression
+to that version, the verdict engine returns ROLLBACK within the sample
+budget and stamps it into lineage, the incumbent's error budget is
+untouched, and ``/healthz`` is DEGRADED exactly while the ROLLBACK is
+un-acted-on. Covered: the multi-window ``SLOTracker`` extension
+(fast/slow burn pair, primary window bit-compatible), cohort math,
+the verdict state machine (warming HOLD → hard ROLLBACK → PROMOTE
+exoneration → sample-budget fail-safe), ``RolloutCheck`` +
+``HealthMonitor.watch_rollout``, lineage verdict stamps, ``/budgetz``
+over a real ``ObsServer``, fleet merge-by-version (worst-host windowed
+readings), postmortem bundles (v7 write/load, archived v6 synthesized),
+and the zero-cost disabled path.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from large_scale_recommendation_tpu import obs
+from large_scale_recommendation_tpu.obs.budget import (
+    HOLD,
+    PROMOTE,
+    ROLLBACK,
+    CanaryVerdictEngine,
+    RolloutBudget,
+    RolloutCheck,
+    budgetz,
+    get_budget,
+    serve_scope,
+    set_budget,
+)
+from large_scale_recommendation_tpu.obs.health import (
+    HealthMonitor,
+    SLOTracker,
+)
+from large_scale_recommendation_tpu.obs.server import ObsServer, http_get
+from large_scale_recommendation_tpu.obs.transfers import _NULL_CONTEXT
+
+RANK = 8
+
+
+@pytest.fixture(autouse=True)
+def _reset_planes():
+    """Tests install budgets (and via enable_budget the registry stays
+    whatever null_obs set) — never leak the plane into the next test."""
+    prev = get_budget()
+    yield
+    set_budget(prev)
+
+
+def _small_budget(**kw):
+    kw.setdefault("objective", 0.9)
+    kw.setdefault("fast_window", 8)
+    kw.setdefault("slow_window", 64)
+    kw.setdefault("min_samples", 8)
+    kw.setdefault("sample_budget", 32)
+    return RolloutBudget(0.1, **kw)
+
+
+def _model(num_users=50, num_items=256, seed=20, poisoned=False):
+    import jax.numpy as jnp
+
+    from large_scale_recommendation_tpu.data.blocking import flat_index
+    from large_scale_recommendation_tpu.models.mf import MFModel
+
+    rng = np.random.default_rng(seed)
+    V = rng.normal(size=(num_items, RANK)).astype(np.float32)
+    if poisoned:
+        # the poison: item factors row-shuffled — identical serving
+        # cost, garbage answers (the regression is in WHAT it serves)
+        V = V[rng.permutation(num_items)]
+    return MFModel(
+        U=jnp.asarray(rng.normal(size=(num_users, RANK)).astype(np.float32)),
+        V=jnp.asarray(V),
+        users=flat_index(np.arange(num_users, dtype=np.int64)),
+        items=flat_index(np.arange(num_items, dtype=np.int64)))
+
+
+# --------------------------------------------------------------------------
+# Multi-window SLOTracker: the fast/slow pair, primary pinned elsewhere
+# --------------------------------------------------------------------------
+
+
+class TestMultiWindowSLO:
+    def test_burn_rates_fast_catches_cliff_slow_remembers(self, null_obs):
+        slo = SLOTracker(0.1, objective=0.9, window=64,
+                         windows={"fast": 4, "slow": 64})
+        for _ in range(60):
+            slo.record(0.01)
+        assert slo.burn_rates() == {"primary": 0.0, "fast": 0.0,
+                                    "slow": 0.0}
+        for _ in range(4):  # a cliff: the fast window saturates
+            slo.record(0.5)
+        rates = slo.burn_rates()
+        assert rates["fast"] == pytest.approx(10.0)  # 100% viol / 10%
+        assert rates["slow"] == pytest.approx((4 / 64) / 0.1)
+        assert rates["primary"] == rates["slow"]
+        for _ in range(4):  # recovery: fast forgives, slow remembers
+            slo.record(0.01)
+        rates = slo.burn_rates()
+        assert rates["fast"] == 0.0
+        assert rates["slow"] > 0.0
+
+    def test_snapshot_windows_subdict_only_with_extras(self, null_obs):
+        plain = SLOTracker(0.1, objective=0.9, window=8)
+        assert "windows" not in plain.snapshot()
+        multi = SLOTracker(0.1, objective=0.9, window=8,
+                           windows={"fast": 4})
+        multi.record(0.5)
+        snap = multi.snapshot()
+        assert snap["windows"]["fast"]["size"] == 4
+        assert snap["windows"]["fast"]["fill"] == 1
+        # burn reads over the FILL, the same semantic the primary
+        # window is pinned to: 1 violation / 1 recorded = 100% / 10%
+        assert snap["windows"]["fast"]["burn_rate"] == pytest.approx(10.0)
+
+    def test_extra_burn_gauges_publish_per_window(self, null_obs):
+        from large_scale_recommendation_tpu.obs.registry import (
+            MetricsRegistry,
+        )
+
+        reg = MetricsRegistry()
+        slo = SLOTracker(0.1, objective=0.9, window=16, name="svc",
+                         registry=reg, windows={"fast": 4, "slow": 16})
+        for lat in [0.01] * 15 + [0.5]:
+            slo.record(lat)
+        by_window = {
+            m["labels"]["window"]: m["value"]
+            for m in reg.snapshot()["metrics"]
+            if m["name"] == "slo_burn_rate"
+            and "window" in m["labels"]}  # the primary gauge has none
+        assert by_window["fast"] == pytest.approx((1 / 4) / 0.1)
+        assert by_window["slow"] == pytest.approx((1 / 16) / 0.1)
+        # the primary (unlabelled) burn gauge publishes alongside
+        (primary,) = [m["value"] for m in reg.snapshot()["metrics"]
+                      if m["name"] == "slo_burn_rate"
+                      and "window" not in m["labels"]]
+        assert primary == pytest.approx((1 / 16) / 0.1)
+
+
+# --------------------------------------------------------------------------
+# Cohort attribution math
+# --------------------------------------------------------------------------
+
+
+class TestCohortLedger:
+    def test_outcomes_key_by_version(self, null_obs):
+        b = _small_budget()
+        b.note_results(7, [0.01, 0.02, 0.5], degraded=1)
+        b.note_result(9, 0.03)
+        b.note_shed(7, n=2)
+        b.note_eval(7, {"shadow_recall": 0.98, "nan": float("nan"),
+                        "label": "x"})
+        b.note_extra(7, staleness_s=1.5)
+        c7 = b.cohort(7)
+        assert c7["served"] == 3 and c7["violations"] == 1
+        assert c7["degraded"] == 1 and c7["shed"] == 2
+        assert c7["shed_frac"] == pytest.approx(2 / 5)
+        assert c7["evals"] == {"shadow_recall": 0.98}  # finite scalars
+        assert c7["extras"] == {"staleness_s": 1.5}
+        assert c7["burn_rate_fast"] == pytest.approx((1 / 3) / 0.1)
+        c9 = b.cohort(9)
+        assert c9["served"] == 1 and c9["violations"] == 0
+        assert c9["error_budget_remaining"] == 1.0
+        assert b.cohort(11) is None
+        assert b.versions() == [7, 9]
+
+    def test_service_level_slo_sees_every_cohort(self, null_obs):
+        b = _small_budget()
+        b.note_result(1, 0.01)
+        b.note_result(2, 0.5)
+        assert b.slo.snapshot()["count"] == 2
+        assert b.snapshot()["burn_rates"]["fast"] > 0.0
+
+    def test_version_table_bounded_oldest_evicts(self, null_obs):
+        b = _small_budget(max_versions=2)
+        for v in (1, 2, 3):
+            b.note_result(v, 0.01)
+        assert b.versions() == [2, 3]
+        assert b.evicted == 1
+        assert b.snapshot()["evicted"] == 1
+
+    def test_serve_scope_times_into_the_cohort(self, null_obs):
+        b = _small_budget()
+        with b.serve_scope(5):
+            pass
+        assert b.cohort(5)["served"] == 1
+
+    def test_validation(self, null_obs):
+        with pytest.raises(ValueError, match="max_versions"):
+            RolloutBudget(0.1, max_versions=0)
+        with pytest.raises(ValueError, match="fast_window"):
+            RolloutBudget(0.1, fast_window=64, slow_window=8)
+        with pytest.raises(ValueError, match="min_samples"):
+            CanaryVerdictEngine(_small_budget(), min_samples=0)
+        with pytest.raises(ValueError, match="sample_budget"):
+            CanaryVerdictEngine(_small_budget(), min_samples=8,
+                                sample_budget=4)
+
+
+# --------------------------------------------------------------------------
+# The verdict state machine
+# --------------------------------------------------------------------------
+
+
+class TestVerdictEngine:
+    def test_warming_holds_then_clean_promotes(self, null_obs):
+        b = _small_budget()
+        b.note_results(1, [0.01] * 20)
+        rec = b.verdicts.evaluate(2, 1)  # canary never served
+        assert rec["verdict"] == HOLD and "warming" in rec["reason"]
+        b.note_results(2, [0.01] * 8)
+        rec = b.verdicts.evaluate(2, 1)
+        assert rec["verdict"] == PROMOTE
+        assert b.verdicts.pending() == {}
+
+    def test_missing_incumbent_holds(self, null_obs):
+        b = _small_budget()
+        b.note_results(2, [0.01] * 8)
+        rec = b.verdicts.evaluate(2, 1)
+        assert rec["verdict"] == HOLD
+        assert "no incumbent" in rec["reason"]
+
+    def test_burn_cliff_rolls_back_and_names_the_version(self, null_obs):
+        b = _small_budget()
+        b.note_results(1, [0.01] * 20)
+        b.note_results(2, [0.5] * 8)  # every canary request violates
+        rec = b.verdicts.evaluate(2, 1)
+        assert rec["verdict"] == ROLLBACK
+        assert "burn_rate_fast" in rec["reason"]
+        assert rec["canary_version"] == 2
+        assert 2 in b.verdicts.pending()
+
+    def test_eval_regression_rolls_back_with_direction(self, null_obs):
+        b = _small_budget()
+        b.note_results(1, [0.01] * 8)
+        b.note_results(2, [0.01] * 8)  # latency identical
+        b.note_eval(1, {"shadow_recall": 0.99, "eval_rmse": 1.0})
+        b.note_eval(2, {"shadow_recall": 0.50, "eval_rmse": 1.0})
+        rec = b.verdicts.evaluate(2, 1)
+        assert rec["verdict"] == ROLLBACK
+        assert "shadow_recall" in rec["reason"]
+        # lower-better keys read the other way: a DROPPING rmse is an
+        # improvement, never a signal
+        b2 = _small_budget()
+        b2.note_results(1, [0.01] * 8)
+        b2.note_results(2, [0.01] * 8)
+        b2.note_eval(1, {"eval_rmse": 1.0})
+        b2.note_eval(2, {"eval_rmse": 0.5})
+        assert b2.verdicts.evaluate(2, 1)["verdict"] == PROMOTE
+
+    def test_soft_signal_holds_then_sample_budget_fails_safe(
+            self, null_obs):
+        b = _small_budget(min_samples=8, sample_budget=16,
+                          eval_tol=0.10)
+        b.note_results(1, [0.01] * 20)
+        b.note_results(2, [0.01] * 8)
+        # 7% worse: above the soft bar (5%), below the hard bar (10%)
+        b.note_eval(1, {"shadow_recall": 1.00})
+        b.note_eval(2, {"shadow_recall": 0.93})
+        rec = b.verdicts.evaluate(2, 1)
+        assert rec["verdict"] == HOLD
+        b.note_results(2, [0.01] * 8)  # sample budget now spent
+        rec = b.verdicts.evaluate(2, 1)
+        assert rec["verdict"] == ROLLBACK
+        assert "sample budget exhausted" in rec["reason"]
+
+    def test_promote_exonerates_a_pending_rollback(self, null_obs):
+        # a small latency reservoir so the recovery can age the cliff
+        # out of the p99 read, not just out of the fast burn window
+        b = _small_budget(lat_reservoir=8)
+        b.note_results(1, [0.01] * 20)
+        b.note_results(2, [0.5] * 8)
+        assert b.verdicts.evaluate(2, 1)["verdict"] == ROLLBACK
+        # the canary recovers: fast window and reservoir forget
+        b.note_results(2, [0.01] * 8)
+        b.note_results(1, [0.01] * 8)
+        assert b.verdicts.evaluate(2, 1)["verdict"] == PROMOTE
+        assert b.verdicts.pending() == {}
+
+    def test_mark_rolled_back_clears_pending(self, null_obs):
+        b = _small_budget()
+        b.note_results(1, [0.01] * 20)
+        b.note_results(2, [0.5] * 8)
+        b.verdicts.evaluate(2, 1)
+        assert b.verdicts.mark_rolled_back(2) is True
+        assert b.verdicts.pending() == {}
+        assert b.verdicts.mark_rolled_back(2) is False  # idempotent
+
+    def test_snapshot_history_and_counters(self, null_obs):
+        from large_scale_recommendation_tpu.obs.registry import (
+            MetricsRegistry,
+        )
+
+        reg = MetricsRegistry()
+        b = RolloutBudget(0.1, objective=0.9, fast_window=8,
+                          slow_window=64, min_samples=8,
+                          sample_budget=32, registry=reg)
+        b.note_results(1, [0.01] * 20)
+        b.note_results(2, [0.5] * 8)
+        b.verdicts.evaluate(2, 1)
+        snap = b.verdicts.snapshot()
+        assert snap["evaluations"] == 1
+        assert list(snap["pending_rollbacks"]) == ["2"]
+        assert snap["history"][-1]["verdict"] == ROLLBACK
+        assert snap["config"]["min_samples"] == 8
+        metrics = {(m["name"], tuple(sorted(m["labels"].items()))):
+                   m["value"] for m in reg.snapshot()["metrics"]}
+        assert metrics[("rollout_verdicts_total",
+                        (("verdict", ROLLBACK),))] == 1
+        assert metrics[("rollout_pending_rollbacks", ())] == 1
+        assert metrics[("rollout_served_total", ())] == 28
+
+    def test_verdicts_stamp_lineage(self, null_obs):
+        journal = obs.enable_lineage(capacity=16)
+        try:
+            b = _small_budget()
+            b.note_results(1, [0.01] * 20)
+            b.note_results(2, [0.5] * 8)
+            b.verdicts.evaluate(2, 1)
+            rec = journal.resolve(2)
+            assert rec["verdict"] == ROLLBACK
+            assert "burn_rate_fast" in rec["verdict_reason"]
+            assert "rolled_back" not in rec
+            b.verdicts.mark_rolled_back(2)
+            assert journal.resolve(2)["rolled_back"] is True
+        finally:
+            obs.disable()
+
+
+# --------------------------------------------------------------------------
+# Plane lifecycle + the zero-cost disabled path
+# --------------------------------------------------------------------------
+
+
+class TestPlaneLifecycle:
+    def test_default_is_none_and_budgetz_notes(self, null_obs):
+        assert get_budget() is None
+        doc = budgetz()
+        assert "enable_budget" in doc["note"] and doc["cohorts"] == {}
+
+    def test_disabled_scope_is_the_shared_singleton(self, null_obs,
+                                                    monkeypatch):
+        """The TestNullPathZeroWork pin for this plane: with no budget
+        installed ``serve_scope`` hands out the one module-level null
+        context — no allocation, and NO clock read (pinned by making
+        the clock explode)."""
+        import time as _time
+
+        def _boom():  # pragma: no cover - must never run
+            raise AssertionError("clock read on the disabled path")
+
+        monkeypatch.setattr(_time, "perf_counter", _boom)
+        assert serve_scope(1) is _NULL_CONTEXT
+        with serve_scope(1):
+            pass
+
+    def test_engine_binds_none_when_plane_off(self, null_obs):
+        from large_scale_recommendation_tpu.serving import ServingEngine
+
+        assert ServingEngine(_model(), k=4)._budget is None
+
+    def test_enable_budget_installs_and_disable_clears(self, null_obs):
+        b = obs.enable_budget(0.1, objective=0.95, fast_window=4,
+                              slow_window=16, min_samples=4)
+        try:
+            assert b is get_budget()
+            assert b.objective == 0.95
+            assert b.verdicts.min_samples == 4
+            assert serve_scope(3) is not _NULL_CONTEXT
+        finally:
+            obs.disable()
+        assert get_budget() is None
+
+
+# --------------------------------------------------------------------------
+# Server route, health gate
+# --------------------------------------------------------------------------
+
+
+class TestServerAndHealth:
+    def test_budgetz_route_and_index(self, null_obs):
+        obs.enable()
+        try:
+            b = obs.enable_budget(0.1, objective=0.9)
+            b.note_result(3, 0.01)
+            with ObsServer() as server:
+                code, body = http_get(server.url + "/budgetz")
+                icode, ibody = http_get(server.url + "/")
+        finally:
+            obs.disable()
+        assert code == 200
+        doc = json.loads(body)
+        assert doc["cohorts"]["3"]["served"] == 1
+        assert "/budgetz" in json.loads(ibody)["routes"]
+
+    def test_budgetz_without_plane_is_a_note(self, null_obs):
+        obs.enable()
+        try:
+            with ObsServer() as server:
+                code, body = http_get(server.url + "/budgetz")
+        finally:
+            obs.disable()
+        assert code == 200
+        assert "enable_budget" in json.loads(body)["note"]
+
+    def test_rollout_check_degraded_exactly_while_pending(self, null_obs):
+        b = _small_budget()
+        check = RolloutCheck(b)
+        assert check().status == "ok"
+        b.note_results(1, [0.01] * 20)
+        b.note_results(2, [0.5] * 8)
+        b.verdicts.evaluate(2, 1)
+        res = check()
+        assert res.status == "degraded"
+        assert "un-acted-on" in res.detail["note"]
+        b.verdicts.mark_rolled_back(2)
+        assert check().status == "ok"
+
+    def test_watch_rollout_flips_healthz(self, null_obs):
+        mon = HealthMonitor()
+        b = _small_budget()
+        mon.watch_rollout(b)
+        assert mon.run()["status"] == "ok"
+        b.note_results(1, [0.01] * 20)
+        b.note_results(2, [0.5] * 8)
+        b.verdicts.evaluate(2, 1)
+        report = mon.run()
+        assert report["checks"]["rollout"]["status"] == "degraded"
+        assert report["status"] == "degraded"
+
+
+# --------------------------------------------------------------------------
+# Fleet merge-by-version
+# --------------------------------------------------------------------------
+
+
+class TestFleet:
+    def test_pod_view_merges_cohorts_by_version(self, null_obs):
+        from large_scale_recommendation_tpu.obs.fleet import (
+            FleetAggregator,
+            FleetServer,
+        )
+
+        obs.enable()
+        try:
+            b = obs.enable_budget(0.1, objective=0.9, fast_window=8,
+                                  slow_window=64, min_samples=4,
+                                  sample_budget=16)
+            b.note_results(1, [0.01] * 6)
+            b.note_results(2, [0.5] * 4)
+            b.note_shed(2, n=1)
+            b.verdicts.evaluate(2, 1)
+            with ObsServer() as s1, ObsServer() as s2:
+                # two real sockets over the one process budget: the
+                # merge-by-version contract is what's under test
+                view = FleetAggregator([s1.url, s2.url]).budget()
+                with FleetServer(FleetAggregator([s1.url])) as fleet:
+                    code, body = http_get(fleet.url + "/budgetz")
+        finally:
+            obs.disable()
+        (r2,) = [r for r in view["cohorts"] if r["version"] == 2]
+        assert r2["hosts"] == 2
+        assert r2["served"] == 8  # summed across members
+        assert r2["shed"] == 2
+        # the windowed readings keep the WORST host, never averaged
+        assert r2["burn_rate_fast_max"] == pytest.approx(10.0)
+        # every canary request violated: the slow window (burn over
+        # fill, the pinned SLOTracker semantic) is fully burned
+        assert r2["error_budget_remaining_min"] == 0.0
+        assert r2["attainment"] == 0.0
+        assert view["pending_rollbacks"]["2"][0]["reason"]
+        assert len(view["pending_rollbacks"]["2"]) == 2  # one per host
+        assert code == 200
+        assert json.loads(body)["cohorts"][0]["version"] == 1
+
+    def test_unreachable_member_is_listed_not_fatal(self, null_obs):
+        from large_scale_recommendation_tpu.obs.fleet import (
+            FleetAggregator,
+        )
+
+        obs.enable()
+        try:
+            obs.enable_budget(0.1)
+            with ObsServer() as s1:
+                dead = "http://127.0.0.1:1"
+                view = FleetAggregator([s1.url, dead],
+                                       timeout_s=3.0).budget()
+        finally:
+            obs.disable()
+        assert view["unreachable"] == ["127.0.0.1:1"]
+        assert len(view["targets"]) == 1
+
+
+# --------------------------------------------------------------------------
+# Postmortem bundles: v7 round-trip, archived v6 synthesized
+# --------------------------------------------------------------------------
+
+
+class TestBundle:
+    def test_v7_bundle_carries_budget_and_v6_stays_loadable(
+            self, null_obs, tmp_path):
+        import os
+
+        from large_scale_recommendation_tpu.obs.recorder import (
+            BUNDLE_VERSION,
+            load_bundle,
+            write_bundle,
+        )
+
+        obs.enable()
+        obs.enable_flight_recorder(interval_s=0.05)
+        try:
+            b = obs.enable_budget(0.1, objective=0.9)
+            b.note_result(5, 0.02)
+            path = write_bundle(str(tmp_path / "b"), trigger="manual")
+            docs = load_bundle(path)
+            assert BUNDLE_VERSION == 7
+            assert docs["manifest"]["bundle_version"] == 7
+            assert docs["budget"]["cohorts"]["5"]["served"] == 1
+            # an archived version-6 bundle (pre-rollout-plane) stays
+            # loadable with the note synthesized
+            manifest_path = str(tmp_path / "b" / "manifest.json")
+            with open(manifest_path) as f:
+                manifest = json.load(f)
+            manifest["bundle_version"] = 6
+            manifest["files"] = [x for x in manifest["files"]
+                                 if x != "budget.json"]
+            with open(manifest_path, "w") as f:
+                json.dump(manifest, f)
+            os.unlink(str(tmp_path / "b" / "budget.json"))
+            docs6 = load_bundle(path)
+            assert docs6["budget"]["cohorts"] == {}
+            assert "version-6" in docs6["budget"]["note"]
+        finally:
+            obs.disable()
+
+    def test_bundle_without_plane_freezes_the_note(self, null_obs,
+                                                   tmp_path):
+        from large_scale_recommendation_tpu.obs.recorder import (
+            load_bundle,
+            write_bundle,
+        )
+
+        obs.enable()
+        obs.enable_flight_recorder(interval_s=0.05)
+        try:
+            path = write_bundle(str(tmp_path / "b"), trigger="manual")
+            docs = load_bundle(path)
+        finally:
+            obs.disable()
+        assert "not enabled" in docs["budget"]["note"]
+
+
+# --------------------------------------------------------------------------
+# THE acceptance pin: poisoned canary, two engines, real socket
+# --------------------------------------------------------------------------
+
+
+class TestE2EPoisonedCanary:
+    def test_poisoned_version_attributed_rolled_back_incumbent_untouched(
+            self, null_obs):
+        """One deliberately poisoned catalog version ships to one
+        engine only. The ledger attributes the regression to THAT
+        version, the verdict engine returns ROLLBACK within the sample
+        budget and stamps it into lineage, the incumbent's budget is
+        untouched, ``/healthz`` is DEGRADED while the ROLLBACK is
+        un-acted-on and green after the rollback lands."""
+        from large_scale_recommendation_tpu.serving import (
+            ServingEngine,
+            recall_at_k,
+        )
+
+        obs.enable()
+        journal = obs.enable_lineage(capacity=32)
+        # a generous latency target: on a CPU test host only the
+        # PLANTED poison may trip a signal, never scheduler noise
+        budget = obs.enable_budget(
+            30.0, objective=0.9, fast_window=8, slow_window=64,
+            min_samples=8, sample_budget=64)
+        mon = HealthMonitor()
+        mon.watch_rollout(budget)
+        try:
+            # engines bind the plane at construction — incumbent serves
+            # the healthy catalog, the canary the poisoned one
+            incumbent = ServingEngine(_model(), k=5, max_batch=64)
+            canary = ServingEngine(_model(poisoned=True), k=5,
+                                   max_batch=64)
+            inc_ver, can_ver = incumbent.version, canary.version
+            assert inc_ver != can_ver
+            rng = np.random.default_rng(11)
+            verdicts = []
+            with ObsServer(monitor=mon) as server:
+                for _ in range(4):
+                    reqs = [rng.integers(0, 50, 4).astype(np.int64)
+                            for _ in range(4)]
+                    inc_res = incumbent.serve(reqs)
+                    can_res = canary.serve(reqs)
+                    shadow = float(np.mean(
+                        [recall_at_k(c[0], i[0])
+                         for c, i in zip(can_res, inc_res)]))
+                    budget.note_eval(inc_ver, {"shadow_recall": 1.0})
+                    budget.note_eval(can_ver, {"shadow_recall": shadow})
+                    verdicts.append(
+                        budget.verdicts.evaluate(can_ver, inc_ver))
+                    if verdicts[-1]["verdict"] == ROLLBACK:
+                        break
+                # the engine seam attributed every request to the
+                # version that served it
+                code, body = http_get(server.url + "/budgetz")
+                hcode, hbody = http_get(server.url + "/healthz")
+                # the operator acts; the page clears
+                assert budget.verdicts.mark_rolled_back(can_ver)
+                gcode, gbody = http_get(server.url + "/healthz")
+        finally:
+            obs.disable()
+
+        # ROLLBACK within the sample budget, from the warming HOLD
+        assert verdicts[0]["verdict"] == HOLD
+        assert verdicts[-1]["verdict"] == ROLLBACK
+        assert "shadow_recall" in verdicts[-1]["reason"]
+        served = sum(v["canary"]["served"] for v in verdicts
+                     if v["canary"] is not None)
+        assert served <= budget.verdicts.sample_budget
+
+        # the socket view attributes the regression to the poisoned
+        # version and only that version
+        assert code == 200
+        doc = json.loads(body)
+        can_row = doc["cohorts"][str(can_ver)]
+        inc_row = doc["cohorts"][str(inc_ver)]
+        assert can_row["evals"]["shadow_recall"] < 0.5
+        assert inc_row["evals"]["shadow_recall"] == 1.0
+        assert can_row["served"] == inc_row["served"] > 0
+        # the incumbent's error budget is untouched
+        assert inc_row["violations"] == 0
+        assert inc_row["error_budget_remaining"] == 1.0
+
+        # lineage carries the verdict, then the act
+        rec = journal.resolve(can_ver)
+        assert rec["verdict"] == ROLLBACK
+        assert "shadow_recall" in rec["verdict_reason"]
+        assert rec["rolled_back"] is True
+        # the incumbent's provenance record carries no rollback stamp
+        inc_rec = journal.resolve(inc_ver)
+        assert inc_rec is None or inc_rec.get("verdict") != ROLLBACK
+
+        # /healthz: DEGRADED while the ROLLBACK was un-acted-on,
+        # green after the rollback landed
+        assert json.loads(hbody)["status"] == "degraded"
+        assert json.loads(hbody)["checks"]["rollout"]["status"] == \
+            "degraded"
+        assert json.loads(gbody)["status"] == "ok"

@@ -465,8 +465,8 @@ class TestContentionzEndToEnd:
                 pass
             path = write_bundle(str(tmp_path / "b"), trigger="manual")
             docs = load_bundle(path)
-            assert BUNDLE_VERSION == 6
-            assert docs["manifest"]["bundle_version"] == 6
+            assert BUNDLE_VERSION == 7
+            assert docs["manifest"]["bundle_version"] == 7
             locks = {r["lock"] for r in docs["contention"]["locks"]}
             assert "t.bundle" in locks
             # an archived version-3 bundle (pre-concurrency-plane)

@@ -353,6 +353,56 @@ class TestQualityLineageRenderers:
         q.write_text(_json.dumps({"series": {}}))
         assert report_main(["--quality", str(q)]) == 0
         assert "model-quality" in capsys.readouterr().out
+        b = tmp_path / "budget.json"
+        b.write_text(_json.dumps({"note": "rollout budget not enabled",
+                                  "cohorts": {}}))
+        assert report_main(["--budget", str(b)]) == 0
+        assert "rollout error budget" in capsys.readouterr().out
+
+    def test_render_budget_snapshot_and_fleet_shapes(self):
+        from scripts.obs_report import render_budget
+
+        # the local /budgetz shape: cohorts keyed by version string
+        doc = {"target_s": 0.1, "objective": 0.9,
+               "burn_rates": {"primary": 0.5, "fast": 4.0, "slow": 0.5},
+               "cohorts": {"7": {"served": 40, "shed": 0,
+                                 "shed_frac": 0.0, "attainment": 1.0,
+                                 "burn_rate_fast": 0.0, "p99_ms": 10.0,
+                                 "error_budget_remaining": 1.0},
+                           "9": {"served": 40, "shed": 3,
+                                 "shed_frac": 0.07, "attainment": 0.0,
+                                 "burn_rate_fast": 10.0, "p99_ms": 200.0,
+                                 "error_budget_remaining": 0.0}},
+               "verdicts": {
+                   "pending_rollbacks": {"9": {"reason": "burn cliff",
+                                               "time": 100.0}},
+                   "history": [{"time": 100.0, "canary_version": 9,
+                                "incumbent_version": 7,
+                                "verdict": "ROLLBACK",
+                                "reason": "burn cliff"}]}}
+        out = render_budget(doc)
+        assert "PENDING ROLLBACK v9" in out
+        assert "burn cliff" in out
+        assert "fast=4" in out
+        # the fleet pod-aggregate shape: a merged, sorted row list
+        fleet = {"objective": 0.9,
+                 "cohorts": [{"version": 9, "served": 80, "shed": 6,
+                              "shed_frac": 0.07, "attainment": 0.0,
+                              "burn_rate_fast_max": 10.0,
+                              "p99_ms_max": 200.0,
+                              "error_budget_remaining_min": 0.0,
+                              "hosts": 2}],
+                 "pending_rollbacks": {"9": [{"host": "a:1",
+                                              "reason": "burn cliff"}]},
+                 "targets": [{"host": "a:1", "evaluations": 3,
+                              "pending_rollbacks": ["9"],
+                              "note": None}]}
+        out = render_budget(fleet)
+        assert "a:1" in out and "PENDING ROLLBACK v9" in out
+        # the absent-plane note renders, never crashes
+        assert "enable_budget" in render_budget(
+            {"note": "rollout budget not enabled (obs.enable_budget)",
+             "cohorts": {}})
 
 
 class TestWatchDeltas:
@@ -966,3 +1016,78 @@ class TestTransferDirections:
         assert regress_main(["--family", "tier",
                              "--baseline", c, "--current", b,
                              "--key", "retrace_total=50"]) == 0
+
+
+class TestRolloutDirections:
+    """Rollout-budget keys (ISSUE 19): ``burn_rate`` /
+    ``verdict_latency`` joined DEFAULT_LOWER and
+    ``error_budget_remaining`` DEFAULT_HIGHER — the direction /
+    no-collision / not-in-family twins the transfer and rank-shard
+    entries carry. CI watches these via explicit ``--key`` only:
+    SERVING_r01 predates the plane, and a default watch key the
+    baseline can't contain is permanent "missing" noise (the
+    PR 10/13 lesson)."""
+
+    LOWER_KEYS = ("slo_burn_rate_fast", "slo_burn_rate_slow",
+                  "verdict_latency_batches")
+
+    def test_rollout_direction_rules(self):
+        from scripts.bench_regress import is_lower_better
+
+        for key in self.LOWER_KEYS:
+            assert is_lower_better(key, set()), key
+        assert not is_lower_better("error_budget_remaining", set())
+
+    def test_rollout_no_direction_collision(self):
+        """The burn/verdict keys must not match a HIGHER pattern
+        (DEFAULT_HIGHER wins, so a collision silently flips the gate's
+        direction), and error_budget_remaining must not match a LOWER
+        pattern — in particular "_rmse" does not occur in it."""
+        from scripts.bench_regress import DEFAULT_HIGHER, DEFAULT_LOWER
+
+        for key in self.LOWER_KEYS:
+            assert not any(pat in key for pat in DEFAULT_HIGHER), key
+        assert not any(pat in "error_budget_remaining"
+                       for pat in DEFAULT_LOWER)
+        for pat in ("burn_rate", "verdict_latency"):
+            assert pat in DEFAULT_LOWER
+        assert "error_budget_remaining" in DEFAULT_HIGHER
+
+    def test_rollout_keys_not_in_family_watch_sets(self):
+        """Explicit --key only — no family default set may carry a
+        rollout key."""
+        from scripts.bench_regress import FAMILIES
+
+        for fam, (_, keys) in FAMILIES.items():
+            for key in keys:
+                for pat in ("burn_rate", "verdict_latency",
+                            "error_budget"):
+                    assert pat not in key, (fam, key)
+
+    def test_burn_rate_blowup_trips_via_key(self, tmp_path):
+        """A fast-burn regression on a round that carries the key
+        trips through the LOWER direction rule; the remaining-budget
+        key gates through the HIGHER rule."""
+        for name, burn, remaining in (("SERVING_r01.json", 0.5, 0.95),
+                                      ("SERVING_r02.json", 4.0, 0.20)):
+            (tmp_path / name).write_text(json.dumps(
+                {"metric": "serving users/s", "value": 300.0,
+                 "unit": "users/s",
+                 "extra": {"qps_at_slo": 12.0, "p99_ms": 80.0,
+                           "recall_at_10": 0.99, "shed_frac": 0.0,
+                           "slo_burn_rate_fast": burn,
+                           "error_budget_remaining": remaining}}))
+        b = str(tmp_path / "SERVING_r01.json")
+        c = str(tmp_path / "SERVING_r02.json")
+        assert regress_main(["--family", "serving",
+                             "--baseline", b, "--current", c,
+                             "--key", "slo_burn_rate_fast=50"]) == 1
+        assert regress_main(["--family", "serving",
+                             "--baseline", b, "--current", c,
+                             "--key", "error_budget_remaining=50"]) == 1
+        # the improvement direction (less burn, more budget) never
+        # trips
+        assert regress_main(["--family", "serving",
+                             "--baseline", c, "--current", b,
+                             "--key", "slo_burn_rate_fast=50",
+                             "--key", "error_budget_remaining=50"]) == 0
