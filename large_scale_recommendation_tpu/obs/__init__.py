@@ -97,6 +97,19 @@ package is that instrumentation layer, shared by every runtime tier:
   ``HealthMonitor.watch_rollout`` while a ROLLBACK sits un-acted-on
   (``/budgetz``; ``scripts/obs_report.py --budget``).
 
+- ``obs.requests`` — the REQUEST plane: per-request stage
+  decomposition (``queue_wait``/``batch_form``/``gather``/
+  ``score_stage1``/``score_stage2``/``topk_merge``/``host_post``
+  ledgers whose sums reconcile against the SLO-recorded walls by
+  construction, ``request_stage_s{stage=}`` histograms +
+  ``request_stage_frac{stage=}`` window gauges) and Dapper-style
+  tail-based exemplar sampling — SLO-violating, shed, and degraded
+  requests are always kept, otherwise the window's slowest N, each
+  exemplar carrying its ledger, catalog version, pow2 bucket,
+  admission rung, queue depth, and a Perfetto-renderable span tree —
+  with ``RequestStageCheck`` paging when one stage dominates while
+  the SLO burns (``/slowz``; ``scripts/obs_report.py --requests``).
+
 Zero-cost when disabled — the design invariant every instrumented hot
 path relies on: the module-level defaults are a ``NullRegistry`` and
 ``NullTracer`` whose instruments are shared stateless singletons (no
@@ -215,6 +228,15 @@ from large_scale_recommendation_tpu.obs.registry import (
     get_registry,
     set_registry,
 )
+from large_scale_recommendation_tpu.obs.requests import (
+    FlushLedger,
+    RequestStageCheck,
+    RequestTelemetry,
+    get_requests,
+    request_scope,
+    set_requests,
+    slowz,
+)
 from large_scale_recommendation_tpu.obs.server import ObsServer
 from large_scale_recommendation_tpu.obs.store import (
     get_store,
@@ -329,6 +351,14 @@ __all__ = [
     "serve_scope",
     "budgetz",
     "enable_budget",
+    "RequestTelemetry",
+    "FlushLedger",
+    "RequestStageCheck",
+    "get_requests",
+    "set_requests",
+    "request_scope",
+    "slowz",
+    "enable_requests",
     "OK",
     "DEGRADED",
     "CRITICAL",
@@ -499,6 +529,27 @@ def enable_budget(target_s: float, objective: float = 0.99,
     return budget
 
 
+def enable_requests(target_s: float, objective: float = 0.99,
+                    **telemetry_kwargs) -> RequestTelemetry:
+    """Install a ``RequestTelemetry`` as the module-level default — the
+    REQUEST plane the serving seams mark stage ledgers into and the
+    tail exemplars land in. ``target_s``/``objective`` define the SLO
+    the violation class keys off (give it the SAME target as the
+    engine's ``SLOTracker`` so the exemplar p99 and the SLO reservoir
+    price one stream); ``telemetry_kwargs`` pass through to
+    ``RequestTelemetry`` (``window``, ``max_exemplars``,
+    ``slow_keep``). Call AFTER ``enable()`` (the plane binds the live
+    registry for its ``request_stage_*`` instruments) and BEFORE
+    building the engines whose requests you want decomposed — the
+    noting handle binds at construction, same as every other plane.
+    Returns the telemetry (served at ``/slowz`` by any subsequently
+    built ``ObsServer``)."""
+    telemetry = RequestTelemetry(target_s, objective=objective,
+                                 **telemetry_kwargs)
+    set_requests(telemetry)
+    return telemetry
+
+
 def disable() -> None:
     """Restore the zero-cost defaults: null registry/tracer, no flight
     recorder, event journal, lineage journal or contention tracker,
@@ -525,6 +576,7 @@ def disable() -> None:
     set_store(None)
     set_transfers(None)
     set_budget(None)
+    set_requests(None)
     set_registry(_r.NULL_REGISTRY)
     set_tracer(_t.NULL_TRACER)
 

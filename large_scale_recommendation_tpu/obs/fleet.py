@@ -471,6 +471,63 @@ class FleetAggregator:
             "pending_rollbacks": pending,
         }
 
+    def requests(self, limit: int = 50) -> dict:
+        """Scrape every target's ``/slowz`` into one pod tail view:
+        exemplars merged WORST-FIRST across hosts (wall descending,
+        each tagged with its host, bounded by ``limit``), per-stage
+        window totals summed into pod-level fractions + the pod's
+        dominant stage, and a per-target summary row (burn rate, p99,
+        dominant stage, kept counts). Targets with no request
+        telemetry enabled report their note and contribute nothing;
+        unreachable targets are listed."""
+        per_target = []
+        skipped: list[str] = []
+        exemplars: list[dict] = []
+        stage_totals: dict[str, float] = {}
+        for url in self.targets:
+            host = _host_of(url)
+            code, body = http_get(url + "/slowz", timeout=self.timeout_s)
+            if code != 200:
+                skipped.append(host)
+                continue
+            try:
+                doc = json.loads(body)
+            except json.JSONDecodeError:
+                skipped.append(host)
+                continue
+            per_target.append({
+                "host": host, "url": url,
+                "note": doc.get("note"),
+                "name": doc.get("name"),
+                "count": doc.get("count"),
+                "violations": doc.get("violations"),
+                "shed": doc.get("shed"),
+                "burn_rate": doc.get("burn_rate"),
+                "p99_ms": doc.get("p99_ms"),
+                "dominant_stage": doc.get("dominant_stage"),
+                "kept": doc.get("kept"),
+            })
+            for stage, total in (doc.get("stage_totals_s") or {}).items():
+                stage_totals[stage] = (stage_totals.get(stage, 0.0)
+                                       + (total or 0.0))
+            for ex in doc.get("exemplars") or []:
+                exemplars.append(dict(ex, host=host))
+        exemplars.sort(key=lambda e: (e.get("wall_s") or 0.0),
+                       reverse=True)
+        sum_wall = sum(stage_totals.values())
+        frac = ({} if sum_wall <= 0.0
+                else {s: t / sum_wall for s, t in stage_totals.items()})
+        return {
+            "time": time.time(),
+            "targets": per_target,
+            "unreachable": skipped,
+            "stage_totals_s": stage_totals,
+            "stage_frac": frac,
+            "dominant_stage": (max(frac, key=lambda s: frac[s])
+                               if frac else None),
+            "exemplars": exemplars[:limit] if limit else exemplars,
+        }
+
     def healthz(self) -> tuple[int, dict]:
         """(http_status, pod report) — 503 iff the pod aggregate is
         CRITICAL (including any unreachable member), the same contract
@@ -501,7 +558,9 @@ class FleetServer(EndpointServerBase):
     ``/transferz`` (the pod transfer view: the site table merged by
     name + pod implicit/retrace totals), ``/budgetz`` (the pod rollout
     view: cohorts merged by catalog version + pending ROLLBACK
-    verdicts across hosts).
+    verdicts across hosts), ``/slowz`` (the pod tail view: exemplars
+    merged worst-first across hosts + pod stage fractions;
+    ``?limit=N`` bounds the table).
     Rides ``obs.server.EndpointServerBase``
     — the SAME lifecycle/handler plumbing as the per-process
     ``ObsServer``, so the HTTP semantics cannot drift between the
@@ -536,9 +595,16 @@ class FleetServer(EndpointServerBase):
             return 200, self.aggregator.transfers()
         if path == "/budgetz":
             return 200, self.aggregator.budget()
+        if path == "/slowz":
+            limit, err = parse_query_int(query, "limit")
+            if err is not None:
+                return 400, {"error": err}
+            return 200, self.aggregator.requests(
+                limit=50 if limit is None else limit)
         if path == "/":
             return 200, {"routes": ["/metrics", "/healthz", "/fleetz",
                                     "/podtracez", "/contentionz",
-                                    "/transferz", "/budgetz"],
+                                    "/transferz", "/budgetz",
+                                    "/slowz"],
                          "targets": self.aggregator.targets}
         return None

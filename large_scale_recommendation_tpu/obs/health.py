@@ -240,6 +240,21 @@ class HealthMonitor:
 
         self.register(name, RolloutCheck(budget))
 
+    def watch_requests(self, telemetry, name: str = "requests",
+                       frac_bar: float = 0.5) -> None:
+        """Register the stage-domination gate
+        (``obs.requests.RequestStageCheck``) over a
+        ``RequestTelemetry``: OK while the SLO holds or no stage
+        dominates, DEGRADED when one stage's window fraction exceeds
+        ``frac_bar`` while the plane's burn rate is over budget — a
+        burning SLO with a named culprit stage is actionable."""
+        from large_scale_recommendation_tpu.obs.requests import (
+            RequestStageCheck,
+        )
+
+        self.register(name, RequestStageCheck(telemetry,
+                                              frac_bar=frac_bar))
+
     # -- evaluation ----------------------------------------------------------
 
     def run(self) -> dict:

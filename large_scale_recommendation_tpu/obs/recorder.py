@@ -73,23 +73,28 @@ from large_scale_recommendation_tpu.obs.trace import get_tracer
 # plane freeze: service-level fast/slow burn rates, per-catalog-version
 # outcome cohorts and the canary verdict state at incident time — the
 # postmortem answer to "which deploy was burning the budget, and had
-# the verdict engine already said so"). Bundles written before each
-# layer must stay loadable — an ARCHIVED incident bundle is exactly
-# the artifact this module exists to preserve, so the loader validates
-# per the version it finds
-BUNDLE_VERSION = 7
+# the verdict engine already said so"); version 8 added requests.json
+# (the REQUEST-plane freeze: window stage fractions + dominant stage
+# and the tail exemplar table with per-request stage ledgers at
+# incident time — the postmortem answer to "WHERE did the slow
+# requests' time go"). Bundles written before each layer must stay
+# loadable — an ARCHIVED incident bundle is exactly the artifact this
+# module exists to preserve, so the loader validates per the version
+# it finds
+BUNDLE_VERSION = 8
 BUNDLE_FILES = ("series.json", "events.jsonl", "trace.json", "health.json",
                 "metrics.json", "config.json", "device_memory.json",
                 "lineage.json", "contention.json", "store.json",
-                "transfers.json", "budget.json")
+                "transfers.json", "budget.json", "requests.json")
 _BUNDLE_FILES_BY_VERSION = {
-    1: BUNDLE_FILES[:-6],
-    2: BUNDLE_FILES[:-5],
-    3: BUNDLE_FILES[:-4],
-    4: BUNDLE_FILES[:-3],
-    5: BUNDLE_FILES[:-2],
-    6: BUNDLE_FILES[:-1],
-    7: BUNDLE_FILES,
+    1: BUNDLE_FILES[:-7],
+    2: BUNDLE_FILES[:-6],
+    3: BUNDLE_FILES[:-5],
+    4: BUNDLE_FILES[:-4],
+    5: BUNDLE_FILES[:-3],
+    6: BUNDLE_FILES[:-2],
+    7: BUNDLE_FILES[:-1],
+    8: BUNDLE_FILES,
 }
 # env prefixes worth freezing into a bundle — runtime knobs, never secrets
 _ENV_PREFIXES = ("JAX_", "XLA_", "OBS_", "BENCH_", "LIBTPU", "TPU_")
@@ -545,6 +550,21 @@ def write_bundle(directory: str, *, trigger: str, detail: dict | None = None,
     else:
         budget_doc = {"note": "rollout budget not enabled",
                       "cohorts": {}}
+    # the request-plane freeze: window stage fractions + the tail
+    # exemplar table — "WHERE did the slow requests' time go?"
+    # answerable offline. Same graceful rules.
+    from large_scale_recommendation_tpu.obs.requests import get_requests
+
+    request_telemetry = get_requests()
+    if request_telemetry is not None:
+        try:
+            requests_doc = request_telemetry.snapshot()
+        except Exception as e:
+            requests_doc = {"note": f"snapshot failed: {e!r}",
+                            "exemplars": []}
+    else:
+        requests_doc = {"note": "request telemetry not enabled",
+                        "exemplars": []}
     config_doc = {
         "time": created,
         "pid": os.getpid(),
@@ -593,6 +613,7 @@ def write_bundle(directory: str, *, trigger: str, detail: dict | None = None,
         _write_json("store.json", store_doc)
         _write_json("transfers.json", transfers_doc)
         _write_json("budget.json", budget_doc)
+        _write_json("requests.json", requests_doc)
         _write_json("manifest.json", manifest)
         if os.path.isdir(directory):  # re-dump to the same explicit path
             import shutil
@@ -745,11 +766,23 @@ def load_bundle(directory: str) -> dict:
     else:  # pre-rollout-plane bundle (version <= 6)
         budget = {"note": f"version-{version} bundle (no budget "
                           "freeze)", "cohorts": {}}
+    if "requests.json" in required_files:
+        requests = _load("requests.json")
+        if not isinstance(requests, dict):
+            raise ValueError(f"bundle {directory}: requests.json is not "
+                             "a JSON object")
+        if "exemplars" not in requests and "note" not in requests:
+            raise ValueError(f"bundle {directory}: requests.json has "
+                             "neither an exemplar table nor a note")
+    else:  # pre-request-plane bundle (version <= 7)
+        requests = {"note": f"version-{version} bundle (no request "
+                            "freeze)", "exemplars": []}
     return {"manifest": manifest, "series": series, "events": events,
             "trace": trace, "health": health, "metrics": metrics,
             "config": config, "device_memory": device_memory,
             "lineage": lineage, "contention": contention,
-            "store": store, "transfers": transfers, "budget": budget}
+            "store": store, "transfers": transfers, "budget": budget,
+            "requests": requests}
 
 
 def validate_bundle(directory: str) -> dict:

@@ -46,6 +46,15 @@ minimal, can expose its live state to a scraper or a ``curl``:
   armed guard, retrace counts + the signature-diff ring, and the
   steady-state window (``scripts/obs_report.py --transfers`` renders
   it).
+- ``/budgetz`` — the ROLLOUT plane (``obs.budget.RolloutBudget``):
+  service-level fast/slow burn rates, per-``catalog_version`` outcome
+  cohorts, and the canary verdict state
+  (``scripts/obs_report.py --budget`` renders it).
+- ``/slowz`` — the REQUEST plane (``obs.requests.RequestTelemetry``):
+  window stage fractions + the dominant stage, wall tail quantiles,
+  and the worst-first tail exemplar table (stage ledgers, catalog
+  version, admission rung, queue depth); ``?limit=N`` bounds the
+  table (``scripts/obs_report.py --requests`` renders it).
 - ``/profilez``  — on-demand ``jax.profiler`` capture:
   ``GET /profilez?seconds=N`` records N seconds (capped, default 1)
   of the whole process into an artifact directory (``profile_dir`` or
@@ -319,6 +328,11 @@ class ObsServer(EndpointServerBase):
             return 200, self.transferz()
         if path == "/budgetz":
             return 200, self.budgetz()
+        if path == "/slowz":
+            limit, err = parse_query_int(query, "limit")
+            if err is not None:  # client error, not a server failure
+                return 400, {"error": err}
+            return 200, self.slowz(limit)
         if path == "/profilez":
             from urllib.parse import parse_qs
 
@@ -334,7 +348,8 @@ class ObsServer(EndpointServerBase):
                                     "/rooflinez", "/lineagez",
                                     "/criticalpathz", "/contentionz",
                                     "/storez", "/transferz",
-                                    "/budgetz", "/profilez"]}
+                                    "/budgetz", "/slowz",
+                                    "/profilez"]}
         return None
 
     # -- route bodies (shared with tests / in-process callers) --------------
@@ -441,6 +456,16 @@ class ObsServer(EndpointServerBase):
         from large_scale_recommendation_tpu.obs.budget import budgetz
 
         return budgetz()
+
+    def slowz(self, limit: int | None = None) -> dict:
+        """The REQUEST plane (window stage fractions + dominant stage,
+        wall tail quantiles, the worst-first exemplar table with stage
+        ledgers) — the module-default plane (``obs.requests``),
+        resolved per request so telemetry enabled after the server is
+        still visible. ``?limit=N`` bounds the exemplar table."""
+        from large_scale_recommendation_tpu.obs.requests import slowz
+
+        return slowz(limit)
 
     def profilez(self, seconds: float | None = None) -> tuple[int, dict]:
         """(http_status, body) for ``/profilez``: run one N-second

@@ -432,6 +432,87 @@ class Tracer:
                 "args": args,
             })
 
+    def complete(self, name: str, t0: float, t1: float,
+                 cat: str = "complete", tid: int | None = None,
+                 **args) -> str | None:
+        """Append one ALREADY-MEASURED complete event: ``t0``/``t1``
+        are historical ``perf_counter`` readings the caller paid
+        elsewhere (the request plane's exemplar span trees — the walls
+        were measured on the serving path; re-opening live spans would
+        re-read clocks and lie about when). Same buffer bound and
+        epoch-anchoring as live spans; ``tid`` overrides the thread id
+        so reconstructed trees can render on their own track. Returns
+        the minted ``span_id`` (``None`` when the buffer dropped it) —
+        the correlation token for event↔span joins."""
+        span_id = f"{process_namespace()}:{next(_SPAN_IDS)}"
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self.dropped += 1
+                return None
+            self._events.append({
+                "name": name,
+                "cat": cat,
+                "ph": "X",
+                "ts": (t0 + self._origin) * 1e6,
+                "dur": max(0.0, t1 - t0) * 1e6,
+                "pid": os.getpid(),
+                "tid": threading.get_ident() if tid is None else int(tid),
+                "args": dict(args, span_id=span_id),
+            })
+        return span_id
+
+    def complete_tree(self, name: str, t0: float, t1: float,
+                      children, cat: str = "complete",
+                      child_cat: str = "complete",
+                      tid: int | None = None, **args) -> str | None:
+        """Append one reconstructed span tree: a parent complete-event
+        over ``[t0, t1]`` plus ``children`` (``[(name, dur_s), ...]``,
+        zero/negative durations skipped) laid back-to-back from ``t0``.
+        Child boundaries are computed in the event's own MICROSECOND
+        space — each child's ``ts`` is the previous child's ``ts + dur``
+        with the very same floats a validator re-adds, and the last end
+        is clamped to the parent's — because converting each boundary
+        from seconds independently does not survive the epoch anchor:
+        at ~1e15 µs one ulp is ~0.25 µs, enough to un-nest abutting
+        siblings under ``validate_chrome_trace``. Returns the parent
+        ``span_id`` (``None`` when the buffer dropped it)."""
+        span_id = f"{process_namespace()}:{next(_SPAN_IDS)}"
+        rtid = threading.get_ident() if tid is None else int(tid)
+        pts = (t0 + self._origin) * 1e6
+        pdur = max(0.0, t1 - t0) * 1e6
+        pend = pts + pdur
+        with self._lock:
+            if len(self._events) >= self.max_events:
+                self.dropped += 1
+                return None
+            self._events.append({
+                "name": name, "cat": cat, "ph": "X",
+                "ts": pts, "dur": pdur, "pid": os.getpid(), "tid": rtid,
+                "args": dict(args, span_id=span_id),
+            })
+            cursor = pts
+            for cname, dur_s in children:
+                if dur_s <= 0.0:
+                    continue
+                dur = min(dur_s * 1e6, pend - cursor)
+                if dur <= 0.0:
+                    continue
+                if len(self._events) >= self.max_events:
+                    self.dropped += 1
+                    continue
+                self._events.append({
+                    "name": cname, "cat": child_cat, "ph": "X",
+                    "ts": cursor, "dur": dur, "pid": os.getpid(),
+                    "tid": rtid,
+                    "args": {
+                        "span_id":
+                            f"{process_namespace()}:{next(_SPAN_IDS)}",
+                        "parent_span_id": span_id,
+                    },
+                })
+                cursor = cursor + dur
+        return span_id
+
     def instant(self, name: str, **args) -> None:
         """Record a zero-duration instant event (``"ph": "i"``) — swap
         markers, checkpoint boundaries. Stamped with the ENCLOSING open
@@ -525,6 +606,17 @@ class NullTracer(Tracer):
 
     def span(self, name: str, key: Any = None, **args):
         return NULL_SPAN
+
+    def complete(self, name: str, t0: float, t1: float,
+                 cat: str = "complete", tid: int | None = None,
+                 **args) -> str | None:
+        return None
+
+    def complete_tree(self, name: str, t0: float, t1: float,
+                      children, cat: str = "complete",
+                      child_cat: str = "complete",
+                      tid: int | None = None, **args) -> str | None:
+        return None
 
     def instant(self, name: str, **args) -> None:
         pass

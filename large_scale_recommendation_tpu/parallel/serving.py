@@ -308,7 +308,7 @@ def mesh_supports_donation(mesh: Mesh) -> bool:
 
 def run_pipelined_topk(user_rows, *, k: int, k_out: int, n_rows: int,
                        slice_size: int, bucket_fn, score_chunk,
-                       on_batch=None):
+                       on_batch=None, on_drain=None):
     """The chunk-loop machinery shared by ``mesh_top_k_recommend`` and
     the serving engine: walk ``user_rows`` in ``slice_size`` slices,
     pad each to ``bucket_fn(len(slice))`` rows, score via
@@ -320,7 +320,10 @@ def run_pipelined_topk(user_rows, *, k: int, k_out: int, n_rows: int,
     become row 0 / -inf, keeping the single-device contract (rows are
     always valid table indices, dead slots identified by score). ONE
     copy of the pipeline + clamp so the per-call path and the engine
-    cannot drift. ``on_batch(bucket)`` observes each dispatched bucket.
+    cannot drift. ``on_batch(bucket)`` observes each dispatched bucket;
+    ``on_drain()`` fires after each drain's device sync completes (the
+    request plane marks its ``topk_merge`` stage there — None, the
+    default, adds nothing to the loop).
     """
     n = len(user_rows)
     out_rows = np.zeros((n, k), np.int32)
@@ -337,6 +340,8 @@ def run_pipelined_topk(user_rows, *, k: int, k_out: int, n_rows: int,
         p0, pc, pv, pr = p
         out_rows[p0:p0 + pc, :k_out] = np.asarray(pr)[:pc]
         out_scores[p0:p0 + pc, :k_out] = np.asarray(pv)[:pc]
+        if on_drain is not None:
+            on_drain()
 
     for c0 in range(0, n, slice_size):
         cu = user_rows[c0:c0 + slice_size]
@@ -385,11 +390,18 @@ def mesh_top_k_recommend(U, V, user_rows, k: int = 10,
     # serving path has no engine flush to note for it, so the call
     # itself lands its wall in the cohort of the catalog version that
     # scored it. One `is not None` test when the plane is off — no
-    # clock reads on the null path.
+    # clock reads on the null path. The request plane (obs.requests)
+    # mirrors the seam: the call is noted as a one-request flush whose
+    # stage ledger marks the same seams the engine does (the residual
+    # lands in topk_merge — the pad clamp runs after the final drain).
     from large_scale_recommendation_tpu.obs.budget import get_budget
+    from large_scale_recommendation_tpu.obs.requests import get_requests
 
     budget = get_budget()
-    t_serve = time.perf_counter() if budget is not None else 0.0
+    rt = get_requests()
+    t_serve = (time.perf_counter()
+               if budget is not None or rt is not None else 0.0)
+    led = rt.ledger(t_serve) if rt is not None else None
 
     if catalog is None:
         catalog = shard_catalog(V, mesh, item_mask)
@@ -412,18 +424,32 @@ def mesh_top_k_recommend(U, V, user_rows, k: int = 10,
 
     def score_chunk(cu, c):
         excl_rows, excl_cols, excl_w = build_excl(cu, c)
+        if led is not None:
+            led.mark("batch_form")  # exclusion build
         U_chunk = U_dev[jnp.asarray(cu)]
         if U_chunk.dtype != cat_dtype:
             U_chunk = U_chunk.astype(cat_dtype)
-        return step(U_chunk, V_sh, w_sh,
-                    jnp.asarray(excl_rows), jnp.asarray(excl_cols),
-                    jnp.asarray(excl_w))
+        if led is not None:
+            led.mark("gather")
+        out = step(U_chunk, V_sh, w_sh,
+                   jnp.asarray(excl_rows), jnp.asarray(excl_cols),
+                   jnp.asarray(excl_w))
+        if led is not None:
+            led.mark("score_stage1")  # one fused dispatch: stage 1
+        return out
 
     chunk = min(chunk, pow2_pad(n))
     out = run_pipelined_topk(
         user_rows, k=k, k_out=k_out, n_rows=n_rows, slice_size=chunk,
-        bucket_fn=lambda c: chunk, score_chunk=score_chunk)
-    if budget is not None:
-        budget.note_result(catalog.version,
-                           time.perf_counter() - t_serve)
+        bucket_fn=lambda c: chunk, score_chunk=score_chunk,
+        on_drain=(None if led is None
+                  else lambda: led.mark("topk_merge")))
+    if budget is not None or led is not None:
+        t_end = time.perf_counter()  # ONE read shared by both planes
+        if budget is not None:
+            budget.note_result(catalog.version, t_end - t_serve)
+        if rt is not None and led is not None:
+            rt.note_flush(led, t_end, (t_serve,),
+                          version=catalog.version, rows=(n,),
+                          residual_stage="topk_merge")
     return out

@@ -74,6 +74,7 @@ from large_scale_recommendation_tpu.obs.disttrace import get_disttrace
 from large_scale_recommendation_tpu.obs.events import get_events
 from large_scale_recommendation_tpu.obs.lineage import get_lineage
 from large_scale_recommendation_tpu.obs.registry import get_registry
+from large_scale_recommendation_tpu.obs.requests import get_requests
 from large_scale_recommendation_tpu.obs.trace import get_tracer
 from large_scale_recommendation_tpu.obs.transfers import (
     get_transfers,
@@ -241,6 +242,11 @@ class ServingEngine:
         # served it, every shed submit notes the rejection against the
         # live version — one `is not None` test per seam
         self._budget = get_budget()
+        # request telemetry (obs.requests): every flush marks a stage
+        # ledger whose per-request sums reconcile against the SLO-
+        # recorded walls, and the tail exemplars land in /slowz — one
+        # `is not None` test per seam, no ledger allocation when off
+        self._requests = get_requests()
         self._m_qwait = obs.histogram("serving_queue_wait_s")
         self._m_assembly = obs.histogram("serving_batch_assembly_s")
         self._m_flush = obs.histogram("serving_flush_s")
@@ -606,12 +612,20 @@ class ServingEngine:
         if self._admission is not None:
             try:
                 self._admission.check_admit()  # raises when shedding
-            except Exception:
+            except Exception as e:
                 if self._budget is not None:
                     # the shed outcome is attributed to the version that
                     # WOULD have served — overload during a canary
                     # charges the canary's cohort, not a wall-clock bin
                     self._budget.note_shed(self.version)
+                if self._requests is not None:
+                    # a shed IS a tail exemplar: always kept, carrying
+                    # the rung and burn that drove the rejection
+                    self._requests.note_shed(
+                        version=self.version,
+                        level=getattr(e, "level", "shed"),
+                        burn=getattr(e, "burn", None),
+                        queue_depth=len(self._pending))
                 raise
         with self._lock:
             self._pending.append(np.asarray(user_ids))
@@ -704,6 +718,11 @@ class ServingEngine:
                         and self._retriever is not None)
             t0 = time.perf_counter()
             stamps, self._pending_t = self._pending_t, []
+            # stage ledger (obs.requests): anchored on the SAME t0 the
+            # flush wall measures from — None when the plane is off (no
+            # allocation, no clock reads on the null path)
+            led = (self._requests.ledger(t0)
+                   if self._requests is not None else None)
             if self._obs_on:
                 for ts in stamps:
                     self._m_qwait.observe(t0 - ts)
@@ -720,8 +739,15 @@ class ServingEngine:
                 bounds.append(bounds[-1] + int(known.sum()))
             rows_all = (np.concatenate(row_slices) if row_slices
                         else np.zeros(0, np.int64))
-            if self._obs_on:
-                self._m_assembly.observe(time.perf_counter() - t0)
+            if self._obs_on or led is not None:
+                # ONE clock read feeds both the assembly histogram and
+                # the ledger's batch_form mark — the shared-read
+                # discipline that keeps the stage sum reconcilable
+                t_asm = time.perf_counter()
+                if self._obs_on:
+                    self._m_assembly.observe(t_asm - t0)
+                if led is not None:
+                    led.mark("batch_form", t_asm)
             if self._trace.enabled:
                 # compile-keyed: the first flush at a fresh catalog
                 # geometry carries the bucket family's XLA compiles.
@@ -737,10 +763,10 @@ class ServingEngine:
                         rows=len(rows_all), requests=len(requests),
                         catalog_version=int(self.version)):
                     top_rows, top_scores = self._serve_rows(
-                        rows_all, stage1_only=degraded)
+                        rows_all, stage1_only=degraded, ledger=led)
             else:
                 top_rows, top_scores = self._serve_rows(
-                    rows_all, stage1_only=degraded)
+                    rows_all, stage1_only=degraded, ledger=led)
             version = self.version
             results = []
             for (n_ids, known), b0, b1 in zip(known_masks, bounds,
@@ -756,6 +782,10 @@ class ServingEngine:
             self.stats["flushes"] += 1
             wall = time.perf_counter() - t0
             end = t0 + wall
+            # the rung exemplars report: read BEFORE observe() below
+            # re-evaluates the ladder — the level that served THIS flush
+            adm_level = (self._admission.level
+                         if self._admission is not None else None)
             self.meter.record(len(rows_all), wall)
             if self._slo is not None:
                 # one sample per REQUEST: queue wait since submit plus
@@ -804,16 +834,32 @@ class ServingEngine:
             self._budget.note_results(
                 version, [end - ts for ts in stamps],
                 degraded=len(requests) if degraded else 0)
+        if self._requests is not None and led is not None:
+            # the REQUEST plane's flush note (obs.requests): the SAME
+            # end/stamps floats the SLO just recorded close the stage
+            # ledger, so every request's stage sum reconciles against
+            # its recorded wall by construction (host_post takes the
+            # flush residual, queue_wait the per-request one). Outside
+            # flush's own lock hold, same rule as the budget note.
+            self._requests.note_flush(
+                led, end, stamps, version=version, degraded=degraded,
+                rows=[b1 - b0 for b0, b1 in zip(bounds, bounds[1:])],
+                admission_level=adm_level)
         return results
 
     def _serve_rows(self, user_rows: np.ndarray,
-                    stage1_only: bool = False):
+                    stage1_only: bool = False, ledger=None):
         """Row-space scoring through pow2-bucketed micro-batches, on the
         shared two-deep dispatch pipeline (``run_pipelined_topk`` — one
         copy of the overlap + pad-clamp machinery with the per-call
         path). Routes to the exact mesh step or the two-stage fast path
         (``stage1_only`` skips the exact rescore — the admission
-        ladder's degraded operating point)."""
+        ladder's degraded operating point). ``ledger`` (a
+        ``obs.requests.FlushLedger``, None when the plane is off) marks
+        the stage seams: exclusion builds land in ``batch_form``, user
+        gathers in ``gather``, score dispatches in ``score_stage1``/
+        ``score_stage2``, drain syncs in ``topk_merge`` — each mark one
+        clock read over the contiguous host interval since the last."""
         store = self._user_store
 
         def gather_users(cu, want_dtype):
@@ -836,9 +882,15 @@ class ServingEngine:
 
             def base_chunk(cu, c):
                 excl = self._build_excl(cu, c)
+                if ledger is not None:
+                    ledger.mark("batch_form")  # exclusion build
                 U_chunk = gather_users(cu, jnp.float32)
+                if ledger is not None:
+                    ledger.mark("gather")
                 return ret.topk(U_chunk, excl, k=self.k,
-                                stage1_only=stage1_only)
+                                stage1_only=stage1_only,
+                                mark=(ledger.mark if ledger is not None
+                                      else None))
 
             k_out = min(self.k, ret.candidate_count(self.k))
             n_rows = ret.n_rows
@@ -851,10 +903,19 @@ class ServingEngine:
 
             def base_chunk(cu, c):
                 excl = self._build_excl(cu, c)
-                return step(gather_users(cu, self._dtype),
-                            cat.V_sh, cat.w_sh,
-                            jnp.asarray(excl[0]), jnp.asarray(excl[1]),
-                            jnp.asarray(excl[2]))
+                if ledger is not None:
+                    ledger.mark("batch_form")  # exclusion build
+                U_chunk = gather_users(cu, self._dtype)
+                if ledger is not None:
+                    ledger.mark("gather")
+                out = step(U_chunk, cat.V_sh, cat.w_sh,
+                           jnp.asarray(excl[0]), jnp.asarray(excl[1]),
+                           jnp.asarray(excl[2]))
+                if ledger is not None:
+                    # the exact path's one fused score dispatch lands
+                    # in stage 1; score_stage2 stays 0 by construction
+                    ledger.mark("score_stage1")
+                return out
 
             k_out, n_rows, slice_size = (self._k_out, cat.n_rows,
                                          self.max_batch)
@@ -896,4 +957,6 @@ class ServingEngine:
                 slice_size=slice_size,
                 bucket_fn=lambda c: min(pow2_pad(c, self.min_bucket),
                                         slice_size),
-                score_chunk=score_chunk, on_batch=on_batch)
+                score_chunk=score_chunk, on_batch=on_batch,
+                on_drain=(None if ledger is None
+                          else lambda: ledger.mark("topk_merge")))

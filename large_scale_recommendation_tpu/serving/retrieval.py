@@ -697,10 +697,15 @@ class TwoStageRetriever:
         return min(max(k, min(k * self.config.overfetch, cat.n_rows)),
                    hard)
 
-    def topk(self, U_chunk, excl, k: int, stage1_only: bool = False):
+    def topk(self, U_chunk, excl, k: int, stage1_only: bool = False,
+             mark=None):
         """Top-``k`` of one padded query chunk: ``(values f32 [b, k],
         rows int32 [b, k])``, rows ≥ ``n_rows`` possible only for slab
-        pads (callers clamp, as with mesh padding)."""
+        pads (callers clamp, as with mesh padding). ``mark`` (the
+        request plane's ``FlushLedger.mark``, None when off) splits the
+        dispatch wall at the stage-1/stage-2 seam — one clock read per
+        mark, including under ``stage1_only`` (the degraded path still
+        attributes its approximate stage-2 dispatch)."""
         cat = self.catalog
         kc = self.candidate_count(k)
         if U_chunk.shape[0] * (cat.n_rows + 1) >= 2**32:
@@ -732,9 +737,14 @@ class TwoStageRetriever:
             cand_v, cand_rows = _stage1_flat(
                 qU, u_scale, cat.q, cat.scale, cat.item_w,
                 excl_rows, excl_cols, excl_w, kc=kc)
-        return _stage2(U_chunk, self.V, cat.item_w, cand_v, cand_rows,
-                       excl_rows, excl_cols, excl_w,
-                       k=min(k, kc), exact=not stage1_only)
+        if mark is not None:
+            mark("score_stage1")
+        out = _stage2(U_chunk, self.V, cat.item_w, cand_v, cand_rows,
+                      excl_rows, excl_cols, excl_w,
+                      k=min(k, kc), exact=not stage1_only)
+        if mark is not None:
+            mark("score_stage2")
+        return out
 
     def apply_delta(self, rows, values, version: int) -> None:
         """Install only the touched rows: patch the f32 rescore table

@@ -277,7 +277,18 @@ DEFAULT_LOWER = ("_wall_s", "_ms_", "time_to_", "_s_p", "_pad_ratio",
                  # with no HIGHER pattern — error_budget_remaining
                  # (higher-better) contains neither — pinned by the
                  # direction tests.
-                 "burn_rate", "verdict_latency")
+                 "burn_rate", "verdict_latency",
+                 # request plane (ISSUE 20): per-stage serving walls
+                 # (request_stage_*_s_p99 and friends) and per-request
+                 # queue wait both regress UP — a stage's p99 growing
+                 # means a serving seam got slower, queue_wait growing
+                 # means admission/batching backpressure. Watched via
+                 # --key on rounds that carry them, NOT in
+                 # SERVING_KEYS: committed rounds predating ISSUE 20
+                 # lack the keys (the PR 10/13 lesson). Neither
+                 # "request_stage" nor "queue_wait" is a substring of
+                 # any HIGHER pattern — pinned by the direction tests.
+                 "request_stage", "queue_wait")
 
 _NUM_PAIR = re.compile(
     r'"([A-Za-z_][A-Za-z0-9_]*)":\s*(-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)')

@@ -691,6 +691,73 @@ def render_budget(doc: dict, tail: int = 12) -> str:
     return "\n".join(out).rstrip()
 
 
+def render_requests(doc: dict, tail: int = 12) -> str:
+    """Render a ``/slowz`` body (or dumped snapshot / bundle
+    ``requests.json`` / fleet pod aggregate): window stage
+    decomposition with the dominant stage, then the exemplar table
+    worst-first — each row naming where that request's time went."""
+    head = ["# per-request stage decomposition & tail exemplars"]
+    if doc.get("note"):
+        head[0] += f" — note: {doc['note']}"
+    if doc.get("target_s") is not None:
+        head.append(f"slo: target {_fmt(doc['target_s'] * 1e3)} ms, "
+                    f"objective {_fmt(doc.get('objective'))}")
+    bits = []
+    for key in ("count", "violations", "shed", "window_fill"):
+        if doc.get(key) is not None:
+            bits.append(f"{key}={_fmt(doc[key])}")
+    if doc.get("burn_rate") is not None:
+        bits.append(f"burn_rate={_fmt(doc['burn_rate'])}")
+    if doc.get("p99_ms") is not None:
+        bits.append(f"p99={_fmt(doc['p99_ms'])} ms")
+    if bits:
+        head.append(", ".join(bits))
+    out = head + [""]
+
+    frac = doc.get("stage_frac") or {}
+    totals = doc.get("stage_totals_s") or {}
+    if frac:
+        dominant = doc.get("dominant_stage")
+        rows = [(s + (" *" if s == dominant else ""),
+                 _fmt(totals.get(s)), _fmt(f))
+                for s, f in sorted(frac.items(),
+                                   key=lambda kv: -kv[1])]
+        out.extend(format_table(("stage", "total_s", "frac"), rows))
+        out.append("")
+
+    exemplars = doc.get("exemplars") or []
+    if exemplars:
+        out.append(f"exemplars worst-first (showing "
+                   f"{min(tail, len(exemplars))} of {len(exemplars)}):")
+        rows = [(str(e.get("host", "-")) if "host" in e else
+                 str(e.get("seq", "-")),
+                 str(e.get("kind")), _fmt((e.get("wall_s") or 0.0) * 1e3),
+                 str(e.get("dominant_stage") or "-"),
+                 str(e.get("catalog_version")),
+                 str(e.get("queue_depth") if e.get("queue_depth")
+                     is not None else "-"),
+                 str(e.get("bucket") or "-"),
+                 str(e.get("admission_level") or "-"))
+                for e in exemplars[:tail]]
+        out.extend(format_table(
+            ("id", "kind", "wall_ms", "dominant", "ver", "qdepth",
+             "bucket", "admission"), rows))
+    elif not doc.get("note"):
+        out.append("(no exemplars kept — no traffic noted yet)")
+    targets = doc.get("targets")
+    if targets:  # a fleet pod aggregate: per-host summaries ride along
+        out.append("")
+        rows = [(str(t.get("host")), _fmt(t.get("count")),
+                 _fmt(t.get("violations")), _fmt(t.get("shed")),
+                 _fmt(t.get("p99_ms")),
+                 str(t.get("dominant_stage") or "-"),
+                 str(t.get("note") or "-"))
+                for t in targets]
+        out.extend(format_table(("host", "count", "viol", "shed",
+                                 "p99_ms", "dominant", "note"), rows))
+    return "\n".join(out).rstrip()
+
+
 QUALITY_PREFIXES = ("eval_", "dataq_", "lineage_")
 
 
@@ -787,6 +854,12 @@ def main(argv=None) -> int:
                          "cohort attribution + canary verdict tail) from "
                          "a /budgetz URL, a dumped snapshot JSON, a "
                          "bundle budget.json, or a fleet pod aggregate")
+    ap.add_argument("--requests", default=None, metavar="SRC",
+                    help="render the per-request plane (window stage "
+                         "decomposition + dominant stage + tail "
+                         "exemplars worst-first) from a /slowz URL, a "
+                         "dumped snapshot JSON, a bundle requests.json, "
+                         "or a fleet pod aggregate")
     args = ap.parse_args(argv)
     if args.bundle is not None:
         print(render_bundle(args.bundle, args.name))
@@ -811,6 +884,9 @@ def main(argv=None) -> int:
         return 0
     if args.budget is not None:
         print(render_budget(fetch_snapshot(args.budget)))
+        return 0
+    if args.requests is not None:
+        print(render_requests(fetch_snapshot(args.requests)))
         return 0
     if args.path is None:
         ap.error("path is required unless --bundle is given")

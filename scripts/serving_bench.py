@@ -405,6 +405,13 @@ def run_traffic(num_users=20_000, num_items=262_144, rank=64,
     budget = obs.enable_budget(
         slo_ms / 1e3, objective=0.9, fast_window=32, slow_window=256,
         min_samples=8, sample_budget=64)
+    # the request plane rides the same lifecycle (ISSUE 20): engines
+    # bind the handle at construction, so it too must exist first —
+    # every flush below then carries a stage ledger and the sustained
+    # pass's tail lands in the exemplar reservoir
+    telemetry = obs.enable_requests(
+        slo_ms / 1e3, objective=0.9, window=512, max_exemplars=64,
+        slow_keep=16)
 
     model = build_structured_model(num_users, num_items, rank,
                                    n_centers=n_centers, seed=seed)
@@ -522,6 +529,28 @@ def run_traffic(num_users=20_000, num_items=262_144, rank=64,
                                    deadline_s=deadline_ms / 1e3,
                                    slo_ms=slo_ms)
     extra["overload_exact_p99_ms"] = over_exact["p99_ms"]
+
+    # ---- request-plane stamp: where the sustained pass's time went ---
+    # per-stage medians/p99s over the plane's window (the curve +
+    # overload passes fed it) plus the exemplar-reservoir census; the
+    # full /slowz body optionally dumps for CI artifacts. Stamped keys
+    # match the bench_regress DEFAULT_LOWER patterns ("request_stage",
+    # "queue_wait") — watched via explicit --key only.
+    req_snap = telemetry.snapshot()
+    for stage, q in telemetry.stage_quantiles().items():
+        # queue_wait stamps under its own name (its regress pattern)
+        key = "queue_wait" if stage == "queue_wait" \
+            else f"request_stage_{stage}"
+        extra[f"{key}_s_p50"] = round(q["p50"], 6)
+        extra[f"{key}_s_p99"] = round(q["p99"], 6)
+    extra["request_dominant_stage"] = req_snap["dominant_stage"]
+    extra["request_exemplars_kept"] = req_snap["kept"]
+    extra["request_noted"] = req_snap["count"]
+    extra["request_shed_noted"] = req_snap["shed"]
+    slowz_out = os.environ.get("SERVING_SLOWZ_OUT")
+    if slowz_out:
+        with open(slowz_out, "w") as f:
+            json.dump(req_snap, f, indent=1)
 
     # ---- rollout canary: poisoned catalog version, verdict latency ---
     # The canary serves a deliberately poisoned catalog (item factors

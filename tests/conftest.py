@@ -138,6 +138,10 @@ def null_obs():
         get_budget,
         set_budget,
     )
+    from large_scale_recommendation_tpu.obs.requests import (
+        get_requests,
+        set_requests,
+    )
     from large_scale_recommendation_tpu.obs.transfers import (
         get_transfers,
         set_transfers,
@@ -151,6 +155,7 @@ def null_obs():
     prev_tf = get_transfers()
     prev_store = get_store()
     prev_budget = get_budget()
+    prev_requests = get_requests()
     was_running = prev_rec is not None and prev_rec.running
     ins_was_running = prev_ins is not None and prev_ins.running
     ct_was_running = prev_ct is not None and prev_ct.running
@@ -175,6 +180,7 @@ def null_obs():
     set_transfers(prev_tf)
     set_store(prev_store)  # a test-built TieredFactorStore must not leak
     set_budget(prev_budget)
+    set_requests(prev_requests)
 
 
 def pytest_sessionfinish(session, exitstatus):

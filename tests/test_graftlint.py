@@ -340,6 +340,58 @@ class TestObsGate:
         assert res.findings == []
         assert [f.rule for f in res.suppressed] == ["obs-gate"]
 
+    def test_requests_getter_planted(self, tmp_path):
+        """``get_requests`` joined NONE_GETTERS with the request plane
+        (PR 20): an ungated ``note_shed`` at the admission-reject seam
+        is the exact regression the rule exists to catch."""
+        res = lint_src(tmp_path, """
+            from large_scale_recommendation_tpu.obs.requests import (
+                get_requests,
+            )
+
+            def shed(version):
+                rt = get_requests()
+                rt.note_shed(version=version)
+        """, "obs-gate")
+        assert [f.rule for f in res.findings] == ["obs-gate"]
+        assert "rt" in res.findings[0].message
+
+    def test_requests_seam_site_shape_is_clean(self, tmp_path):
+        """The canonical wired-site shape (bind once, skip the clock
+        when absent, close the ledger after serving) must lint clean —
+        the ServingEngine.flush crossing uses exactly this."""
+        res = lint_src(tmp_path, """
+            import time
+
+            from large_scale_recommendation_tpu.obs.requests import (
+                get_requests,
+            )
+
+            def serve(run, version):
+                rt = get_requests()
+                t0 = time.perf_counter() if rt is not None else 0.0
+                led = rt.ledger(t0) if rt is not None else None
+                out = run()
+                if rt is not None and led is not None:
+                    rt.note_flush(led, time.perf_counter(), (t0,),
+                                  version=version)
+                return out
+        """, "obs-gate")
+        assert res.findings == []
+
+    def test_requests_reasoned_suppression_survives(self, tmp_path):
+        res = lint_src(tmp_path, """
+            from large_scale_recommendation_tpu.obs.requests import (
+                get_requests,
+            )
+
+            def debug_dump():
+                # debug-only path: a crash here is acceptable
+                get_requests().snapshot()  # graftlint: disable=obs-gate
+        """, "obs-gate")
+        assert res.findings == []
+        assert [f.rule for f in res.suppressed] == ["obs-gate"]
+
 
 # ---------------------------------------------------------------------------
 # lock-order

@@ -521,8 +521,10 @@ class TestBundle:
             b.note_result(5, 0.02)
             path = write_bundle(str(tmp_path / "b"), trigger="manual")
             docs = load_bundle(path)
-            assert BUNDLE_VERSION == 7
-            assert docs["manifest"]["bundle_version"] == 7
+            # the plane landed in bundle v7; later planes keep
+            # bumping the version, so pin the floor, not the value
+            assert BUNDLE_VERSION >= 7
+            assert docs["manifest"]["bundle_version"] == BUNDLE_VERSION
             assert docs["budget"]["cohorts"]["5"]["served"] == 1
             # an archived version-6 bundle (pre-rollout-plane) stays
             # loadable with the note synthesized

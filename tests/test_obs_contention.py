@@ -465,8 +465,10 @@ class TestContentionzEndToEnd:
                 pass
             path = write_bundle(str(tmp_path / "b"), trigger="manual")
             docs = load_bundle(path)
-            assert BUNDLE_VERSION == 7
-            assert docs["manifest"]["bundle_version"] == 7
+            # the plane landed in bundle v7; later planes keep
+            # bumping the version, so pin the floor, not the value
+            assert BUNDLE_VERSION >= 7
+            assert docs["manifest"]["bundle_version"] == BUNDLE_VERSION
             locks = {r["lock"] for r in docs["contention"]["locks"]}
             assert "t.bundle" in locks
             # an archived version-3 bundle (pre-concurrency-plane)

@@ -1091,3 +1091,71 @@ class TestRolloutDirections:
                              "--baseline", c, "--current", b,
                              "--key", "slo_burn_rate_fast=50",
                              "--key", "error_budget_remaining=50"]) == 0
+
+
+class TestRequestStageDirections:
+    """Request-plane keys (ISSUE 20): ``request_stage`` /
+    ``queue_wait`` joined DEFAULT_LOWER — the direction /
+    no-collision / not-in-family twins the rollout entries carry. CI
+    watches these via explicit ``--key`` only: committed rounds
+    predating the plane lack the keys, and a default watch key the
+    baseline can't contain is permanent "missing" noise (the
+    PR 10/13 lesson)."""
+
+    LOWER_KEYS = ("request_stage_gather_s_p99",
+                  "request_stage_score_stage1_s_p50",
+                  "queue_wait_s_p99")
+
+    def test_request_stage_direction_rules(self):
+        from scripts.bench_regress import is_lower_better
+
+        for key in self.LOWER_KEYS:
+            assert is_lower_better(key, set()), key
+
+    def test_request_stage_no_direction_collision(self):
+        """A stage wall must not match a HIGHER pattern
+        (DEFAULT_HIGHER wins, so a collision silently flips the
+        gate's direction)."""
+        from scripts.bench_regress import DEFAULT_HIGHER, DEFAULT_LOWER
+
+        for key in self.LOWER_KEYS:
+            assert not any(pat in key for pat in DEFAULT_HIGHER), key
+        for pat in ("request_stage", "queue_wait"):
+            assert pat in DEFAULT_LOWER
+
+    def test_request_stage_keys_not_in_family_watch_sets(self):
+        """Explicit --key only — no family default set may carry a
+        request-plane key."""
+        from scripts.bench_regress import FAMILIES
+
+        for fam, (_, keys) in FAMILIES.items():
+            for key in keys:
+                for pat in ("request_stage", "queue_wait"):
+                    assert pat not in key, (fam, key)
+
+    def test_stage_p99_blowup_trips_via_key(self, tmp_path):
+        """A gather-stage p99 regression on a round that carries the
+        key trips through the LOWER direction rule."""
+        for name, p99 in (("SERVING_r02.json", 0.002),
+                          ("SERVING_r03.json", 0.080)):
+            (tmp_path / name).write_text(json.dumps(
+                {"metric": "serving users/s", "value": 300.0,
+                 "unit": "users/s",
+                 "extra": {"qps_at_slo": 12.0, "p99_ms": 80.0,
+                           "recall_at_10": 0.99, "shed_frac": 0.0,
+                           "request_stage_gather_s_p99": p99,
+                           "queue_wait_s_p99": p99}}))
+        b = str(tmp_path / "SERVING_r02.json")
+        c = str(tmp_path / "SERVING_r03.json")
+        assert regress_main(["--family", "serving",
+                             "--baseline", b, "--current", c,
+                             "--key", "request_stage_gather_s_p99=50"
+                             ]) == 1
+        assert regress_main(["--family", "serving",
+                             "--baseline", b, "--current", c,
+                             "--key", "queue_wait_s_p99=50"]) == 1
+        # the improvement direction (faster stages) never trips
+        assert regress_main(["--family", "serving",
+                             "--baseline", c, "--current", b,
+                             "--key", "request_stage_gather_s_p99=50",
+                             "--key", "queue_wait_s_p99=50"]) == 0

@@ -482,8 +482,10 @@ class TestBundle:
             led.note_transfer("tier.demo", "d2h", 2048, 0.02)
             path = write_bundle(str(tmp_path / "b"), trigger="manual")
             docs = load_bundle(path)
-            assert BUNDLE_VERSION == 7
-            assert docs["manifest"]["bundle_version"] == 7
+            # the plane landed in bundle v7; later planes keep
+            # bumping the version, so pin the floor, not the value
+            assert BUNDLE_VERSION >= 7
+            assert docs["manifest"]["bundle_version"] == BUNDLE_VERSION
             assert docs["transfers"]["sites"]["tier.demo"][
                 "d2h_bytes"] == 2048
             # an archived version-5 bundle (pre-transfer-plane) stays

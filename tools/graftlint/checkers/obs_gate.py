@@ -38,7 +38,7 @@ from tools.graftlint.core import Checker, Finding, ModuleInfo, Project
 NONE_GETTERS = {
     "get_events", "get_recorder", "get_lineage", "get_disttrace",
     "get_contention", "get_introspector", "get_transfers",
-    "get_budget",
+    "get_budget", "get_requests",
 }
 
 
